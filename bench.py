@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Headline benchmark: GPT-2 pretraining throughput + MFU on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the north-star from BASELINE.md — ≥50% MFU for GPT-2-class ZeRO-3
+pretraining (the reference's best published efficiency is 52% of peak on V100,
+docs/_posts/2020-05-19-bert-record.md:13). vs_baseline = MFU / 0.50.
+
+Env knobs: BENCH_MODEL (preset name), BENCH_BS, BENCH_SEQ, BENCH_STEPS.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def main():
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-350m")
+    n_dev = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu and "BENCH_MODEL" not in os.environ:
+        model_name = "gpt2-tiny"
+
+    import deepspeed_tpu
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.models.gpt2 import GPT2Model, PRESETS, synthetic_lm_batch
+
+    config = PRESETS[model_name]
+    seq = int(os.environ.get("BENCH_SEQ", min(1024, config.n_positions)))
+    per_chip_bs = int(os.environ.get("BENCH_BS", 8 if on_tpu else 2))
+    steps = int(os.environ.get("BENCH_STEPS", 10 if on_tpu else 3))
+    batch_size = per_chip_bs * n_dev
+
+    ds_config = {
+        "train_batch_size": batch_size,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3 if n_dev > 1 else 1},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+
+    model = GPT2Model(config)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
+    batch = synthetic_lm_batch(batch_size, seq, config.vocab_size, seed=0)
+
+    # warmup / compile
+    for _ in range(2):
+        loss = engine.train_batch(batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    tokens = batch_size * seq * steps
+    tok_per_sec = tokens / dt
+    tok_per_sec_chip = tok_per_sec / n_dev
+    flops_per_token = config.flops_per_token(seq)
+    achieved = tok_per_sec_chip * flops_per_token
+    peak = get_accelerator().peak_flops()
+    mfu = achieved / peak
+
+    result = {
+        "metric": f"{model_name} pretrain MFU (bs={per_chip_bs}/chip, seq={seq}, "
+                  f"{n_dev} chip(s), tok/s/chip={tok_per_sec_chip:.0f}, "
+                  f"TFLOPs/chip={achieved/1e12:.1f}, loss={float(loss):.3f})",
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / 0.50, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""deepspeed_tpu — a TPU-native training/inference framework with the
+capabilities of DeepSpeed (reference: AngelTs/DeepSpeed v0.9.3).
+
+Public API parity with ``deepspeed/__init__.py``: ``initialize`` (:58),
+``init_distributed``, ``init_inference`` (:260), ``add_config_arguments``
+(:237) — re-designed for JAX/XLA: the engine is functional, parallelism is a
+``jax.sharding.Mesh``, and collectives are XLA's (see deepspeed_tpu.comm).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__version__ = "0.1.0"
+
+from deepspeed_tpu.accelerator import get_accelerator  # noqa: F401
+from deepspeed_tpu.utils.logging import log_dist, logger  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               config=None,
+               config_params=None):
+    """Create the training engine (reference deepspeed/__init__.py:58).
+
+    Returns the same 4-tuple: (engine, optimizer, training_dataloader,
+    lr_scheduler). ``model`` follows the functional protocol — an object with
+    ``init_params(rng)`` and ``loss(params, batch, rng)`` (see
+    deepspeed_tpu.models) or a bare loss callable with ``model_parameters``
+    as the initial pytree.
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    log_dist(f"deepspeed_tpu {__version__} initialize()", ranks=[0])
+    if config is None:
+        config = config_params
+    if config is None and args is not None and getattr(args, "deepspeed_config", None) is not None:
+        config = args.deepspeed_config
+
+    engine = DeepSpeedEngine(args=args,
+                             model=model,
+                             optimizer=optimizer,
+                             model_parameters=model_parameters,
+                             training_data=training_data,
+                             lr_scheduler=lr_scheduler,
+                             mpu=mpu,
+                             dist_init_required=dist_init_required,
+                             collate_fn=collate_fn,
+                             config=config)
+    return engine, engine.optimizer, engine.dataloader, engine.lr_scheduler
+
+
+def init_distributed(dist_backend: str = "xccl", **kwargs):
+    """Bootstrap the mesh/comm backend (see deepspeed_tpu.comm.comm.init_distributed)."""
+    from deepspeed_tpu.comm import comm as _comm
+
+    return _comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Create an InferenceEngine (reference deepspeed/__init__.py:260)."""
+    try:
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+        from deepspeed_tpu.inference.engine import InferenceEngine
+    except ModuleNotFoundError as e:
+        raise NotImplementedError(
+            "deepspeed_tpu.inference is not available in this build yet") from e
+
+    if config is None:
+        config = {}
+    if isinstance(config, dict):
+        config = DeepSpeedInferenceConfig(**{**config, **kwargs})
+    return InferenceEngine(model, config)
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config CLI args (reference :237)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag, parity only)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the ds_config json")
+    group.add_argument("--local_rank", type=int, default=-1,
+                       help="local rank passed by launchers (unused on TPU single-controller)")
+    return parser
+
+
+def _lazy(name):
+    import importlib
+
+    return importlib.import_module(name)
+
+
+def __getattr__(name):
+    # lazy subsystem access: deepspeed_tpu.comm, .zero, .moe, .pipe, ...
+    lazy_map = {
+        "comm": "deepspeed_tpu.comm",
+        "zero": "deepspeed_tpu.runtime.zero",
+        "moe": "deepspeed_tpu.moe",
+        "pipe": "deepspeed_tpu.runtime.pipe",
+        "ops": "deepspeed_tpu.ops",
+        "checkpoint": "deepspeed_tpu.checkpoint",
+        "inference": "deepspeed_tpu.inference",
+    }
+    if name == "DeepSpeedEngine":
+        return _lazy("deepspeed_tpu.runtime.engine").DeepSpeedEngine
+    if name == "DeepSpeedConfig":
+        return _lazy("deepspeed_tpu.runtime.config").DeepSpeedConfig
+    if name in lazy_map:
+        return _lazy(lazy_map[name])
+    raise AttributeError(f"module deepspeed_tpu has no attribute {name}")

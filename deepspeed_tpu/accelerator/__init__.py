@@ -1,0 +1,4 @@
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+from deepspeed_tpu.accelerator.tpu_accelerator import TPU_Accelerator, get_accelerator
+
+__all__ = ["DeepSpeedAccelerator", "TPU_Accelerator", "get_accelerator"]

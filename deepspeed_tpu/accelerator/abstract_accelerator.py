@@ -1,0 +1,118 @@
+"""Accelerator abstraction — the single device-portability seam.
+
+Counterpart of the reference's ``accelerator/abstract_accelerator.py:10``
+(``DeepSpeedAccelerator`` ABC with ~41 abstract methods: device mgmt, RNG,
+streams/events, memory stats, dtype support, comm backend name, op builders).
+
+The TPU build keeps the seam but drops the CUDA-isms that have no XLA meaning
+(streams/events — XLA schedules asynchronously itself; pinned-memory handles —
+host transfer is ``jax.device_put``). What remains is the honest portable
+surface: device enumeration/selection, RNG seeding, memory telemetry, dtype
+capability, communication-backend naming, and a kernel (op) registry hook.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    """Device abstraction consumed by runtime, comm, ops, and tests."""
+
+    def __init__(self):
+        self._name: Optional[str] = None
+        self._communication_backend_name: Optional[str] = None
+
+    # ------------------------------------------------------------------ device
+    @abc.abstractmethod
+    def is_available(self) -> bool: ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str: ...
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None) -> Any: ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        """Number of local (this-process) devices."""
+
+    @abc.abstractmethod
+    def global_device_count(self) -> int:
+        """Number of devices across all processes."""
+
+    @abc.abstractmethod
+    def current_device(self) -> int: ...
+
+    @abc.abstractmethod
+    def set_device(self, device_index: int) -> None: ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        """Block until queued work on the device is complete."""
+
+    # ------------------------------------------------------------------- RNG
+    @abc.abstractmethod
+    def manual_seed(self, seed: int) -> Any:
+        """Seed device RNG; returns a key/state object where applicable."""
+
+    @abc.abstractmethod
+    def initial_seed(self) -> int: ...
+
+    # ---------------------------------------------------------------- memory
+    @abc.abstractmethod
+    def memory_allocated(self, device_index: Optional[int] = None) -> int: ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int: ...
+
+    @abc.abstractmethod
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None: ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index: Optional[int] = None) -> int: ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index: Optional[int] = None) -> int: ...
+
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> dict: ...
+
+    # ----------------------------------------------------------------- dtype
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self) -> List[Any]: ...
+
+    @abc.abstractmethod
+    def preferred_dtype(self) -> Any:
+        """Best training dtype on this hardware (bf16 on TPU)."""
+
+    # ------------------------------------------------------------------ comm
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        """e.g. 'xccl' for XLA collectives (reference: 'nccl' for CUDA)."""
+
+    # ----------------------------------------------------------------- perf
+    @abc.abstractmethod
+    def peak_flops(self, dtype: Any = None) -> float:
+        """Peak dense matmul FLOP/s per chip, for MFU accounting."""
+
+    # ------------------------------------------------------------- op builder
+    @abc.abstractmethod
+    def create_op_builder(self, op_name: str) -> Any: ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, op_name: str) -> Any: ...
+
+    # --------------------------------------------------------------- platform
+    @abc.abstractmethod
+    def on_accelerator(self, array: Any) -> bool: ...
+
+    def name(self) -> str:
+        return self._name or "unknown"

@@ -1,0 +1,5 @@
+from deepspeed_tpu.comm.comm import *  # noqa: F401,F403
+from deepspeed_tpu.comm.comm import (CommGroup, ReduceOp, all_gather, all_reduce, all_to_all_single,
+                                     barrier, broadcast, cdb, configure, get_mesh, get_rank,
+                                     get_world_size, init_distributed, is_initialized, new_group,
+                                     ppermute, reduce_scatter, set_mesh)

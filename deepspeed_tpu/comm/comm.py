@@ -1,0 +1,495 @@
+"""xccl — the XLA-collectives communication layer.
+
+Counterpart of the reference's ``deepspeed/comm/comm.py`` (torch.distributed-
+shaped module API over a global backend object ``cdb``, comm.py:53, installed by
+``init_distributed:562``) and its only backend ``TorchBackend``
+(comm/torch.py:39). Same surface, TPU-native semantics:
+
+* ``all_reduce → jax.lax.psum``, ``all_gather → jax.lax.all_gather``,
+  ``reduce_scatter → jax.lax.psum_scatter``, ``all_to_all → jax.lax.all_to_all``,
+  ``send/recv → jax.lax.ppermute`` — all over **named mesh axes** instead of
+  NCCL communicators. A "process group" is a tuple of mesh axis names
+  (cf. SURVEY §2.4 mapping table).
+* Called **inside a traced context** (shard_map/jit), these lower to ICI/DCN
+  collectives in the compiled program — this is the hot path, used by ZeRO,
+  MoE, pipeline, ring attention.
+* Called **eagerly** they wrap themselves in a one-op ``shard_map`` over the
+  global mesh, so test code can exercise the API exactly like the reference's
+  ``tests/unit/comm/test_dist.py`` does (input carries the group axis as its
+  leading dimension, one shard per group member).
+* Multi-host bootstrap is ``jax.distributed.initialize()`` — the analogue of
+  the NCCL rendezvous in ``TorchBackend.init_process_group`` (torch.py:84).
+
+Every collective is wrapped by ``timed_op`` feeding the comms logger, matching
+comm.py:104's profiling decorator.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.parallel.topology import (ALL_AXES, DP_AXES, build_mesh)
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class ReduceOp:
+    """cf. reference comm/comm.py:33."""
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    AVG = "avg"
+    BAND = "band"
+    BOR = "bor"
+    BXOR = "bxor"
+    UNUSED = "unused"
+
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+class CommGroup:
+    """A communication group = subset of mesh axis names (+ the mesh)."""
+
+    def __init__(self, mesh: Mesh, axes: AxisName):
+        self.mesh = mesh
+        self.axes: Tuple[str, ...] = (axes,) if isinstance(axes, str) else tuple(axes)
+        for a in self.axes:
+            if a not in mesh.axis_names:
+                raise ValueError(f"axis {a} not in mesh axes {mesh.axis_names}")
+
+    @property
+    def size(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self.axes]))
+
+    def __repr__(self):
+        return f"CommGroup(axes={self.axes}, size={self.size})"
+
+
+class XCCLBackend:
+    """Global backend state (the reference's ``cdb``, comm.py:53)."""
+
+    def __init__(self, mesh: Mesh):
+        self.name = "xccl"
+        self.mesh = mesh
+        self.initialized = True
+        self.world_group = CommGroup(mesh, tuple(mesh.axis_names))
+
+    def group(self, axes: Optional[AxisName]) -> CommGroup:
+        if axes is None:
+            return self.world_group
+        if isinstance(axes, CommGroup):
+            return axes
+        return CommGroup(self.mesh, axes)
+
+
+cdb: Optional[XCCLBackend] = None
+comms_logger = None  # installed by configure()
+
+
+def is_initialized() -> bool:
+    return cdb is not None
+
+
+def init_distributed(dist_backend: str = "xccl",
+                     auto_mpi_discovery: bool = True,
+                     distributed_port: int = 29500,
+                     verbose: bool = True,
+                     timeout=None,
+                     init_method: Optional[str] = None,
+                     dist_init_required: Optional[bool] = None,
+                     config=None,
+                     rank: int = -1,
+                     world_size: int = -1,
+                     mesh: Optional[Mesh] = None,
+                     mesh_config=None) -> XCCLBackend:
+    """Bootstrap multi-host JAX (if needed) and install the global mesh backend.
+
+    Mirrors reference init_distributed (comm/comm.py:562): idempotent; discovers
+    coordinator from env (JAX_COORDINATOR_ADDRESS / MASTER_ADDR like the
+    launcher sets). Single-process single-host needs no rendezvous at all.
+    """
+    global cdb
+    if cdb is not None and mesh is None:
+        return cdb
+
+    if jax.process_count() == 1 and (os.environ.get("DSTPU_NUM_PROCESSES") or
+                                     os.environ.get("COORDINATOR_ADDRESS") or
+                                     os.environ.get("JAX_COORDINATOR_ADDRESS")):
+        coord = (os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
+                 or f"{os.environ.get('MASTER_ADDR', 'localhost')}:{distributed_port}")
+        nproc = int(os.environ.get("DSTPU_NUM_PROCESSES", world_size if world_size > 0 else 1))
+        pid = int(os.environ.get("DSTPU_PROCESS_ID", rank if rank >= 0 else 0))
+        try:
+            jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+            if verbose:
+                log_dist(f"jax.distributed initialized: {nproc} processes via {coord}", ranks=[0])
+        except Exception as e:  # already initialized or single-host
+            logger.warning(f"jax.distributed.initialize skipped: {e}")
+
+    if mesh is None:
+        mesh = build_mesh(mesh_config=mesh_config)
+    cdb = XCCLBackend(mesh)
+    if verbose:
+        log_dist(f"xccl backend ready: mesh={dict(mesh.shape)} on {get_accelerator().device_kind()}", ranks=[0])
+    return cdb
+
+
+def get_mesh() -> Mesh:
+    assert cdb is not None, "deepspeed_tpu.comm not initialized — call init_distributed()"
+    return cdb.mesh
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global cdb
+    cdb = XCCLBackend(mesh)
+
+
+def get_rank(group=None) -> int:
+    """Process rank (multi-host). Device-level position comes from the mesh."""
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if cdb is not None and group is not None:
+        return cdb.group(group).size
+    return jax.device_count()
+
+
+def get_local_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_group() -> Optional[CommGroup]:
+    return cdb.world_group if cdb else None
+
+
+def new_group(axes: AxisName) -> CommGroup:
+    """Groups are declared by mesh axis name, not rank list — rank-list groups
+    are a NCCL-ism; on TPU all group structure lives in the mesh."""
+    assert cdb is not None
+    return cdb.group(axes)
+
+
+# --------------------------------------------------------------------------- #
+# comms logging (reference utils/comms_logging.py + timed_op comm.py:104)
+# --------------------------------------------------------------------------- #
+class CommsLogger:
+    def __init__(self, verbose=False, debug=False, prof_all=True, prof_ops=None):
+        self.verbose = verbose
+        self.debug = debug
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.comms_dict = {}
+
+    def append(self, raw_name, record_name, latency, msg_size):
+        entry = self.comms_dict.setdefault(raw_name, {})
+        sizes = entry.setdefault(msg_size, [0, [], [], []])
+        n = sizes[0] + 1
+        sizes[0] = n
+        sizes[1].append(latency)
+        # algo bandwidth GB/s; bus bw left to log analysis
+        if latency > 0:
+            sizes[2].append(msg_size / latency / 1e9)
+        if self.verbose:
+            log_dist(f"comm op: {record_name} | msg size: {msg_size} | latency(ms): {latency*1000:.2f}", ranks=[0])
+
+    def log_all(self, print_log=True, show_straggler=False):
+        lines = ["Comms summary:"]
+        for op, per_size in self.comms_dict.items():
+            for size, (count, lats, bws, _) in sorted(per_size.items()):
+                avg_lat = sum(lats) / max(1, len(lats))
+                avg_bw = sum(bws) / max(1, len(bws)) if bws else 0.0
+                lines.append(f"  {op:26s} size={size:>12d} count={count:>6d} "
+                             f"avg_lat={avg_lat*1e3:8.3f}ms algo_bw={avg_bw:8.2f}GB/s")
+        if print_log:
+            log_dist("\n".join(lines), ranks=[0])
+        return self.comms_dict
+
+
+def configure(deepspeed_config=None, enabled=None, prof_all=None, prof_ops=None, verbose=None, debug=None):
+    global comms_logger
+    cc = deepspeed_config.comms_config if deepspeed_config is not None else None
+    enabled = enabled if enabled is not None else (cc.enabled if cc else False)
+    if enabled:
+        comms_logger = CommsLogger(
+            verbose=verbose if verbose is not None else (cc.verbose if cc else False),
+            debug=debug if debug is not None else (cc.debug if cc else False),
+            prof_all=prof_all if prof_all is not None else (cc.prof_all if cc else True),
+            prof_ops=prof_ops if prof_ops is not None else (cc.prof_ops if cc else []),
+        )
+
+
+def _nbytes(x) -> int:
+    try:
+        return int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def timed_op(func):
+    @functools.wraps(func)
+    def wrapper(tensor, *args, **kwargs):
+        if comms_logger is None or isinstance(tensor, jax.core.Tracer):
+            return func(tensor, *args, **kwargs)
+        t0 = time.time()
+        result = func(tensor, *args, **kwargs)
+        jax.block_until_ready(result)
+        comms_logger.append(func.__name__, kwargs.get("log_name", func.__name__),
+                            time.time() - t0, _nbytes(tensor))
+        return result
+
+    return wrapper
+
+
+# --------------------------------------------------------------------------- #
+# collectives
+# --------------------------------------------------------------------------- #
+def _axes(group) -> Tuple[str, ...]:
+    if group is None:
+        if cdb is not None:
+            return tuple(cdb.mesh.axis_names)
+        raise RuntimeError("comm not initialized and no group given")
+    if isinstance(group, CommGroup):
+        return group.axes
+    return (group,) if isinstance(group, str) else tuple(group)
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _eager_shard_map(fn, group, x, extra_leading_out: bool = False):
+    """Run a one-collective shard_map over the mesh for eager API usage.
+
+    Convention (documented in the module docstring): the input's leading dim
+    enumerates the group members, i.e. shape (group_size, ...). We shard that
+    dim over the group axes, apply the collective, and return the result with
+    the same convention.
+    """
+    mesh = get_mesh()
+    axes = _axes(group)
+    spec = P(axes)
+    in_spec = P(axes, *([None] * (x.ndim - 1)))
+    out_first = axes if extra_leading_out else None
+    shard_fn = jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
+                             out_specs=P(out_first, *([None] * (x.ndim - 1))))
+    return jax.jit(shard_fn)(x)
+
+
+_REDUCERS_TRACED = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.MAX: lax.pmax,
+    ReduceOp.MIN: lax.pmin,
+    ReduceOp.AVG: lambda x, ax: lax.pmean(x, ax),
+}
+
+
+@timed_op
+def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, async_op: bool = False, log_name="all_reduce"):
+    """SUM/MAX/MIN/AVG across the group axes.
+
+    Traced: ``lax.psum(x, axes)`` — the hot path inside shard_map.
+    Eager: leading dim is the group dim; every member's slot gets the reduction.
+    """
+    axes = _axes(group)
+
+    def _product(x):
+        # sign-safe product: psum of log|x| for magnitude, psum of sign
+        # parity for sign; exact zeros propagate as zeros.
+        mag = jnp.exp(lax.psum(jnp.log(jnp.abs(x) + jnp.where(x == 0, 1.0, 0.0)), axes))
+        neg = lax.psum(jnp.where(x < 0, 1.0, 0.0), axes)
+        has_zero = lax.pmax(jnp.where(x == 0, 1.0, 0.0), axes)
+        sign = jnp.where(jnp.mod(neg, 2.0) == 1.0, -1.0, 1.0)
+        return jnp.where(has_zero == 1.0, 0.0, sign * mag)
+
+    if _in_trace(tensor):
+        if op == ReduceOp.PRODUCT:
+            return _product(tensor)
+        return _REDUCERS_TRACED[op](tensor, axes)
+
+    def _k(x):
+        x = jnp.squeeze(x, 0)
+        if op == ReduceOp.PRODUCT:
+            r = _product(x)
+        else:
+            r = _REDUCERS_TRACED[op](x, axes)
+        return r[None]
+
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+
+
+@timed_op
+def inference_all_reduce(tensor, op=ReduceOp.SUM, group=None, log_name="inference_all_reduce"):
+    return all_reduce(tensor, op=op, group=group)
+
+
+@timed_op
+def all_gather(tensor, group=None, axis: int = 0, tiled: bool = False, log_name="all_gather"):
+    """Traced: lax.all_gather over group axes (concatenated along ``axis``)."""
+    axes = _axes(group)
+    if _in_trace(tensor):
+        return lax.all_gather(tensor, axes, axis=axis, tiled=tiled)
+    def _k(x):
+        return lax.all_gather(jnp.squeeze(x, 0), axes, axis=0, tiled=False)[None]
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+
+
+def all_gather_into_tensor(output_unused, tensor, group=None):
+    """Reference signature parity (comm/torch.py:123); output arg is ignored
+    because JAX is functional — the gathered array is returned."""
+    return all_gather(tensor, group=group, tiled=True)
+
+
+@timed_op
+def reduce_scatter(tensor, group=None, op=ReduceOp.SUM, scatter_dimension: int = 0,
+                   tiled: bool = True, log_name="reduce_scatter"):
+    """Traced: lax.psum_scatter. Eager: leading-dim group convention."""
+    axes = _axes(group)
+    if _in_trace(tensor):
+        return lax.psum_scatter(tensor, axes, scatter_dimension=scatter_dimension, tiled=tiled)
+    def _k(x):
+        return lax.psum_scatter(jnp.squeeze(x, 0), axes, scatter_dimension=0, tiled=True)[None]
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+
+
+def reduce_scatter_tensor(output_unused, tensor, op=ReduceOp.SUM, group=None):
+    return reduce_scatter(tensor, group=group, op=op)
+
+
+@timed_op
+def all_to_all_single(tensor, group=None, split_axis: int = 0, concat_axis: int = 0,
+                      log_name="all_to_all_single"):
+    """Traced: lax.all_to_all (the MoE dispatch primitive, cf. sharded_moe.py:90)."""
+    axes = _axes(group)
+    if _in_trace(tensor):
+        return lax.all_to_all(tensor, axes, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+    def _k(x):
+        return lax.all_to_all(jnp.squeeze(x, 0), axes, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)[None]
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+
+
+all_to_all = all_to_all_single
+
+
+@timed_op
+def broadcast(tensor, src: int = 0, group=None, async_op: bool = False, log_name="broadcast"):
+    """Traced: every member takes src's value (ppermute-free: psum of masked)."""
+    axes = _axes(group)
+    if _in_trace(tensor):
+        idx = lax.axis_index(axes if len(axes) > 1 else axes[0])
+        contrib = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+        return lax.psum(contrib, axes)
+    def _k(x):
+        x = jnp.squeeze(x, 0)
+        idx = lax.axis_index(axes if len(axes) > 1 else axes[0])
+        contrib = jnp.where(idx == src, x, jnp.zeros_like(x))
+        return lax.psum(contrib, axes)[None]
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+
+
+def ppermute(tensor, perm, group=None):
+    """Point-to-point collective permute — the TPU-native send/recv
+    (reference pipe/p2p.py send:50/recv:71 become one fused ppermute over ICI)."""
+    axes = _axes(group)
+    axis = axes[0] if len(axes) == 1 else axes
+    return lax.ppermute(tensor, axis, perm)
+
+
+def send(tensor, dst: int, group=None, tag: int = 0):
+    raise NotImplementedError(
+        "xccl has no eager point-to-point send; use comm.ppermute inside a "
+        "shard_map (pipeline p2p does this — see deepspeed_tpu.runtime.pipe.p2p)")
+
+
+def recv(tensor, src: int, group=None, tag: int = 0):
+    raise NotImplementedError(
+        "xccl has no eager point-to-point recv; use comm.ppermute inside a shard_map")
+
+
+def barrier(group=None, log_name="barrier"):
+    """Cross-process sync point. In-trace it's a no-op (XLA orders ops)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(log_name)
+    else:
+        jax.effects_barrier()
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    barrier(group)
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None):
+    """Rooted reduce has no ICI advantage on TPU — lower to all_reduce, callers
+    read their slot (same trick the reference uses in reverse for bcast)."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def gather(tensor, dst: int = 0, group=None):
+    return all_gather(tensor, group=group)
+
+
+def scatter(tensor, src: int = 0, group=None):
+    raise NotImplementedError("use sharding constraints / device_put for scatter on TPU")
+
+
+def all_gather_coalesced(tensors, group=None):
+    """Gather a list of arrays with one fused program (reference torch.py:135)."""
+    axes = _axes(group)
+    if tensors and _in_trace(tensors[0]):
+        return [lax.all_gather(t, axes, tiled=True) for t in tensors]
+    return [all_gather(t, group=group) for t in tensors]
+
+
+def all_reduce_coalesced(tensors, op=ReduceOp.SUM, group=None):
+    if tensors and _in_trace(tensors[0]):
+        axes = _axes(group)
+        return list(lax.psum(tuple(tensors), axes))
+    return [all_reduce(t, op=op, group=group) for t in tensors]
+
+
+# ------------------------------------------------------------------ host-side
+def broadcast_object_list(obj_list, src=0, group=None):
+    """Cross-process python-object broadcast (reference send_obj/recv_obj
+    pickle path, pipe/p2p.py:100). Uses multihost broadcast of host bytes."""
+    if jax.process_count() == 1:
+        return obj_list
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(obj_list)
+    arr = np.frombuffer(payload, dtype=np.uint8)
+    n = multihost_utils.broadcast_one_to_all(np.array([arr.size], dtype=np.int64))
+    buf = np.zeros(int(n[0]), dtype=np.uint8)
+    if jax.process_index() == src:
+        buf[: arr.size] = arr
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return pickle.loads(out.tobytes())
+
+
+def log_summary(show_straggler=False):
+    if comms_logger is not None:
+        return comms_logger.log_all(show_straggler=show_straggler)
+
+
+def get_global_rank(group=None, group_rank: int = 0) -> int:
+    return group_rank
+
+
+def destroy_process_group(group=None):
+    global cdb
+    cdb = None

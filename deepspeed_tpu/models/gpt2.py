@@ -1,0 +1,250 @@
+"""GPT-2 family decoder — the flagship training model.
+
+The reference trains GPT-2/Megatron-GPT via external model code (DeepSpeed
+wraps it; cf. tests/model/Megatron_GPT2, BASELINE configs "GPT-2 125M/1.3B").
+Here the model is in-tree and TPU-shaped:
+
+* layer-stacked parameters scanned with ``lax.scan`` → O(1) compile time in
+  depth, XLA pipelines the layer loop;
+* Megatron-style tensor-parallel PartitionSpecs on qkv/proj/mlp (column then
+  row) so TP is pure sharding metadata — GSPMD inserts the per-layer psum the
+  reference does by hand in LinearAllreduce (module_inject/layers.py:15);
+* bf16 compute, fp32 logits/loss; optional remat (activation checkpointing,
+  reference activation_checkpointing/checkpointing.py role);
+* attention pluggable: XLA einsum path or the Pallas flash kernel
+  (deepspeed_tpu.ops.pallas.flash_attention).
+
+Sizes follow the GPT-2/GPT-3 ladder used in DeepSpeed docs and tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash_attention: bool = True
+    tie_embeddings: bool = True
+    # sequence-parallel: shard activations over the 'seq' axis (ring attention)
+    sequence_parallel: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    def num_params(self) -> int:
+        d, l, v, t = self.n_embd, self.n_layer, self.vocab_size, self.n_positions
+        per_layer = 12 * d * d + 13 * d
+        return v * d + t * d + l * per_layer + 2 * d
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Forward+backward model FLOPs per token: 6N + 12·l·d·s — the
+        Megatron-paper accounting the reference community uses for its TFLOPS
+        numbers (SURVEY §6; docs/_posts/2022-07-26-deepspeed-azure.md:90).
+        Remat recompute is intentionally NOT counted (model flops, not
+        hardware flops)."""
+        s = seq_len or self.n_positions
+        return 6 * self.num_params() + 12 * self.n_layer * self.n_embd * s
+
+
+PRESETS = {
+    "gpt2-tiny": GPT2Config(vocab_size=2048, n_positions=256, n_embd=128, n_layer=2, n_head=4),
+    "gpt2-125m": GPT2Config(n_embd=768, n_layer=12, n_head=12),
+    "gpt2-350m": GPT2Config(n_embd=1024, n_layer=24, n_head=16),
+    "gpt2-760m": GPT2Config(n_embd=1536, n_layer=24, n_head=16),
+    "gpt2-1.3b": GPT2Config(n_embd=2048, n_layer=24, n_head=16, n_positions=2048),
+    "gpt2-xl": GPT2Config(n_embd=1600, n_layer=48, n_head=25, n_positions=1024),
+    "gpt2-2.7b": GPT2Config(n_embd=2560, n_layer=32, n_head=32, n_positions=2048),
+    "gpt2-6.7b": GPT2Config(n_embd=4096, n_layer=32, n_head=32, n_positions=2048),
+}
+
+
+def _init_linear(key, fan_in, shape, scale=None):
+    scale = scale if scale is not None else 1.0 / math.sqrt(fan_in)
+    return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+
+class GPT2Model:
+    """Functional GPT-2: params are a dict with stacked per-layer leaves."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+
+    # ---------------------------------------------------------------- params
+    def init_params(self, rng) -> Dict[str, Any]:
+        c = self.config
+        d, l = c.n_embd, c.n_layer
+        keys = jax.random.split(rng, 10)
+        proj_scale = 0.02 / math.sqrt(2 * l)  # GPT-2 residual-scaled init
+        params = {
+            "wte": jax.random.normal(keys[0], (c.vocab_size, d), jnp.float32) * 0.02,
+            "wpe": jax.random.normal(keys[1], (c.n_positions, d), jnp.float32) * 0.01,
+            "blocks": {
+                "ln1_g": jnp.ones((l, d), jnp.float32),
+                "ln1_b": jnp.zeros((l, d), jnp.float32),
+                "qkv_w": _init_linear(keys[2], d, (l, d, 3 * d), 0.02),
+                "qkv_b": jnp.zeros((l, 3 * d), jnp.float32),
+                "proj_w": _init_linear(keys[3], d, (l, d, d), proj_scale),
+                "proj_b": jnp.zeros((l, d), jnp.float32),
+                "ln2_g": jnp.ones((l, d), jnp.float32),
+                "ln2_b": jnp.zeros((l, d), jnp.float32),
+                "fc_w": _init_linear(keys[4], d, (l, d, 4 * d), 0.02),
+                "fc_b": jnp.zeros((l, 4 * d), jnp.float32),
+                "fc2_w": _init_linear(keys[5], 4 * d, (l, 4 * d, d), proj_scale),
+                "fc2_b": jnp.zeros((l, d), jnp.float32),
+            },
+            "lnf_g": jnp.ones((d,), jnp.float32),
+            "lnf_b": jnp.zeros((d,), jnp.float32),
+        }
+        if not c.tie_embeddings:
+            params["lm_head"] = jax.random.normal(keys[6], (d, c.vocab_size), jnp.float32) * 0.02
+        return params
+
+    def param_partition_specs(self) -> Dict[str, Any]:
+        """Megatron TP layout over the 'tensor' mesh axis. Leading layer dim of
+        stacked block params is never sharded (it's the scan axis)."""
+        specs = {
+            "wte": P("tensor", None),          # vocab-sharded embedding
+            "wpe": P(None, None),
+            "blocks": {
+                "ln1_g": P(None, None), "ln1_b": P(None, None),
+                "qkv_w": P(None, None, "tensor"),   # column parallel
+                "qkv_b": P(None, "tensor"),
+                "proj_w": P(None, "tensor", None),  # row parallel
+                "proj_b": P(None, None),
+                "ln2_g": P(None, None), "ln2_b": P(None, None),
+                "fc_w": P(None, None, "tensor"),
+                "fc_b": P(None, "tensor"),
+                "fc2_w": P(None, "tensor", None),
+                "fc2_b": P(None, None),
+            },
+            "lnf_g": P(None), "lnf_b": P(None),
+        }
+        if not self.config.tie_embeddings:
+            specs["lm_head"] = P(None, "tensor")
+        return specs
+
+    # --------------------------------------------------------------- compute
+    @staticmethod
+    def _layer_norm(x, g, b, eps=1e-5):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+        return (y * g + b).astype(x.dtype)
+
+    _warned_flash_fallback = False
+
+    def _attention(self, q, k, v):
+        """q,k,v: (B, T, H, Dh). Causal self-attention."""
+        c = self.config
+        if c.use_flash_attention:
+            try:
+                from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+                return flash_attention(q, k, v, causal=True)
+            except Exception as e:
+                if not GPT2Model._warned_flash_fallback:
+                    GPT2Model._warned_flash_fallback = True
+                    from deepspeed_tpu.utils.logging import logger
+
+                    logger.warning(f"flash attention unavailable ({e}); using XLA einsum attention")
+        scale = 1.0 / math.sqrt(c.head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    def _dropout(self, x, rng):
+        p = self.config.dropout
+        if p == 0.0 or rng is None:
+            return x
+        keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+
+    def _block(self, x, blk, rng):
+        c = self.config
+        B, T, D = x.shape
+        dk = (lambda i: jax.random.fold_in(rng, i)) if rng is not None else (lambda i: None)
+        h = self._layer_norm(x, blk["ln1_g"], blk["ln1_b"])
+        qkv = h @ blk["qkv_w"].astype(h.dtype) + blk["qkv_b"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, T, c.n_head, c.head_dim)
+        attn = self._attention(to_heads(q), to_heads(k), to_heads(v))
+        attn = attn.reshape(B, T, D)
+        attn = self._dropout(attn @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype), dk(0))
+        x = x + attn
+        h = self._layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+        h = h @ blk["fc_w"].astype(h.dtype) + blk["fc_b"].astype(h.dtype)
+        h = jax.nn.gelu(h)
+        h = self._dropout(h @ blk["fc2_w"].astype(x.dtype) + blk["fc2_b"].astype(x.dtype), dk(1))
+        x = x + h
+        return x
+
+    def apply(self, params, input_ids, rng=None):
+        """input_ids (B, T) int32 → logits (B, T, V) fp32."""
+        c = self.config
+        B, T = input_ids.shape
+        x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[:T]
+        if rng is not None and c.dropout > 0.0:
+            rng, emb_key = jax.random.split(rng)
+            x = self._dropout(x, emb_key)
+
+        block_fn = self._block
+        if c.remat:
+            block_fn = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+        layer_rngs = jax.random.split(rng, c.n_layer) if (rng is not None and c.dropout > 0.0) else None
+
+        def scan_body(carry, xs):
+            blk, lrng = xs
+            x = block_fn(carry, blk, lrng)
+            return x, None
+
+        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+        x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
+        head = params["wte"].T if c.tie_embeddings else params["lm_head"]
+        logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+        return logits
+
+    def loss(self, params, batch, rng=None):
+        """batch: dict with input_ids (B,T) [+ optional labels/loss_mask] or a
+        bare (B,T) array — next-token cross entropy."""
+        if isinstance(batch, dict):
+            ids = batch["input_ids"]
+            labels = batch.get("labels", ids)
+            mask = batch.get("loss_mask")
+        else:
+            ids, labels, mask = batch, batch, None
+        logits = self.apply(params, ids, rng)[:, :-1]
+        targets = labels[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        if mask is not None:
+            m = mask[:, 1:].astype(jnp.float32)
+            return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.mean(nll)
+
+
+def synthetic_lm_batch(batch_size: int, seq_len: int, vocab_size: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab_size, size=(batch_size, seq_len), dtype=np.int32)}

@@ -1,0 +1,71 @@
+"""Tiny synthetic models for tests.
+
+Counterpart of the reference's test fixtures (tests/unit/simple_model.py:18
+SimpleModel — a Linear stack; :71 SimpleMoEModel; :37 SimpleFrozenModel). Pure
+functional: init_params(rng) + loss(params, batch, rng).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class SimpleModel:
+    """Linear → relu stack with an MSE head. batch = (x, y)."""
+
+    def __init__(self, hidden_dim: int = 16, nlayers: int = 2, empty_grad: bool = False):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+        self.empty_grad = empty_grad
+
+    def init_params(self, rng):
+        keys = jax.random.split(rng, self.nlayers + 1)
+        layers = []
+        for i in range(self.nlayers):
+            w = jax.random.normal(keys[i], (self.hidden_dim, self.hidden_dim), jnp.float32) * 0.1
+            b = jnp.zeros((self.hidden_dim,), jnp.float32)
+            layers.append({"w": w, "b": b})
+        return {"layers": layers}
+
+    def apply(self, params, x):
+        h = x
+        for i, lyr in enumerate(params["layers"]):
+            h = h @ lyr["w"].astype(h.dtype) + lyr["b"].astype(h.dtype)
+            if i < self.nlayers - 1:
+                h = jax.nn.relu(h)
+        return h
+
+    def loss(self, params, batch, rng=None):
+        x, y = batch
+        pred = self.apply(params, x)
+        return jnp.mean(jnp.square(pred - y.astype(pred.dtype))).astype(jnp.float32)
+
+    def param_partition_specs(self):
+        return {"layers": [{"w": P(), "b": P()} for _ in range(self.nlayers)]}
+
+
+class SimpleTPModel(SimpleModel):
+    """Same stack but Megatron-style column/row sharded over the tensor axis."""
+
+    def param_partition_specs(self):
+        specs = []
+        for i in range(self.nlayers):
+            if i % 2 == 0:  # column parallel
+                specs.append({"w": P(None, "tensor"), "b": P("tensor")})
+            else:  # row parallel
+                specs.append({"w": P("tensor", None), "b": P()})
+        return {"layers": specs}
+
+
+def random_dataset(n_samples: int, hidden_dim: int, seed: int = 0):
+    """Host-side (x, y) sample list — reference random_dataloader analogue."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n_samples, hidden_dim)).astype("float32")
+    ys = rng.normal(size=(n_samples, hidden_dim)).astype("float32")
+    return [(xs[i], ys[i]) for i in range(n_samples)]

@@ -1,0 +1,113 @@
+"""Experiment monitoring: TensorBoard / W&B / CSV event fan-out.
+
+Counterpart of the reference's ``deepspeed/monitor/monitor.py`` (MonitorMaster
+:29 fans out write_events on rank 0 to the enabled writers). Events are
+``(tag, value, step)`` tuples, same contract as the reference's engine calls
+(engine.py:1826-1834, _write_monitor:2136).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor:
+    def __init__(self, config):
+        self.monitor_config = config
+
+    def write_events(self, event_list: List[Tuple]):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = config.enabled and jax.process_index() == 0
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                path = os.path.join(config.output_path or "./runs", config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=path)
+            except Exception as e:
+                logger.warning(f"TensorBoard writer unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for event in event_list:
+            self.summary_writer.add_scalar(*event)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = config.enabled and jax.process_index() == 0
+        if self.enabled:
+            try:
+                import wandb
+
+                wandb.init(project=config.project, group=config.group, entity=config.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: value}, step=int(step))
+
+
+class csvMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = config.enabled and jax.process_index() == 0
+        self.filenames = {}
+        if self.enabled:
+            self.log_dir = os.path.join(config.output_path or "./csv_logs", config.job_name)
+            os.makedirs(self.log_dir, exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            fname = os.path.join(self.log_dir, tag.replace("/", "_") + ".csv")
+            new = fname not in self.filenames and not os.path.exists(fname)
+            self.filenames[fname] = True
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([int(step), float(value)])
+
+
+class MonitorMaster(Monitor):
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+        self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.csv_monitor.enabled)
+
+    def write_events(self, event_list):
+        if jax.process_index() != 0 or not self.enabled:
+            return
+        if self.tb_monitor.enabled:
+            self.tb_monitor.write_events(event_list)
+        if self.wandb_monitor.enabled:
+            self.wandb_monitor.write_events(event_list)
+        if self.csv_monitor.enabled:
+            self.csv_monitor.write_events(event_list)

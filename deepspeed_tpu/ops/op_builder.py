@@ -1,0 +1,112 @@
+"""Kernel/op registry — the op_builder successor.
+
+The reference's ``op_builder/`` (1.4k LoC) exists to JIT-compile CUDA/C++
+extensions per-op (builder.py:442 OpBuilder.load). On TPU, device kernels are
+Pallas (JIT-compiled by XLA — no build step), so the registry's job shrinks to:
+(a) name → python kernel module resolution, (b) building the one genuinely
+native component, the async-IO C extension for NVMe/host offload
+(csrc/aio equivalent), via setuptools/cc at first use.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class OpBuilder:
+    NAME = "base"
+
+    def __init__(self):
+        self.loaded = None
+
+    def absolute_name(self) -> str:
+        raise NotImplementedError
+
+    def is_compatible(self) -> bool:
+        return True
+
+    def load(self):
+        if self.loaded is None:
+            self.loaded = importlib.import_module(self.absolute_name())
+        return self.loaded
+
+
+class PallasKernelBuilder(OpBuilder):
+    """Python/Pallas-backed op — load() just imports the module."""
+
+    MODULE = None
+
+    def absolute_name(self):
+        return self.MODULE
+
+
+class FlashAttentionBuilder(PallasKernelBuilder):
+    NAME = "flash_attn"
+    MODULE = "deepspeed_tpu.ops.pallas.flash_attention"
+
+
+class FusedAdamBuilder(PallasKernelBuilder):
+    NAME = "fused_adam"
+    MODULE = "deepspeed_tpu.ops.optimizers"
+
+
+class FusedLambBuilder(PallasKernelBuilder):
+    NAME = "fused_lamb"
+    MODULE = "deepspeed_tpu.ops.optimizers"
+
+
+class CPUAdamBuilder(PallasKernelBuilder):
+    NAME = "cpu_adam"
+    MODULE = "deepspeed_tpu.ops.optimizers"
+
+
+class QuantizerBuilder(PallasKernelBuilder):
+    NAME = "quantizer"
+    MODULE = "deepspeed_tpu.ops.quantizer"
+
+
+class TransformerBuilder(PallasKernelBuilder):
+    NAME = "transformer"
+    MODULE = "deepspeed_tpu.models.gpt2"
+
+
+class InferenceBuilder(PallasKernelBuilder):
+    NAME = "transformer_inference"
+    MODULE = "deepspeed_tpu.inference.kernels"
+
+
+class SparseAttnBuilder(PallasKernelBuilder):
+    NAME = "sparse_attn"
+    MODULE = "deepspeed_tpu.ops.pallas.block_sparse_attention"
+
+
+class AsyncIOBuilder(OpBuilder):
+    """The one native build: C async-file-IO for ZeRO-Infinity offload
+    (csrc/aio equivalent). Built lazily with cc; see ops/aio/."""
+
+    NAME = "async_io"
+
+    def absolute_name(self):
+        return "deepspeed_tpu.ops.aio"
+
+    def is_compatible(self) -> bool:
+        try:
+            self.load()
+            return True
+        except Exception as e:
+            logger.warning(f"async_io unavailable: {e}")
+            return False
+
+
+ALL_OPS = {
+    b.NAME: b for b in (FlashAttentionBuilder, FusedAdamBuilder, FusedLambBuilder,
+                        CPUAdamBuilder, QuantizerBuilder, TransformerBuilder,
+                        InferenceBuilder, SparseAttnBuilder, AsyncIOBuilder)
+}
+
+
+def get_builder_class(op_name: str) -> Optional[type]:
+    return ALL_OPS.get(op_name)

@@ -1,0 +1,99 @@
+"""Config plumbing shared by every subsystem.
+
+Counterpart of the reference's ``deepspeed/runtime/config_utils.py`` (205 LoC):
+a pydantic base model with strict extra-field checking and deprecated-field
+aliasing, plus dict helpers. Written against pydantic v2.
+"""
+
+from __future__ import annotations
+
+import collections.abc
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all ds_config sub-models.
+
+    Supports marking fields deprecated via ``json_schema_extra``:
+
+        my_field: int = Field(0, json_schema_extra={
+            "deprecated": True, "new_param": "better_field"})
+
+    On init, a value passed to a deprecated field is copied to ``new_param``
+    (unless the new param was also set) and a warning is logged — same
+    behavior as the reference's _process_deprecated_field.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="forbid",
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict: bool = False, **data):
+        if not strict:
+            # "auto" / None mean "use the default" in ds_config files
+            data = {k: v for k, v in data.items() if v is not None and v != "auto"}
+        super().__init__(**data)
+        self._deprecated_fields_check()
+
+    def _deprecated_fields_check(self):
+        for name, field in type(self).model_fields.items():
+            extra = field.json_schema_extra
+            if isinstance(extra, dict) and extra.get("deprecated", False):
+                self._process_deprecated_field(name, extra)
+
+    def _process_deprecated_field(self, dep_name: str, extra: Dict[str, Any]):
+        if dep_name not in self.model_fields_set:
+            return
+        new_param = extra.get("new_param", "")
+        dep_msg = extra.get("deprecated_msg", "")
+        logger.warning(f"Config parameter {dep_name} is deprecated. {dep_msg} " +
+                       (f"Use {new_param} instead." if new_param else ""))
+        if new_param and extra.get("set_new_param", True):
+            if new_param in self.model_fields_set:
+                raise ValueError(f"Cannot provide deprecated parameter '{dep_name}' and its replacement "
+                                 f"'{new_param}' together")
+            try:
+                value = extra.get("new_param_fn", lambda x: x)(getattr(self, dep_name))
+                setattr(self, new_param, value)
+            except Exception as e:
+                logger.error(f"Tried setting value for '{new_param}' with value from deprecated '{dep_name}'")
+                raise e
+
+    def get(self, key, default=None):
+        return getattr(self, key, default)
+
+
+def get_scalar_param(param_dict: dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict: dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """json.load object_pairs_hook that rejects duplicate keys."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = collections.Counter([pair[0] for pair in ordered_pairs])
+        keys = [key for key, value in counter.items() if value > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d
+
+
+class ScientificNotationEncoder:
+    """Placeholder for parity; jnp handles floats natively."""

@@ -1,0 +1,89 @@
+"""Data loading helpers.
+
+Counterpart of the reference's ``deepspeed/runtime/dataloader.py`` (162 LoC:
+DeepSpeedDataLoader wires a DistributedSampler + RepeatingLoader). On TPU with
+a single controller, "distributed sampling" means: every process loads its own
+shard of the global batch; here (single-process case) the loader yields global
+numpy batches and the engine shards them over the mesh's data axes on
+device_put. Works with torch Datasets, numpy arrays, or any indexable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def _default_collate(samples):
+    """Stack a list of samples (dicts/tuples/arrays) into one numpy batch."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: _default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(_default_collate([s[i] for s in samples]) for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset; each process yields its local share.
+
+    Multi-host: process p takes samples with index % num_processes == p of each
+    global batch (equivalent of DistributedSampler's rank stride).
+    """
+
+    def __init__(self, dataset, batch_size: int, collate_fn: Optional[Callable] = None,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+                 num_local_io_workers: int = 0, data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.data_sampler = data_sampler
+        self.len = len(dataset) // self.batch_size if drop_last else \
+            -(-len(dataset) // self.batch_size)
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        nproc = jax.process_count()
+        pid = jax.process_index()
+        for b in range(self.len):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                return
+            if nproc > 1:
+                idx = idx[pid::nproc]
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])

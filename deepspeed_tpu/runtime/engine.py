@@ -1,0 +1,636 @@
+"""DeepSpeedEngine — the training engine.
+
+Counterpart of the reference's ``deepspeed/runtime/engine.py`` (DeepSpeedEngine
+:181, ~3.3k LoC god object). The torch engine wraps an nn.Module and mutates
+it through forward/backward/step with hook-driven communication. The TPU-native
+engine is functional: all training state (params, fp32 masters, optimizer
+state, loss-scale) lives in one ``TrainState`` pytree whose placement comes
+from the ZeRO ``ShardingPlan``; a single donated, jitted update advances it.
+The reference's three-call API (``forward`` engine.py:1663, ``backward`` :1804,
+``step`` :2000) is kept as shims over the same compiled pieces, and
+``train_batch(batch)`` is the fused fast path (grad-accumulation microbatches
+as a ``lax.scan``).
+
+What the reference does with streams/hooks, XLA does in the scheduler: ZeRO-3
+allgather-on-use + prefetch = GSPMD sharded params; overlapped reduce-scatter =
+grad sharding constraints; bucket sizes become advisory (SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.ops.optimizers import build_optimizer
+from deepspeed_tpu.parallel.topology import DATA_AXIS, EXPERT_AXIS, ParallelGrid, build_mesh
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.fp16.loss_scaler import (CreateLossScaler, DynamicLossScaler,
+                                                    LossScaleState, grads_finite)
+from deepspeed_tpu.runtime.lr_schedules import LRSchedule, build_lr_schedule
+from deepspeed_tpu.runtime.zero.partition import ShardingPlan, partition_report, plan_sharding
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, NoopTimer,
+                                       STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
+                                       ThroughputTimer, TRAIN_BATCH_TIMER)
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
+
+
+class TrainState(NamedTuple):
+    """Everything that changes during training, as one pytree."""
+    step: jnp.ndarray            # i32 global step
+    params: Any                  # compute-dtype params (what forward reads)
+    master: Any                  # fp32 master copy (None => params are master)
+    opt_state: Any
+    scaler: Any                  # LossScaleState or None
+    rng: jnp.ndarray             # PRNG key for dropout etc.
+    skipped_steps: jnp.ndarray   # i32
+
+
+class StepMetrics(NamedTuple):
+    loss: jnp.ndarray
+    grad_norm: jnp.ndarray
+    lr: jnp.ndarray
+    loss_scale: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+def _is_optax_like(opt) -> bool:
+    return hasattr(opt, "init") and hasattr(opt, "update")
+
+
+def _supports_lr_override(opt) -> bool:
+    try:
+        return "lr_override" in inspect.signature(opt.update).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+class DeepSpeedEngine:
+    def __init__(self,
+                 args=None,
+                 model=None,
+                 optimizer=None,
+                 model_parameters=None,
+                 training_data=None,
+                 lr_scheduler=None,
+                 mpu=None,
+                 dist_init_required=None,
+                 collate_fn=None,
+                 config=None,
+                 config_class: Optional[DeepSpeedConfig] = None,
+                 dont_change_device=False):
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.training_data = training_data
+        self.collate_fn = collate_fn
+
+        # ---- config ------------------------------------------------------
+        if config_class is None:
+            config_class = DeepSpeedConfig(config if config is not None else {})
+        self._config = config_class
+
+        # ---- distributed backend / mesh ---------------------------------
+        if mpu is not None and hasattr(mpu, "mesh"):
+            mesh = mpu.mesh
+            dist.init_distributed(mesh=mesh, verbose=False)
+        else:
+            backend = dist.init_distributed(mesh_config=self._config.mesh_config, verbose=False)
+            mesh = backend.mesh
+        self.mesh = mesh
+        self.grid = ParallelGrid(mesh)
+        self.dp_world_size = self.grid.get_data_parallel_world_size()
+        self.mp_world_size = self.grid.get_model_parallel_world_size()
+        self._config._configure_train_batch_size(self.dp_world_size)
+
+        # ---- model protocol ---------------------------------------------
+        # `model` provides init_params(rng) + loss(params, batch, rng) — the
+        # functional stand-in for the reference's nn.Module. Alternatively
+        # model_parameters carries an initial param pytree and `model` is a
+        # bare callable loss_fn(params, batch, rng).
+        self.module = model
+        if hasattr(model, "loss"):
+            self._loss_fn = model.loss
+        elif callable(model):
+            self._loss_fn = model
+        else:
+            raise ValueError("model must provide .loss(params, batch, rng) or be callable")
+
+        self.train_dtype = self._config.train_dtype
+        self.fp16_enabled = self._config.fp16.enabled
+        self.bf16_enabled = self._config.bf16.enabled
+        self.zero_stage = self._config.zero_optimization_stage
+
+        # ---- abstract shapes + sharding plan ----------------------------
+        seed_key = jax.random.PRNGKey(self._config.seed)
+        if model_parameters is not None:
+            param_shapes = jax.eval_shape(lambda: model_parameters)
+            init_fn = lambda: model_parameters
+        elif hasattr(model, "init_params"):
+            param_shapes = jax.eval_shape(model.init_params, seed_key)
+            init_fn = lambda: model.init_params(seed_key)
+        else:
+            raise ValueError("Provide model.init_params(rng) or model_parameters")
+
+        tp_specs = None
+        if hasattr(model, "param_partition_specs"):
+            tp_specs = model.param_partition_specs()
+        self.plan: ShardingPlan = plan_sharding(
+            param_shapes, mesh, zero_config=self._config.zero_config, tp_specs=tp_specs)
+        log_dist(partition_report(self.plan, param_shapes), ranks=[0])
+
+        # ---- optimizer ---------------------------------------------------
+        self.optimizer = self._configure_optimizer()
+        self._lr_supports_override = _supports_lr_override(self.optimizer)
+
+        # ---- lr schedule -------------------------------------------------
+        self.lr_scheduler = self._configure_lr_scheduler()
+
+        # ---- loss scaler -------------------------------------------------
+        dynamic = self._config.fp16.loss_scale == 0.0
+        self.loss_scaler = CreateLossScaler(
+            self.train_dtype, self._config.fp16.loss_scale, dynamic,
+            dynamic_loss_args={
+                "init_scale": 2.0 ** self._config.fp16.initial_scale_power,
+                "scale_window": self._config.fp16.loss_scale_window,
+                "min_scale": self._config.fp16.min_loss_scale,
+                "delayed_shift": self._config.fp16.hysteresis,
+                "consecutive_hysteresis": self._config.fp16.consecutive_hysteresis,
+            }) if self.fp16_enabled else None
+
+        # master-weight policy: fp32 master kept when computing in low precision
+        self._keep_master = (self.train_dtype != jnp.float32) and (
+            self.fp16_enabled or self._config.bf16.master_weights)
+
+        # ---- materialize state sharded ----------------------------------
+        self.state, self.state_shardings = self._init_state(init_fn, param_shapes, seed_key)
+
+        # ---- compiled steps ---------------------------------------------
+        self._compiled_train_batch = {}
+        self._compiled_fwd_bwd = None
+        self._compiled_apply = None
+        self._compiled_eval = None
+        self._grad_buffer = None
+        self._last_metrics: Optional[StepMetrics] = None
+        self.micro_steps = 0
+        self.global_samples = 0
+        self.gradient_accumulation_steps = lambda: self._config.gradient_accumulation_steps
+
+        # ---- telemetry ---------------------------------------------------
+        self.wall_clock_breakdown = self._config.wall_clock_breakdown
+        self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
+        self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size(),
+                                          steps_per_output=self._config.steps_per_print)
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+        self.monitor = MonitorMaster(self._config.monitor_config)
+        dist.configure(self._config)
+
+        self.dataloader = None
+        if training_data is not None:
+            self.dataloader = self.deepspeed_io(training_data)
+
+        log_dist(f"engine ready: dtype={jnp.dtype(self.train_dtype).name}, zero={self.zero_stage}, "
+                 f"dp={self.dp_world_size}, tp={self.mp_world_size}, "
+                 f"micro_batch={self.train_micro_batch_size_per_gpu()}, "
+                 f"gas={self._config.gradient_accumulation_steps}", ranks=[0])
+
+    # ------------------------------------------------------------- plumbing
+    def _configure_optimizer(self):
+        if self.client_optimizer is not None:
+            if not _is_optax_like(self.client_optimizer):
+                raise ValueError("client optimizer must be an optax.GradientTransformation")
+            log_dist("Using client optimizer", ranks=[0])
+            return self.client_optimizer
+        name = self._config.optimizer_name
+        if name is None:
+            raise ValueError("No optimizer in ds_config and none passed to initialize()")
+        params = dict(self._config.optimizer_params or {})
+        log_dist(f"Using DeepSpeed optimizer: {name}", ranks=[0])
+        return build_optimizer(name, params)
+
+    def _configure_lr_scheduler(self) -> Optional[LRSchedule]:
+        if self.client_lr_scheduler is not None:
+            return self.client_lr_scheduler
+        if self._config.scheduler_name is not None:
+            return build_lr_schedule(self._config.scheduler_name,
+                                     self._config.scheduler_params or {})
+        return None
+
+    def _base_lr(self) -> float:
+        p = self._config.optimizer_params or {}
+        return float(p.get("lr", 1e-3))
+
+    def _lr_at(self, step):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler.lr_at(step)
+        return jnp.float32(self._base_lr())
+
+    def _init_state(self, init_fn, param_shapes, seed_key):
+        """Shard-aware state materialization — the zero.Init equivalent
+        (partition_parameters.py:603): params are created directly into their
+        shards (via jit out_shardings), never fully replicated on one chip."""
+        plan = self.plan
+        mesh = self.mesh
+        to_train_dtype = lambda p: p.astype(self.train_dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p
+        to_f32 = lambda p: p.astype(jnp.float32) if jnp.issubdtype(p.dtype, jnp.floating) else p
+
+        param_sh = plan.param_shardings()
+        master_sh = plan.master_shardings()
+
+        def build():
+            raw = init_fn()
+            params = jax.tree.map(to_train_dtype, raw)
+            params = jax.lax.with_sharding_constraint(params, plan.param_specs)
+            master = None
+            if self._keep_master:
+                master = jax.tree.map(to_f32, raw)
+                master = jax.lax.with_sharding_constraint(master, plan.master_specs)
+            opt_target = master if master is not None else params
+            opt_state = self.optimizer.init(opt_target)
+            return params, master, opt_state
+
+        with mesh:
+            params, master, opt_state = jax.jit(build)()
+
+        # opt-state shardings: match master-param placement structurally
+        opt_shapes = jax.eval_shape(lambda: opt_state)
+        master_shapes = jax.eval_shape(lambda: master if master is not None else params)
+        opt_specs = plan.map_opt_state_specs(opt_shapes, master_shapes)
+        opt_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), opt_specs)
+        opt_state = jax.device_put(opt_state, opt_sh)
+
+        repl = NamedSharding(mesh, P())
+        scaler_state = self.loss_scaler.initial_state() if self.loss_scaler else None
+        state = TrainState(step=jnp.int32(0), params=params, master=master,
+                           opt_state=opt_state,
+                           scaler=scaler_state,
+                           rng=seed_key,
+                           skipped_steps=jnp.int32(0))
+        shardings = TrainState(
+            step=repl,
+            params=param_sh,
+            master=master_sh if master is not None else None,
+            opt_state=opt_sh,
+            scaler=jax.tree.map(lambda _: repl, scaler_state) if scaler_state is not None else None,
+            rng=repl,
+            skipped_steps=repl)
+        return state, shardings
+
+    # -------------------------------------------------------- compute pieces
+    def _micro_loss_and_grads(self, params, batch, rng, scale):
+        """One microbatch: loss (unscaled, for reporting) + scaled grads."""
+
+        def scaled_loss(p):
+            out = self._loss_fn(p, batch, rng) if self._loss_accepts_rng() else self._loss_fn(p, batch)
+            loss = out[0] if isinstance(out, tuple) else out
+            return loss.astype(jnp.float32) * scale, loss
+
+        grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
+        return loss, grads
+
+    def _loss_accepts_rng(self) -> bool:
+        if not hasattr(self, "_rng_ok"):
+            try:
+                sig = inspect.signature(self._loss_fn)
+                self._rng_ok = len([p for p in sig.parameters.values()
+                                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]) >= 3 \
+                    or "rng" in sig.parameters
+            except (TypeError, ValueError):
+                self._rng_ok = False
+        return self._rng_ok
+
+    def _apply_grads(self, state: TrainState, grads, loss) -> Tuple[TrainState, StepMetrics]:
+        """Shared optimizer phase: unscale→clip→update→cast-back→scale bookkeeping.
+
+        Mirrors stage3.step (stage3.py:1775): overflow check, unscale_and_clip,
+        optimizer update, fp32→bf16/fp16 copy-back — but as one fused XLA
+        program over the sharded state."""
+        plan = self.plan
+        scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
+
+        # move grads to their ZeRO placement (stage>=2: reduce-scattered)
+        grads = jax.lax.with_sharding_constraint(grads, plan.grad_specs)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
+
+        finite = grads_finite(grads) if state.scaler is not None else jnp.bool_(True)
+
+        # global grad-norm clip (reference runtime/utils.py clip_grad_norm_)
+        clip = self._config.gradient_clipping
+        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+        grad_norm = jnp.sqrt(sq)
+        if clip > 0:
+            coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+            grads = jax.tree.map(lambda g: g * coef, grads)
+
+        masters = state.master if state.master is not None else state.params
+        lr = self._lr_at(state.step)
+        if self._lr_supports_override:
+            updates, new_opt = self.optimizer.update(grads, state.opt_state, masters, lr_override=lr)
+        else:
+            updates, new_opt = self.optimizer.update(grads, state.opt_state, masters)
+        import optax
+
+        new_masters = optax.apply_updates(masters, updates)
+        new_masters = jax.lax.with_sharding_constraint(new_masters, plan.master_specs if state.master is not None else plan.param_specs)
+
+        keep = lambda new, old: jnp.where(finite, new, old)
+        new_masters = jax.tree.map(keep, new_masters, masters)
+        new_opt = jax.tree.map(keep, new_opt, state.opt_state)
+
+        if state.master is not None:
+            new_params = jax.tree.map(
+                lambda m, p: m.astype(p.dtype) if jnp.issubdtype(p.dtype, jnp.floating) else m,
+                new_masters, state.params)
+            new_params = jax.lax.with_sharding_constraint(new_params, plan.param_specs)
+            master_out = new_masters
+        else:
+            new_params = new_masters
+            master_out = None
+
+        new_scaler = self.loss_scaler.update(state.scaler, finite) if state.scaler is not None else None
+        new_state = TrainState(step=state.step + 1,
+                               params=new_params,
+                               master=master_out,
+                               opt_state=new_opt,
+                               scaler=new_scaler,
+                               rng=jax.random.fold_in(state.rng, state.step),
+                               skipped_steps=state.skipped_steps + (~finite).astype(jnp.int32))
+        metrics = StepMetrics(loss=loss, grad_norm=grad_norm, lr=lr,
+                              loss_scale=scale, overflow=~finite)
+        return new_state, metrics
+
+    def _build_train_batch_fn(self, gas: int):
+        """Fused train step: scan over gradient-accumulation microbatches."""
+        plan = self.plan
+
+        def step_fn(state: TrainState, batch):
+            scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
+            params_c = state.params
+
+            if gas == 1:
+                rng = jax.random.fold_in(state.rng, state.step)
+                loss, grads = self._micro_loss_and_grads(params_c, batch, rng, scale)
+                mean_loss = loss
+            else:
+                # microbatch split: leading dim -> (gas, micro)
+                def split(x):
+                    x = x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+                    return x
+
+                mbs = jax.tree.map(split, batch)
+
+                def body(carry, mb):
+                    acc, i = carry
+                    rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step), i)
+                    loss, grads = self._micro_loss_and_grads(params_c, mb, rng, scale)
+                    grads = jax.lax.with_sharding_constraint(grads, plan.grad_specs)
+                    acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                    return (acc, i + 1), loss
+
+                zero_acc = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32), jax.eval_shape(lambda: params_c))
+                zero_acc = jax.lax.with_sharding_constraint(zero_acc, plan.grad_specs)
+                (acc, _), losses = jax.lax.scan(body, (zero_acc, jnp.int32(0)), mbs)
+                grads = jax.tree.map(lambda g: g / gas, acc)
+                mean_loss = jnp.mean(losses)
+
+            new_state, metrics = self._apply_grads(state, grads, mean_loss)
+            return new_state, metrics
+
+        return step_fn
+
+    def _get_compiled_train_batch(self, gas: int):
+        if gas not in self._compiled_train_batch:
+            fn = self._build_train_batch_fn(gas)
+            batch_sh = None  # inferred; batch constrained by caller device_put
+            self._compiled_train_batch[gas] = jax.jit(
+                fn, donate_argnums=(0,),
+                in_shardings=(self.state_shardings, None),
+                out_shardings=(self.state_shardings, None))
+        return self._compiled_train_batch[gas]
+
+    # ----------------------------------------------------------- public API
+    def train_batch(self, batch=None, data_iter=None) -> jnp.ndarray:
+        """Consume one *global* batch (all microbatches) and take one step.
+
+        The idiomatic entry point (reference PipelineEngine.train_batch:286 has
+        the same contract). Returns the mean loss.
+        """
+        if batch is None:
+            assert data_iter is not None, "train_batch needs a batch or data_iter"
+            batch = next(data_iter)
+        gas = self._config.gradient_accumulation_steps
+        batch = self._shard_batch(batch)
+        self.timers(TRAIN_BATCH_TIMER).start()
+        self.tput_timer.start()
+        with self.mesh:
+            self.state, metrics = self._get_compiled_train_batch(gas)(self.state, batch)
+        self._last_metrics = metrics
+        self.micro_steps += gas
+        self.global_samples += self.train_batch_size()
+        self._post_step(metrics)
+        self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics.loss)
+        self.tput_timer.stop(global_step=True, sync_obj=metrics.loss)
+        return metrics.loss
+
+    def _shard_batch(self, batch):
+        """Place a host batch onto the mesh, batch dim over the DP axes.
+
+        Single-host: the batch is global; device_put scatters it. Multi-host:
+        each process holds its local 1/nproc share (what DeepSpeedDataLoader
+        yields), assembled into the global array without any cross-host copy
+        via make_array_from_process_local_data.
+        """
+        multihost = jax.process_count() > 1
+
+        def put(x):
+            spec = P(*(tuple(self.plan.batch_spec) + (None,) * (np.asarray(x).ndim - len(tuple(self.plan.batch_spec)))))
+            sh = NamedSharding(self.mesh, spec)
+            if hasattr(x, "sharding") and x.sharding == sh:
+                return x
+            x = np.asarray(x)
+            if multihost:
+                return jax.make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
+
+        return jax.tree.map(put, batch)
+
+    # --- reference 3-call API -------------------------------------------
+    def forward(self, batch, *args, **kwargs):
+        """Compute loss AND stash this microbatch's gradients (fused — same
+        cost as the reference's forward+backward pair; see module docstring)."""
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self._compiled_fwd_bwd is None:
+            def fwd_bwd(state: TrainState, batch):
+                scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
+                rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step),
+                                         jnp.int32(0))
+                loss, grads = self._micro_loss_and_grads(state.params, batch, rng, scale)
+                grads = jax.lax.with_sharding_constraint(grads, self.plan.grad_specs)
+                return loss, grads
+
+            self._compiled_fwd_bwd = jax.jit(fwd_bwd)
+        batch = self._shard_batch(batch)
+        with self.mesh:
+            loss, grads = self._compiled_fwd_bwd(self.state, batch)
+        self._pending_grads = grads
+        self.timers(FORWARD_GLOBAL_TIMER).stop(sync_obj=loss)
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
+        """Accumulate the stashed microbatch grads into the grad buffer."""
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        assert getattr(self, "_pending_grads", None) is not None, \
+            "backward() must follow forward() (grads are computed fused)"
+        grads = self._pending_grads
+        self._pending_grads = None
+        if self._grad_buffer is None:
+            self._grad_buffer = grads
+        else:
+            if self._compiled_accum is None:
+                self._compiled_accum = jax.jit(
+                    lambda a, g: jax.tree.map(lambda x, y: x + y.astype(x.dtype), a, g),
+                    donate_argnums=(0,))
+            with self.mesh:
+                self._grad_buffer = self._compiled_accum(self._grad_buffer, grads)
+        self._micro_loss = loss
+        self.micro_steps += 1
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    _compiled_accum = None
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return self.micro_steps % self._config.gradient_accumulation_steps == 0
+
+    def step(self):
+        """Apply the optimizer at a gradient-accumulation boundary."""
+        self.timers(STEP_GLOBAL_TIMER).start()
+        if not self.is_gradient_accumulation_boundary():
+            self.timers(STEP_GLOBAL_TIMER).stop()
+            return  # mid-accumulation: reference engine also no-ops the model step
+        assert self._grad_buffer is not None, "step() called with no accumulated gradients"
+        gas = self._config.gradient_accumulation_steps
+        if self._compiled_apply is None:
+            def apply_fn(state, grads, loss):
+                grads = jax.tree.map(lambda g: g / gas, grads)
+                return self._apply_grads(state, grads, loss)
+
+            self._compiled_apply = jax.jit(apply_fn, donate_argnums=(0, 1),
+                                           in_shardings=(self.state_shardings, None, None),
+                                           out_shardings=(self.state_shardings, None))
+        loss = self._micro_loss if self._micro_loss is not None else jnp.float32(0.0)
+        with self.mesh:
+            self.state, metrics = self._compiled_apply(self.state, self._grad_buffer, loss)
+        self._grad_buffer = None
+        self._last_metrics = metrics
+        self.global_samples += self.train_batch_size()
+        self._post_step(metrics)
+        self.timers(STEP_GLOBAL_TIMER).stop(sync_obj=metrics.loss)
+
+    def eval_batch(self, batch):
+        """Loss without grads (for eval loops)."""
+        if self._compiled_eval is None:
+            def ev(state, batch):
+                out = self._loss_fn(state.params, batch, state.rng) if self._loss_accepts_rng() \
+                    else self._loss_fn(state.params, batch)
+                return out[0] if isinstance(out, tuple) else out
+
+            self._compiled_eval = jax.jit(ev)
+        batch = self._shard_batch(batch)
+        with self.mesh:
+            return self._compiled_eval(self.state, batch)
+
+    def _post_step(self, metrics: StepMetrics):
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        # host-side step counter: never force a device sync just for logging
+        self._host_step = getattr(self, "_host_step", 0) + 1
+        step = self._host_step
+        if self._config.steps_per_print and step % self._config.steps_per_print == 0:
+            log_dist(f"step={step} loss={float(metrics.loss):.4f} "
+                     f"lr={float(metrics.lr):.3e} gnorm={float(metrics.grad_norm):.3f}"
+                     + (f" scale={float(metrics.loss_scale):.0f}" if self.fp16_enabled else ""),
+                     ranks=[0])
+        if self.monitor.enabled:
+            self.monitor.write_events([("Train/Samples/train_loss", float(metrics.loss), self.global_samples),
+                                       ("Train/Samples/lr", float(metrics.lr), self.global_samples)])
+
+    # ------------------------------------------------------------ accessors
+    def train_batch_size(self) -> int:
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self._config.train_micro_batch_size_per_gpu
+
+    def get_lr(self):
+        return [float(self._lr_at(self.state.step))]
+
+    def get_global_grad_norm(self) -> Optional[float]:
+        return float(self._last_metrics.grad_norm) if self._last_metrics else None
+
+    def get_loss_scale(self) -> float:
+        return float(self.state.scaler.scale) if self.state.scaler is not None else 1.0
+
+    @property
+    def skipped_steps(self) -> int:
+        return int(self.state.skipped_steps)
+
+    @property
+    def global_steps(self) -> int:
+        return int(self.state.step)
+
+    def zero_optimization(self) -> bool:
+        return self.zero_stage > 0
+
+    def zero_optimization_stage(self) -> int:
+        return self.zero_stage
+
+    def get_data_parallel_world_size(self):
+        return self.dp_world_size
+
+    def get_model_parallel_world_size(self):
+        return self.mp_world_size
+
+    def module_state_dict(self):
+        """Gathered (unsharded) params on host — reference module_state_dict."""
+        with self.mesh:
+            gathered = jax.jit(lambda p: p,
+                               out_shardings=jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
+                                                          self.state.params))(self.state.params)
+        return jax.tree.map(np.asarray, gathered)
+
+    # ------------------------------------------------------------ dataloader
+    def deepspeed_io(self, dataset, batch_size=None, route=None, **kwargs):
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+        return DeepSpeedDataLoader(dataset,
+                                   batch_size=batch_size or self.train_batch_size(),
+                                   collate_fn=self.collate_fn)
+
+    # ------------------------------------------------------------ checkpoint
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
+                        exclude_frozen_parameters=False):
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_checkpoint
+
+        return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
+                                      save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True,
+                        load_module_only=False, custom_load_fn=None):
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
+
+        return load_engine_checkpoint(self, load_dir, tag=tag,
+                                      load_optimizer_states=load_optimizer_states,
+                                      load_module_only=load_module_only)

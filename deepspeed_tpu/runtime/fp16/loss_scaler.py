@@ -1,0 +1,120 @@
+"""FP16 loss scaling.
+
+Counterpart of the reference's ``deepspeed/runtime/fp16/loss_scaler.py``
+(LossScaler/DynamicLossScaler, 265 LoC). The TPU twist: the overflow check and
+the skip-or-step decision must live *inside* the jitted train step (a host
+round-trip per step would stall the TPU), so the scaler is a pure pytree state
+plus pure transition functions, driven by ``lax.cond``-free ``jnp.where``
+arithmetic — no recompilation on overflow, matching the reference's semantics:
+on inf/nan skip the update and halve the scale (respecting hysteresis); after
+``scale_window`` clean steps double it (cap at initial scale; floor at
+``min_scale``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray            # f32 scalar
+    good_steps: jnp.ndarray       # i32 — clean steps since last overflow/raise
+    hysteresis: jnp.ndarray       # i32 — remaining tolerated overflows before halving
+    overflows: jnp.ndarray        # i32 — total skipped steps (telemetry)
+
+
+def make_state(init_scale: float) -> LossScaleState:
+    return LossScaleState(scale=jnp.float32(init_scale),
+                          good_steps=jnp.int32(0),
+                          hysteresis=jnp.int32(1),
+                          overflows=jnp.int32(0))
+
+
+def grads_finite(grads: Any) -> jnp.ndarray:
+    """Scalar bool: every element of every gradient leaf is finite.
+
+    The reference scans each grad tensor on the host (stage3.py:1924
+    _has_inf_or_nan); here it is one fused reduction XLA folds into the
+    backward epilogue. Under data-parallel sharding the result is identical on
+    every device because grads are already reduced.
+    """
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.bool_(True)
+    oks = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.stack(oks).all()
+
+
+class DynamicLossScaler:
+    """Stateless policy object; state lives in LossScaleState (pytree)."""
+
+    def __init__(self, init_scale: float = 2.0 ** 16, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 delayed_shift: int = 1, consecutive_hysteresis: bool = False,
+                 raise_error_at_min_scale: bool = False, dtype=jnp.float16):
+        self.init_scale = init_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = max(1, delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.dtype = dtype
+
+    def initial_state(self) -> LossScaleState:
+        st = make_state(self.init_scale)
+        return st._replace(hysteresis=jnp.int32(self.delayed_shift))
+
+    def update(self, state: LossScaleState, finite: jnp.ndarray) -> LossScaleState:
+        """Pure transition: apply one step's overflow verdict."""
+        overflow = ~finite
+        # hysteresis: tolerate `delayed_shift` consecutive overflows before halving
+        hys_after = jnp.where(overflow, jnp.maximum(state.hysteresis - 1, 0), state.hysteresis)
+        should_halve = overflow & (hys_after == 0)
+        new_scale = jnp.where(should_halve,
+                              jnp.maximum(state.scale / self.scale_factor, self.min_scale),
+                              state.scale)
+        # reset hysteresis when we halved, or (if consecutive_hysteresis) on a clean step
+        hys_reset = jnp.int32(self.delayed_shift)
+        new_hys = jnp.where(should_halve, hys_reset,
+                            jnp.where(finite & jnp.bool_(self.consecutive_hysteresis), hys_reset, hys_after))
+        good = jnp.where(finite, state.good_steps + 1, 0)
+        should_raise = finite & (good >= self.scale_window)
+        new_scale = jnp.where(should_raise, new_scale * self.scale_factor, new_scale)
+        good = jnp.where(should_raise, 0, good)
+        return LossScaleState(scale=new_scale, good_steps=good, hysteresis=new_hys,
+                              overflows=state.overflows + overflow.astype(jnp.int32))
+
+
+class LossScaler(DynamicLossScaler):
+    """Static scaling (reference LossScaler): scale never changes."""
+
+    def __init__(self, scale: float = 1.0):
+        super().__init__(init_scale=scale)
+
+    def update(self, state: LossScaleState, finite: jnp.ndarray) -> LossScaleState:
+        return state._replace(overflows=state.overflows + (~finite).astype(jnp.int32))
+
+
+def CreateLossScaler(dtype, static_loss_scale: float, dynamic_scaling: bool, dynamic_loss_args=None):
+    """Factory matching the reference's CreateLossScaler (loss_scaler.py tail)."""
+    if dtype == jnp.float16 and dynamic_scaling:
+        kwargs = dynamic_loss_args or {}
+        mapped = {
+            "init_scale": kwargs.get(INITIAL_LOSS_SCALE, 2.0 ** 16),
+            "scale_window": kwargs.get(SCALE_WINDOW, 1000),
+            "min_scale": kwargs.get(MIN_LOSS_SCALE, 1.0),
+            "delayed_shift": kwargs.get(DELAYED_SHIFT, 1),
+            "consecutive_hysteresis": kwargs.get(CONSECUTIVE_HYSTERESIS, False),
+        }
+        return DynamicLossScaler(dtype=dtype, **mapped)
+    scale = static_loss_scale if (dtype == jnp.float16 and static_loss_scale > 0) else 1.0
+    return LossScaler(scale=scale)

@@ -1,0 +1,220 @@
+"""ZeRO partitioning — sharding-spec planner.
+
+The TPU-native re-expression of the reference's three ZeRO optimizers
+(zero/stage_1_and_2.py:90, zero/stage3.py:65, zero/partition_parameters.py:603).
+Where the reference installs gradient hooks, flattens parameter groups, and
+hand-schedules bucketed reduce/allgather on side streams, the TPU build states
+the *placement* declaratively and lets XLA generate the collectives:
+
+  stage 0  params replicated, grads all-reduced (psum), optimizer replicated
+  stage 1  + optimizer state (and fp32 master weights) sharded over the DP axes
+  stage 2  + gradients sharded over the DP axes (psum → reduce_scatter)
+  stage 3  + parameters themselves sharded over the DP axes (allgather-on-use,
+             which XLA schedules per-layer and overlaps — the role of the
+             reference's PartitionedParameterCoordinator prefetch machinery)
+
+``param_persistence_threshold`` keeps small params replicated in stage 3 just
+like the reference's "persistent parameters" (stage3.py persistence threshold),
+avoiding per-tiny-tensor allgathers. MiCS-style scoped sharding
+(zero/mics.py:31) falls out of restricting ``shard_axes`` to a sub-axis of the
+mesh: params replicate across the remaining DP axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import DATA_AXIS, DP_AXES, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS
+from deepspeed_tpu.utils.logging import logger
+
+
+def _spec_tuple(spec: Optional[P], ndim: int) -> Tuple:
+    """Normalize a PartitionSpec to a length-ndim tuple of entries."""
+    if spec is None:
+        return (None,) * ndim
+    entries = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    return entries[:ndim]
+
+
+def _axes_of(entry) -> Tuple[str, ...]:
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def _shard_over_dp(shape: Tuple[int, ...], base_spec: Optional[P], dp_axes: Sequence[str],
+                   mesh: Mesh, min_size: int = 0) -> P:
+    """Add DP axes to the best available dim of ``base_spec``.
+
+    Picks the largest dim whose size (divided by what tp already shards it by)
+    is divisible by the DP world; returns base_spec unchanged if none fits or
+    the tensor is smaller than ``min_size`` elements.
+    """
+    dp_axes = [a for a in dp_axes if mesh.shape.get(a, 1) > 1]
+    if not dp_axes:
+        return base_spec if base_spec is not None else P()
+    dp_size = int(np.prod([mesh.shape[a] for a in dp_axes]))
+    entries = list(_spec_tuple(base_spec, len(shape)))
+    if int(np.prod(shape)) < max(1, min_size):
+        return P(*entries)
+
+    used = set()
+    for e in entries:
+        used.update(_axes_of(e))
+    if any(a in used for a in dp_axes):
+        return P(*entries)  # already dp-sharded (e.g. expert-stacked weights)
+
+    best_dim, best_size = -1, -1
+    for d, size in enumerate(shape):
+        tp_factor = int(np.prod([mesh.shape[a] for a in _axes_of(entries[d])])) or 1
+        local = size // tp_factor
+        if local % dp_size == 0 and local // dp_size > 0 and size > best_size:
+            best_dim, best_size = d, size
+    if best_dim < 0:
+        return P(*entries)
+    entries[best_dim] = tuple(_axes_of(entries[best_dim])) + tuple(dp_axes)
+    if len(entries[best_dim]) == 1:
+        entries[best_dim] = entries[best_dim][0]
+    return P(*entries)
+
+
+@dataclasses.dataclass
+class ShardingPlan:
+    """Per-pytree NamedShardings for every piece of training state."""
+
+    mesh: Mesh
+    param_specs: Any       # compute params (what the forward pass reads)
+    master_specs: Any      # fp32 master copy (stage>=1: dp-sharded)
+    grad_specs: Any        # gradients (stage>=2: dp-sharded)
+    batch_spec: P          # input batch
+    zero_stage: int
+    dp_axes: Tuple[str, ...]
+
+    def named(self, spec: P, memory_kind: Optional[str] = None) -> NamedSharding:
+        if memory_kind:
+            return NamedSharding(self.mesh, spec, memory_kind=memory_kind)
+        return NamedSharding(self.mesh, spec)
+
+    def param_shardings(self):
+        return jax.tree.map(self.named, self.param_specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def master_shardings(self, memory_kind: Optional[str] = None):
+        return jax.tree.map(lambda s: self.named(s, memory_kind), self.master_specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def grad_shardings(self):
+        return jax.tree.map(self.named, self.grad_specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def batch_sharding(self) -> NamedSharding:
+        return self.named(self.batch_spec)
+
+    _master_shapes: Any = None
+
+    def map_opt_state_specs(self, opt_state_shapes: Any, master_shapes: Any):
+        """Build specs for the optimizer state given abstract shapes.
+
+        optax states mirror the param tree inside NamedTuples; we map: leaf
+        shape == some master-param shape at the same tree position → master
+        spec, else replicate. We exploit that optax moment trees have the SAME
+        treedef as params, so tree_map against masters works when structures
+        align; otherwise fall back to shape-matching per leaf.
+        """
+        master_leaves = jax.tree.leaves(master_shapes)
+        spec_leaves = jax.tree.leaves(self.master_specs, is_leaf=lambda x: isinstance(x, P))
+        shape_index = {}
+        for lf, sp in zip(master_leaves, spec_leaves):
+            shape_index.setdefault(tuple(lf.shape), sp)
+
+        def leaf_spec(leaf):
+            sp = shape_index.get(tuple(leaf.shape))
+            return sp if sp is not None else P()
+
+        return jax.tree.map(leaf_spec, opt_state_shapes)
+
+
+def plan_sharding(param_shapes: Any,
+                  mesh: Mesh,
+                  zero_config=None,
+                  tp_specs: Any = None,
+                  dp_axes: Sequence[str] = DP_AXES,
+                  batch_spec: Optional[P] = None) -> ShardingPlan:
+    """Compute the ZeRO placement plan.
+
+    Args:
+      param_shapes: pytree of ShapeDtypeStruct (from jax.eval_shape of init).
+      tp_specs: optional pytree of PartitionSpec with tensor/seq-parallel axes
+        already assigned (the AutoTP analogue fills this; None = pure DP).
+      zero_config: DeepSpeedZeroConfig; stage and thresholds read from it.
+    """
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+    zc = zero_config or DeepSpeedZeroConfig()
+    stage = int(zc.stage)
+    if zc.shard_axes:
+        dp_axes = tuple(zc.shard_axes)
+    elif zc.mics_shard_size and zc.mics_shard_size > 0:
+        # MiCS: restrict sharding to a sub-group. We approximate by sharding
+        # over the data axis only when its size equals mics_shard_size.
+        dp_axes = (DATA_AXIS,)
+    dp_axes = tuple(a for a in dp_axes if mesh.shape.get(a, 1) > 1)
+
+    if tp_specs is None:
+        tp_specs = jax.tree.map(lambda s: P(), param_shapes)
+
+    def param_spec(shape_struct, tp_spec):
+        if stage >= 3:
+            return _shard_over_dp(shape_struct.shape, tp_spec, dp_axes, mesh,
+                                  min_size=zc.param_persistence_threshold)
+        return tp_spec if tp_spec is not None else P()
+
+    def master_spec(shape_struct, tp_spec):
+        if stage >= 1:
+            return _shard_over_dp(shape_struct.shape, tp_spec, dp_axes, mesh, min_size=0)
+        return tp_spec if tp_spec is not None else P()
+
+    def grad_spec(shape_struct, tp_spec):
+        if stage >= 2:
+            return _shard_over_dp(shape_struct.shape, tp_spec, dp_axes, mesh, min_size=0)
+        return tp_spec if tp_spec is not None else P()
+
+    is_p = lambda x: isinstance(x, P) or x is None
+    param_specs = jax.tree.map(param_spec, param_shapes, tp_specs)
+    master_specs = jax.tree.map(master_spec, param_shapes, tp_specs)
+    grad_specs = jax.tree.map(grad_spec, param_shapes, tp_specs)
+
+    if batch_spec is None:
+        batch_axes = tuple(a for a in (DATA_AXIS, EXPERT_AXIS) if mesh.shape.get(a, 1) > 1)
+        batch_spec = P(batch_axes if batch_axes else None)
+
+    plan = ShardingPlan(mesh=mesh, param_specs=param_specs, master_specs=master_specs,
+                        grad_specs=grad_specs, batch_spec=batch_spec, zero_stage=stage,
+                        dp_axes=dp_axes)
+    plan._master_shapes = param_shapes
+    return plan
+
+
+def partition_report(plan: ShardingPlan, param_shapes: Any) -> str:
+    """Human-readable table of how much of the model each stage shards."""
+    n_total = 0
+    n_sharded = 0
+    for leaf, spec in zip(jax.tree.leaves(param_shapes),
+                          jax.tree.leaves(plan.param_specs, is_leaf=lambda x: isinstance(x, P))):
+        n = int(np.prod(leaf.shape))
+        n_total += n
+        axes = set()
+        for e in _spec_tuple(spec, len(leaf.shape)):
+            axes.update(_axes_of(e))
+        if any(a in plan.dp_axes for a in axes):
+            n_sharded += n
+    pct = 100.0 * n_sharded / max(1, n_total)
+    return (f"ZeRO stage {plan.zero_stage}: {n_total/1e6:.1f}M params, "
+            f"{pct:.1f}% dp-sharded over axes {plan.dp_axes}")

@@ -1,0 +1,37 @@
+"""Test harness: fake 8-device CPU mesh.
+
+The TPU translation of the reference's DistributedTest fork-based harness
+(tests/unit/common.py:86): instead of forking world_size processes, JAX gives
+us N virtual devices in ONE process via --xla_force_host_platform_device_count
+(SURVEY §4 "TPU translation"). Every test sees an 8-device CPU backend and
+builds whatever mesh shape it needs.
+"""
+
+import os
+
+# Must be set before jax initializes its backend.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_comm():
+    """Each test gets a fresh global comm backend."""
+    yield
+    from deepspeed_tpu.comm import comm
+
+    comm.cdb = None
+
+
+@pytest.fixture
+def mesh8():
+    from deepspeed_tpu.parallel.topology import build_mesh
+
+    return build_mesh(axis_dims={"pipe": 1, "data": 8, "expert": 1, "seq": 1, "tensor": 1})

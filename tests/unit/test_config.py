@@ -1,0 +1,63 @@
+"""Config-system tests (reference: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+
+def test_batch_triple_completion():
+    cfg = DeepSpeedConfig({"train_batch_size": 32}, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "gradient_accumulation_steps": 2}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3},
+                          world_size=4)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_triple_mismatch_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 33}, world_size=8)
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+                         "gradient_accumulation_steps": 4}, world_size=8)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=8)
+
+
+def test_zero_config_aliases():
+    zc = DeepSpeedZeroConfig(**{"stage": 3, "stage3_prefetch_bucket_size": 12345,
+                                "stage3_param_persistence_threshold": 77})
+    assert int(zc.stage) == 3
+    assert zc.prefetch_bucket_size == 12345
+    assert zc.param_persistence_threshold == 77
+
+
+def test_zero_deprecated_cpu_offload():
+    zc = DeepSpeedZeroConfig(**{"stage": 2, "cpu_offload": True})
+    assert zc.offload_optimizer is not None and zc.offload_optimizer.device == "cpu"
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(Exception):
+        DeepSpeedZeroConfig(**{"stage": 1, "not_a_real_knob": 5})
+
+
+def test_scheduler_optimizer_sections():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3, "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        "gradient_clipping": 1.0,
+    }, world_size=8)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.gradient_clipping == 1.0

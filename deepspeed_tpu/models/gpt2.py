@@ -39,7 +39,10 @@ class GPT2Config:
     n_head: int = 12
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
-    remat: bool = True
+    # activation checkpointing: False/'none', True/'full' (recompute all),
+    # or 'dots' (save matmul outputs, recompute elementwise — usually the
+    # right trade on TPU where HBM, not FLOPs, is the binding constraint)
+    remat: Any = True
     use_flash_attention: bool = True
     tie_embeddings: bool = True
     # sequence-parallel: shard activations over the 'seq' axis (ring attention)
@@ -203,6 +206,12 @@ class GPT2Model:
     def apply(self, params, input_ids, rng=None):
         """input_ids (B, T) int32 → logits (B, T, V) fp32."""
         c = self.config
+        x = self._trunk(params, input_ids, rng)
+        head = params["wte"].T if c.tie_embeddings else params["lm_head"]
+        return (x @ head.astype(x.dtype)).astype(jnp.float32)
+
+    def _trunk(self, params, input_ids, rng=None):
+        c = self.config
         B, T = input_ids.shape
         x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[:T]
         if rng is not None and c.dropout > 0.0:
@@ -210,8 +219,11 @@ class GPT2Model:
             x = self._dropout(x, emb_key)
 
         block_fn = self._block
-        if c.remat:
+        if c.remat in (True, "full"):
             block_fn = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        elif c.remat == "dots":
+            block_fn = jax.checkpoint(
+                block_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
         layer_rngs = jax.random.split(rng, c.n_layer) if (rng is not None and c.dropout > 0.0) else None
 
@@ -221,24 +233,48 @@ class GPT2Model:
             return x, None
 
         x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
-        x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
-        head = params["wte"].T if c.tie_embeddings else params["lm_head"]
-        logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
-        return logits
+        return self._layer_norm(x, params["lnf_g"], params["lnf_b"])
+
+    def hidden_states(self, params, input_ids, rng=None):
+        """Transformer trunk only: (B, T) → final hidden (B, T, D)."""
+        return self._trunk(params, input_ids, rng)
 
     def loss(self, params, batch, rng=None):
         """batch: dict with input_ids (B,T) [+ optional labels/loss_mask] or a
-        bare (B,T) array — next-token cross entropy."""
+        bare (B,T) array — next-token cross entropy.
+
+        The vocab projection + CE is computed in sequence chunks so the full
+        (B, T, V) fp32 logits tensor is never materialized (the same memory
+        trick as the reference's fused softmax-CE kernels, csrc/transformer/
+        softmax_kernels.cu — at V≈50k this is multiple GB per microbatch).
+        """
         if isinstance(batch, dict):
             ids = batch["input_ids"]
             labels = batch.get("labels", ids)
             mask = batch.get("loss_mask")
         else:
             ids, labels, mask = batch, batch, None
-        logits = self.apply(params, ids, rng)[:, :-1]
+        c = self.config
+        x = self._trunk(params, ids, rng)[:, :-1]          # (B, T-1, D)
         targets = labels[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
+
+        B, Tm1, D = x.shape
+        # chunk so the (B, C, V) fp32 logits buffer stays ~256MB
+        chunk = max(1, min(Tm1, (64 * 1024 * 1024) // max(1, B * c.vocab_size)))
+        chunk = next((cc for cc in range(chunk, 0, -1) if Tm1 % cc == 0), 1)
+        xs = x.reshape(B, Tm1 // chunk, chunk, D).swapaxes(0, 1)        # (n, B, C, D)
+        ts = targets.reshape(B, Tm1 // chunk, chunk).swapaxes(0, 1)     # (n, B, C)
+
+        def chunk_nll(carry, xt):
+            xc, tc = xt
+            logits = (xc @ head).astype(jnp.float32)                     # (B, C, V)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+            return carry, lse - tgt
+
+        _, nll = jax.lax.scan(chunk_nll, 0.0, (xs, ts))                  # (n, B, C)
+        nll = nll.swapaxes(0, 1).reshape(B, Tm1)
         if mask is not None:
             m = mask[:, 1:].astype(jnp.float32)
             return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)

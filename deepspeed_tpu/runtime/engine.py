@@ -318,17 +318,20 @@ class DeepSpeedEngine:
 
         # move grads to their ZeRO placement (stage>=2: reduce-scattered)
         grads = jax.lax.with_sharding_constraint(grads, plan.grad_specs)
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
 
         finite = grads_finite(grads) if state.scaler is not None else jnp.bool_(True)
 
-        # global grad-norm clip (reference runtime/utils.py clip_grad_norm_)
+        # Unscale + global-norm clip WITHOUT materializing a second fp32 grad
+        # tree (at 1B params that tree is 4GB): norms are fused reductions,
+        # and the per-leaf f32 cast happens inside the (fused) scale op.
+        inv_scale = 1.0 / scale
         clip = self._config.gradient_clipping
-        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
-        grad_norm = jnp.sqrt(sq)
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(grads))
+        grad_norm = jnp.sqrt(sq) * inv_scale  # unscaled norm (reference clip_grad_norm_)
+        coef = inv_scale
         if clip > 0:
-            coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
-            grads = jax.tree.map(lambda g: g * coef, grads)
+            coef = coef * jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+        grads = jax.tree.map(lambda g: (g.astype(jnp.float32) * coef).astype(g.dtype), grads)
 
         masters = state.master if state.master is not None else state.params
         lr = self._lr_at(state.step)

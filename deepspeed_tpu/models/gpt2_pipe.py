@@ -1,0 +1,100 @@
+"""Pipeline-parallel GPT-2 (reference role: PipelineModule-wrapped GPT, cf.
+BASELINE config "GPT-NeoX 6.7B ZeRO-3 + PipelineModule").
+
+The decoder blocks become a (pipe_stages, layers_per_stage, ...) stacked pytree
+sharded over the 'pipe' mesh axis; embeddings/final-LN/head live in a 'shared'
+subtree replicated across stages (tied embeddings ⇒ their gradient is the AD
+sum of the stage-0 and last-stage uses — the reference's ReduceTiedGrads,
+pipe/engine.py:225, with no explicit collective). The microbatch loop runs
+inside jit (runtime/pipe/engine.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.pipe.engine import pipelined_loss_fn
+
+
+class PipelinedGPT2(GPT2Model):
+    """Model-protocol implementation whose loss is the in-jit pipeline."""
+
+    def __init__(self, config: GPT2Config, num_stages: int, num_micro: int):
+        super().__init__(config)
+        if config.n_layer % num_stages:
+            raise ValueError(f"n_layer {config.n_layer} not divisible by stages {num_stages}")
+        self.num_stages = num_stages
+        self.num_micro = num_micro
+        self._pipe_loss = None
+
+    # ---------------------------------------------------------------- params
+    def init_params(self, rng) -> Dict[str, Any]:
+        flat = super().init_params(rng)
+        S = self.num_stages
+        Lp = self.config.n_layer // S
+        stages = jax.tree.map(lambda x: x.reshape((S, Lp) + x.shape[1:]), flat["blocks"])
+        shared = {k: v for k, v in flat.items() if k != "blocks"}
+        return {"stages": stages, "shared": shared}
+
+    def param_partition_specs(self) -> Dict[str, Any]:
+        flat = super().param_partition_specs()
+        def stage_spec(spec):
+            # (L, ...) -> (S, Lp, ...): new leading 'pipe' dim, layer dim unsharded
+            rest = tuple(spec)[1:]
+            return P("pipe", None, *rest)
+        stages = jax.tree.map(stage_spec, flat["blocks"],
+                              is_leaf=lambda x: isinstance(x, P))
+        shared = {k: v for k, v in flat.items() if k != "blocks"}
+        return {"stages": stages, "shared": shared}
+
+    # --------------------------------------------------------------- compute
+    def _stage_fn(self, stage_params, x, rng):
+        def body(carry, blk):
+            return self._block(carry, blk, None), None
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    def _first_stage_fn(self, shared, mb, rng):
+        ids = mb["input_ids"] if isinstance(mb, dict) else mb
+        T = ids.shape[1]
+        c = self.config
+        return shared["wte"].astype(c.dtype)[ids] + shared["wpe"].astype(c.dtype)[:T]
+
+    def _last_stage_loss_fn(self, shared, x, mb):
+        c = self.config
+        if isinstance(mb, dict):
+            ids = mb["input_ids"]
+            labels = mb.get("labels", ids)
+            mask = mb.get("loss_mask")
+        else:
+            ids, labels, mask = mb, mb, None
+        x = self._layer_norm(x, shared["lnf_g"], shared["lnf_b"])[:, :-1]
+        head = (shared["wte"].T if c.tie_embeddings else shared["lm_head"]).astype(x.dtype)
+        logits = (x @ head).astype(jnp.float32)
+        targets = labels[:, 1:]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        nll = lse - tgt
+        if mask is not None:
+            m = mask[:, 1:].astype(jnp.float32)
+            return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.mean(nll)
+
+    def loss(self, params, batch, rng=None):
+        if self._pipe_loss is None:
+            from deepspeed_tpu.comm import comm
+
+            self._pipe_loss = pipelined_loss_fn(
+                stage_fn=self._stage_fn,
+                first_stage_fn=self._first_stage_fn,
+                last_stage_loss_fn=self._last_stage_loss_fn,
+                num_micro=self.num_micro,
+                mesh=comm.get_mesh(),
+                remat_stage=self.config.remat in (True, "full", "dots"))
+        return self._pipe_loss(params, batch, rng)

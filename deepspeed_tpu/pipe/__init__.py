@@ -1,0 +1,5 @@
+"""Re-export for API parity with ``deepspeed.pipe`` (deepspeed/pipe/__init__.py)."""
+
+from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec
+
+__all__ = ["LayerSpec", "PipelineModule", "TiedLayerSpec"]

@@ -1,0 +1,6 @@
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe.schedule import (DataParallelSchedule, InferenceSchedule,
+                                                 PipeSchedule, TrainSchedule)
+
+__all__ = ["LayerSpec", "PipelineModule", "TiedLayerSpec", "PipeSchedule",
+           "TrainSchedule", "InferenceSchedule", "DataParallelSchedule"]

@@ -1,0 +1,145 @@
+"""In-jit pipeline executor.
+
+Counterpart of the reference's ``runtime/pipe/engine.py`` (PipelineEngine :42:
+a host-side interpreter that walks TrainSchedule instructions, firing NCCL
+send/recvs and per-microbatch fwd/bwd). The TPU-native design compiles the
+ENTIRE pipelined train step into one XLA program:
+
+* the microbatch loop is a ``lax.scan`` over fill-drain ticks;
+* stage-to-stage transfer is ``lax.ppermute`` over the 'pipe' mesh axis
+  (p2p.send_forward) — XLA overlaps it with the next tick's compute;
+* the backward pass is jax.grad THROUGH the scan: AD transposes every
+  ppermute into the reverse-direction grad send, reproducing the
+  SendGrad/RecvGrad instruction pairs of the 1F1B schedule for free;
+* tied weights (embeddings) are one pytree leaf used on several stages —
+  AD sums their gradient contributions, which is exactly
+  _exec_reduce_tied_grads (reference :225) without the explicit collective.
+
+The pipeline is manual over 'pipe' only (shard_map axis_names={'pipe'}): data/
+tensor/expert axes stay in GSPMD "auto" mode, so ZeRO sharding and Megatron TP
+compose with pipelining without any code here knowing about them.
+
+Schedule: fill-drain (GPipe) order with loss fused into the last stage's tick
+via lax.cond — bubble fraction (S-1)/(M+S-1); the memory-motivated 1F1B
+variant is round-2 work (XLA's scheduler already interleaves fwd/bwd of
+adjacent microbatches within the fused program).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import PIPE_AXIS
+from deepspeed_tpu.runtime.pipe import p2p
+
+
+def pipelined_loss_fn(stage_fn: Callable,
+                      first_stage_fn: Callable,
+                      last_stage_loss_fn: Callable,
+                      num_micro: int,
+                      mesh,
+                      remat_stage: bool = True) -> Callable:
+    """Build loss(params, batch, rng) running a fill-drain pipeline over
+    the mesh's 'pipe' axis.
+
+    Args:
+      stage_fn(stage_params, x, rng) -> x: one stage's layer stack. Applied by
+        EVERY stage each tick (homogeneous stages; stage_params is this
+        stage's slice of the stacked layer pytree).
+      first_stage_fn(shared_params, microbatch, rng) -> x: embedding/input
+        layers; computed only for stage 0's input injection.
+      last_stage_loss_fn(shared_params, x, microbatch) -> scalar: head + loss,
+        evaluated on the final stage under lax.cond (other stages skip it —
+        legal divergence because only auto-axis collectives orthogonal to
+        'pipe' appear inside).
+      num_micro: number of microbatches the global batch splits into.
+
+    params layout: {"stages": <leaves with leading dim = pipe size>,
+                    "shared": <replicated-over-pipe leaves (embed/head/etc)>}
+    batch: pytree whose leaves have leading dim divisible by num_micro.
+    """
+    S = mesh.shape[PIPE_AXIS]
+
+    def loss(params, batch, rng=None):
+        def split_mb(x):
+            return x.reshape((num_micro, x.shape[0] // num_micro) + x.shape[1:])
+
+        mbs = jax.tree.map(split_mb, batch)
+
+        def inner(stage_params, shared, mbs):
+            my_stage = jax.tree.map(lambda t: t[0], stage_params)
+            s = jax.lax.axis_index(PIPE_AXIS)
+            ticks = num_micro + S - 1
+
+            run_stage = stage_fn
+            if remat_stage:
+                run_stage = jax.checkpoint(stage_fn,
+                                           policy=jax.checkpoint_policies.nothing_saveable)
+
+            def pick_mb(t):
+                return jax.tree.map(
+                    lambda x: jax.lax.dynamic_index_in_dim(x, t, axis=0, keepdims=False), mbs)
+
+            def tick(carry, t):
+                x_prev, loss_acc = carry
+                # stage 0 injects microbatch t (clamped during drain)
+                mb_in = pick_mb(jnp.clip(t, 0, num_micro - 1))
+                first = first_stage_fn(shared, mb_in, rng)
+                x_in = jnp.where(s == 0, first, x_prev)
+                out = run_stage(my_stage, x_in, rng)
+
+                # last stage consumes microbatch t-(S-1) once the pipe is full
+                mb_idx = jnp.clip(t - (S - 1), 0, num_micro - 1)
+                mb_out = pick_mb(mb_idx)
+                valid = (t >= S - 1)
+
+                def head(args):
+                    x, mb = args
+                    return last_stage_loss_fn(shared, x, mb)
+
+                l = jax.lax.cond(jnp.logical_and(s == S - 1, valid), head,
+                                 lambda args: jnp.float32(0.0), (out, mb_out))
+                x_next = p2p.send_forward(out, PIPE_AXIS)
+                return (x_next, loss_acc + l), None
+
+            first0 = first_stage_fn(shared, pick_mb(0), rng)
+            zeros = jnp.zeros_like(first0)
+            (x_last, loss_sum), _ = jax.lax.scan(tick, (zeros, jnp.float32(0.0)),
+                                                 jnp.arange(ticks))
+            # only the last stage holds the loss; share it with everyone
+            return jax.lax.psum(loss_sum, PIPE_AXIS) / num_micro
+
+        sm = jax.shard_map(partial(inner),
+                           mesh=mesh,
+                           in_specs=(P(PIPE_AXIS), P(), P()),
+                           out_specs=P(),
+                           axis_names={PIPE_AXIS},
+                           check_vma=False)
+        return sm(params["stages"], params["shared"], mbs)
+
+    return loss
+
+
+class PipelineEngineMixin:
+    """Accessors matching the reference PipelineEngine surface."""
+
+    def is_pipe_parallel(self) -> bool:
+        return self.grid.get_pipe_parallel_world_size() > 1
+
+    def num_stages(self) -> int:
+        return self.grid.get_pipe_parallel_world_size()
+
+    def stage_id(self) -> int:
+        return self.grid.get_stage_id()
+
+    def is_first_stage(self) -> bool:
+        return self.stage_id() == 0
+
+    def is_last_stage(self) -> bool:
+        return self.stage_id() == self.num_stages() - 1

@@ -1,0 +1,155 @@
+"""Pipeline model container: LayerSpec / PipelineModule.
+
+Counterpart of the reference's ``runtime/pipe/module.py`` (LayerSpec :29 lazy
+build, TiedLayerSpec :76, PipelineModule :85 with _partition_layers :353 —
+uniform / parameters / type:regex partitioning). The torch version instantiates
+only this rank's layers; the TPU version records the stage assignment and
+builds a *stacked* parameter layout — homogeneous blocks become one pytree
+with a leading (stage, layers_per_stage) axis that shards over the 'pipe' mesh
+axis, which is what lets the whole 1F1B loop live inside one XLA program
+(pipe/engine.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Lazy layer description (reference :29): class + ctor args, built on
+    demand so the full model never materializes on one host."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, object):
+            raise RuntimeError("LayerSpec expects a class")
+
+    def build(self, log: bool = False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({self.typename.__name__})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose parameters are shared with every other layer of the same
+    key (reference :76 — e.g. tied embeddings). On TPU, tied weights are
+    simply the same pytree leaf used twice; gradient "ReduceTiedGrads" is AD
+    summing both uses — no explicit collective needed."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Split ``weights`` into contiguous parts minimizing the heaviest part
+    (reference utils ds_utils.partition_balanced). Returns part boundaries of
+    length num_parts+1. Greedy prefix-sum bisection."""
+    weights = list(weights)
+    n = len(weights)
+    prefix = np.concatenate([[0], np.cumsum(weights)])
+    total = prefix[-1]
+
+    parts = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        idx = int(np.searchsorted(prefix, target))
+        idx = max(parts[-1] + 1, min(idx, n - (num_parts - p)))
+        parts.append(idx)
+    parts.append(n)
+    return parts
+
+
+class PipelineModule:
+    """Stage-partitioned layer container.
+
+    Args mirror the reference (:85): ``layers`` (list of LayerSpec or built
+    layer objects), ``num_stages``, ``partition_method`` ('uniform',
+    'parameters', 'type:regex'), ``loss_fn``, ``activation_checkpoint_interval``.
+
+    The built object exposes the stage assignment (``parts``,
+    ``stage_layers(stage_id)``) used both by the in-jit pipelined loss and by
+    checkpoint naming.
+    """
+
+    def __init__(self,
+                 layers: Sequence,
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 seed_layers: bool = False,
+                 base_seed: int = 1234,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0):
+        self.layer_specs = list(layers)
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        if num_stages is None and topology is None:
+            raise RuntimeError("must provide num_stages or topology")
+        if num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = int(num_stages)
+        self.parts = self._partition_layers()
+
+    # ------------------------------------------------------------ partitioning
+    def _count_layer_params(self) -> List[float]:
+        counts = []
+        for spec in self.layer_specs:
+            layer = spec.build() if isinstance(spec, LayerSpec) else spec
+            n = 0
+            if hasattr(layer, "num_params"):
+                n = layer.num_params()
+            elif hasattr(layer, "init_params"):
+                import jax
+
+                shapes = jax.eval_shape(layer.init_params, jax.random.PRNGKey(0))
+                n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+            counts.append(float(n))
+        return counts
+
+    def _partition_layers(self) -> List[int]:
+        method = self.partition_method.lower()
+        n = len(self.layer_specs)
+        if method == "uniform":
+            parts = partition_balanced([1.0] * n, self.num_stages)
+        elif method == "parameters":
+            parts = partition_balanced(self._count_layer_params(), self.num_stages)
+        elif method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            weights = [1.0 if re.search(pattern, type(s).__name__ if not isinstance(s, LayerSpec)
+                                        else s.typename.__name__, re.IGNORECASE) else 0.0
+                       for s in self.layer_specs]
+            if sum(weights) == 0:
+                raise ValueError(f"partition type:{pattern} matched no layers")
+            parts = partition_balanced(weights, self.num_stages)
+        else:
+            raise NotImplementedError(f"partition_method {self.partition_method}")
+        for s in range(self.num_stages):
+            logger.info(f"stage {s}: layers [{parts[s]}, {parts[s+1]})")
+        return parts
+
+    def stage_layers(self, stage_id: int):
+        return self.layer_specs[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    def stage_owner(self, layer_idx: int) -> int:
+        return int(np.searchsorted(np.asarray(self.parts), layer_idx, side="right") - 1)
+
+    def num_layers(self) -> int:
+        return len(self.layer_specs)
+
+    def tied_keys(self) -> List[str]:
+        return sorted({s.key for s in self.layer_specs if isinstance(s, TiedLayerSpec)})

@@ -1,0 +1,58 @@
+"""Stage-to-stage point-to-point communication.
+
+Counterpart of the reference's ``runtime/pipe/p2p.py`` (send :50, recv :71,
+send_obj/recv_obj :100/:123 via pickled byte tensors). On TPU there are no
+rank-addressed NCCL sends: neighbor exchange is ``lax.ppermute`` over the
+'pipe' mesh axis inside a traced region — one fused collective-permute riding
+ICI, covering every stage pair at once. The helpers here are the traced
+building blocks used by pipe/engine.py; the reference's shape/meta negotiation
+(_send_tensor_meta engine.py:795) has no equivalent because shapes are static
+under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+
+def send_forward(x: Any, pipe_axis: str = "pipe"):
+    """Shift activations one stage forward (i → i+1), no wraparound.
+
+    Stage 0 receives zeros. Must be called inside a shard_map manual over
+    ``pipe_axis``. Differentiable: AD transposes this into send_backward.
+    """
+    size = lax.axis_size(pipe_axis)
+    perm = [(i, i + 1) for i in range(size - 1)]
+    return jax.tree.map(lambda t: lax.ppermute(t, pipe_axis, perm), x)
+
+
+def send_backward(x: Any, pipe_axis: str = "pipe"):
+    """Shift one stage backward (i → i-1) — gradient direction."""
+    size = lax.axis_size(pipe_axis)
+    perm = [(i + 1, i) for i in range(size - 1)]
+    return jax.tree.map(lambda t: lax.ppermute(t, pipe_axis, perm), x)
+
+
+def rotate(x: Any, pipe_axis: str = "pipe", shift: int = 1):
+    """Circular shift (wraparound) — used by circular pipeline schedules."""
+    size = lax.axis_size(pipe_axis)
+    perm = [(i, (i + shift) % size) for i in range(size)]
+    return jax.tree.map(lambda t: lax.ppermute(t, pipe_axis, perm), x)
+
+
+def send_obj(obj, dst: int):
+    """Host-level python-object send (reference send_obj :100): on a
+    single-controller TPU runtime every process already has host objects;
+    cross-process transfer uses comm.broadcast_object_list."""
+    from deepspeed_tpu.comm import comm
+
+    return comm.broadcast_object_list([obj], src=comm.get_rank())[0]
+
+
+def recv_obj(src: int):
+    from deepspeed_tpu.comm import comm
+
+    return comm.broadcast_object_list([None], src=src)[0]

@@ -1,0 +1,212 @@
+"""Pipeline instruction schedules.
+
+Counterpart of the reference's ``runtime/pipe/schedule.py`` (PipeSchedule ABC
+:11, InferenceSchedule :135, TrainSchedule :189 with 1F1B ordering, instruction
+classes :327-475). On TPU the hot path executes the pipeline *inside* one XLA
+program (see pipe/engine.py) — but the declarative schedule layer is kept:
+it drives the host-driven executor variant, documents the exact 1F1B order for
+parity, and is directly unit-testable without devices (the reference tests it
+the same way, tests/unit/runtime/pipe/test_pipe_schedule.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """A step in the pipeline program. Carries arbitrary kwargs."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        if not self.kwargs:
+            return self.name
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return isinstance(other, PipeInstruction) and repr(self) == repr(other)
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Generates the instruction stream for one stage of one train batch."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    @property
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id: int) -> bool:
+        return 0 <= stage_id < self.stages
+
+    def __iter__(self):
+        return iter(self.steps())
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Fill-drain forward-only schedule (reference :135)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(micro_batch_id))
+                else:
+                    cmds.append(RecvActivation(micro_batch_id))
+                cmds.append(ForwardPass(micro_batch_id))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(micro_batch_id))
+            yield cmds
+
+    @property
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference :189): warmup forwards fill the pipe, then each stage
+    alternates one-forward-one-backward, then backwards drain. Stage s warms
+    up with min(S - s - 1, M) + 1 forwards before its first backward, which
+    bounds in-flight activations to O(S - s) instead of O(M).
+    """
+
+    def _phases(self):
+        """Yield ('fwd'|'bwd', micro_batch_id) in 1F1B order for this stage."""
+        M, S, s = self.micro_batches, self.stages, self.stage_id
+        warmup = min(S - s - 1, M)
+        for m in range(warmup):
+            yield "fwd", m
+        for m in range(M - warmup):
+            yield "fwd", warmup + m
+            yield "bwd", m
+        for m in range(M - warmup, M):
+            yield "bwd", m
+
+    def steps(self):
+        phases = list(self._phases())
+        for idx, (kind, m) in enumerate(phases):
+            cmds: List[PipeInstruction] = []
+            if kind == "fwd":
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(m))
+                else:
+                    cmds.append(RecvActivation(m))
+                cmds.append(ForwardPass(m))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(m))
+            else:
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(m))
+                cmds.append(BackwardPass(m))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(m))
+            if idx == len(phases) - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+    @property
+    def num_pipe_buffers(self) -> int:
+        """In-flight activation buffers: warmup depth + 1, min 2."""
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference :301)."""
+
+    def steps(self):
+        for micro_batch_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(micro_batch_id), ForwardPass(micro_batch_id),
+                    BackwardPass(micro_batch_id)]
+            if micro_batch_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    @property
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 != 0

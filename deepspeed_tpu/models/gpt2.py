@@ -45,8 +45,9 @@ class GPT2Config:
     remat: Any = True
     use_flash_attention: bool = True
     tie_embeddings: bool = True
-    # sequence-parallel: shard activations over the 'seq' axis (ring attention)
-    sequence_parallel: bool = False
+    # sequence parallelism over the 'seq' mesh axis: False | 'ring' | 'ulysses'
+    # (parallel/sequence.py — long-context support beyond the reference)
+    sequence_parallel: Any = False
 
     @property
     def head_dim(self) -> int:
@@ -157,6 +158,20 @@ class GPT2Model:
 
     def _attention(self, q, k, v):
         """q,k,v: (B, T, H, Dh). Causal self-attention."""
+        c = self.config
+        if c.sequence_parallel:
+            from deepspeed_tpu.comm import comm
+            from deepspeed_tpu.parallel import sequence as seq_par
+
+            mesh = comm.get_mesh()
+            if mesh.shape.get("seq", 1) > 1:
+                if c.sequence_parallel == "ulysses":
+                    return seq_par.ulysses_attention(
+                        lambda q, k, v: self._attention_local(q, k, v), q, k, v, mesh)
+                return seq_par.ring_attention(q, k, v, mesh, causal=True)
+        return self._attention_local(q, k, v)
+
+    def _attention_local(self, q, k, v):
         c = self.config
         if c.use_flash_attention:
             try:

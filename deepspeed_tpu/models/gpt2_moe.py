@@ -1,0 +1,102 @@
+"""Switch-Transformer-style MoE GPT-2.
+
+The BASELINE milestone config "Switch-Transformer 8-expert MoE (a2a over ICI)".
+Every other block's dense MLP is replaced by a top-1-gated expert bank
+(reference role: deepspeed/moe applied to Megatron GPT, cf.
+docs/_posts/2021-12-09-deepspeed-moe-nlg.md). Expert weights shard over the
+'expert' mesh axis; the rest of the model is the plain GPT-2.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.moe.layer import MoE
+
+
+class MoEGPT2(GPT2Model):
+    """GPT-2 with MoE MLPs on odd blocks (0-indexed: 1, 3, ...)."""
+
+    def __init__(self, config: GPT2Config, num_experts: int = 8, ep_size: int = 1,
+                 k: int = 1, capacity_factor: float = 1.25, aux_loss_coef: float = 0.01):
+        super().__init__(config)
+        self.moe = MoE(hidden_size=config.n_embd, num_experts=num_experts,
+                       ep_size=ep_size, k=k, capacity_factor=capacity_factor)
+        self.aux_loss_coef = aux_loss_coef
+        self.moe_every = 2
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        k1, k2 = jax.random.split(rng)
+        params = super().init_params(k1)
+        n_moe = self.config.n_layer // self.moe_every
+        keys = jax.random.split(k2, n_moe)
+        moe_params = [self.moe.init_params(k) for k in keys]
+        # stack over the moe-layer dim (scanned separately from dense blocks)
+        params["moe"] = jax.tree.map(lambda *xs: jnp.stack(xs), *moe_params)
+        return params
+
+    def param_partition_specs(self) -> Dict[str, Any]:
+        specs = super().param_partition_specs()
+        moe_spec = self.moe.param_partition_specs()
+        # add the stacked moe-layer leading dim (never sharded)
+        specs["moe"] = jax.tree.map(
+            lambda s: P(None, *tuple(s)), moe_spec, is_leaf=lambda x: isinstance(x, P))
+        return specs
+
+    def loss(self, params, batch, rng=None):
+        """Cross-entropy + load-balance aux loss."""
+        if isinstance(batch, dict):
+            ids = batch["input_ids"]
+            labels = batch.get("labels", ids)
+        else:
+            ids, labels = batch, batch
+        c = self.config
+        B, T = ids.shape
+        x = params["wte"].astype(c.dtype)[ids] + params["wpe"].astype(c.dtype)[:T]
+
+        # interleave dense blocks and MoE MLP blocks without python-loop
+        # unrolling of the dense part: scan pairs of (dense block, moe layer)
+        blocks = params["blocks"]
+        n_pairs = c.n_layer // self.moe_every
+
+        def pair_body(carry, xs):
+            x, aux = carry
+            pair_blocks, moe_p = xs
+            # dense block 0 of the pair
+            b0 = jax.tree.map(lambda t: t[0], pair_blocks)
+            x = self._block(x, b0, None)
+            # block 1: attention part of the dense block, MoE as its MLP
+            b1 = jax.tree.map(lambda t: t[1], pair_blocks)
+            x = self._attn_sublayer(x, b1)
+            h = self._layer_norm(x, b1["ln2_g"], b1["ln2_b"])
+            moe_out, l_aux = self.moe(moe_p, h, rng, train=True)
+            x = x + moe_out
+            return (x, aux + l_aux), None
+
+        paired = jax.tree.map(
+            lambda t: t.reshape((n_pairs, self.moe_every) + t.shape[1:]), blocks)
+        (x, aux), _ = jax.lax.scan(pair_body, (x, jnp.float32(0.0)),
+                                   (paired, params["moe"]))
+        x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])[:, :-1]
+        head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
+        logits = (x @ head).astype(jnp.float32)
+        targets = labels[:, 1:]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        ce = jnp.mean(lse - tgt)
+        return ce + self.aux_loss_coef * aux / n_pairs
+
+    def _attn_sublayer(self, x, blk):
+        c = self.config
+        B, T, D = x.shape
+        h = self._layer_norm(x, blk["ln1_g"], blk["ln1_b"])
+        qkv = h @ blk["qkv_w"].astype(h.dtype) + blk["qkv_b"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, T, c.n_head, c.head_dim)
+        attn = self._attention(to_heads(q), to_heads(k), to_heads(v)).reshape(B, T, D)
+        return x + attn @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype)

@@ -1,0 +1,5 @@
+from deepspeed_tpu.moe.experts import Experts
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.moe.sharded_moe import MOELayer, TopKGate, top1gating, top2gating
+
+__all__ = ["MoE", "Experts", "MOELayer", "TopKGate", "top1gating", "top2gating"]

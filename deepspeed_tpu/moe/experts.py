@@ -1,0 +1,43 @@
+"""Expert bank: stacked FFN experts.
+
+Counterpart of the reference's ``deepspeed/moe/experts.py`` (Experts :10 — a
+python loop over this rank's local expert modules). TPU-native: ALL experts
+live in one stacked pytree with leading dim E sharded over the 'expert' mesh
+axis; application is a vmap, so each device runs only its local experts and
+the "loop" is a batched matmul on the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class Experts:
+    """E stacked 2-layer FFN experts (the standard MoE expert)."""
+
+    def __init__(self, num_experts: int, model_dim: int, hidden_dim: int,
+                 activation: Callable = jax.nn.gelu):
+        self.num_experts = num_experts
+        self.model_dim = model_dim
+        self.hidden_dim = hidden_dim
+        self.activation = activation
+
+    def init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        E, D, H = self.num_experts, self.model_dim, self.hidden_dim
+        return {
+            "wi": jax.random.normal(k1, (E, D, H), jnp.float32) / math.sqrt(D),
+            "bi": jnp.zeros((E, H), jnp.float32),
+            "wo": jax.random.normal(k2, (E, H, D), jnp.float32) / math.sqrt(H),
+            "bo": jnp.zeros((E, D), jnp.float32),
+        }
+
+    def apply_one(self, params, x):
+        """One expert's params (D,H)/(H,)/(H,D)/(D,) on tokens (C, D)."""
+        h = x @ params["wi"].astype(x.dtype) + params["bi"].astype(x.dtype)
+        h = self.activation(h)
+        return h @ params["wo"].astype(x.dtype) + params["bo"].astype(x.dtype)

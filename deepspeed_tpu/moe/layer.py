@@ -1,0 +1,84 @@
+"""MoE layer — the user-facing module.
+
+Counterpart of the reference's ``deepspeed/moe/layer.py`` (MoE :16 — wraps
+TopKGate + Experts + optional residual MLP, creates expert/data process groups
+:85). On TPU the "process groups" are the mesh's 'expert' axis; ep_size is the
+axis size, and num_experts % ep_size experts live on each of its slices.
+Residual-MoE (DeepSpeed-MoE paper) is supported: out = mlp(x) + coef·moe(x).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.moe.experts import Experts
+from deepspeed_tpu.moe.sharded_moe import MOELayer, TopKGate
+from deepspeed_tpu.parallel.topology import EXPERT_AXIS
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class MoE:
+    def __init__(self,
+                 hidden_size: int,
+                 expert: Optional[Any] = None,
+                 num_experts: int = 1,
+                 ep_size: int = 1,
+                 k: int = 1,
+                 capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4,
+                 use_residual: bool = False,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True,
+                 expert_hidden: Optional[int] = None,
+                 activation: Callable = jax.nn.gelu):
+        if num_experts % max(1, ep_size) != 0:
+            raise ValueError(f"num_experts {num_experts} must divide by ep_size {ep_size}")
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.ep_size = ep_size
+        self.use_residual = use_residual
+        self.experts = expert or Experts(num_experts, hidden_size,
+                                         expert_hidden or 4 * hidden_size, activation)
+        self.gate = TopKGate(hidden_size, num_experts, k, capacity_factor,
+                             eval_capacity_factor, min_capacity, noisy_gate_policy,
+                             drop_tokens)
+        self.moe_layer = MOELayer(self.gate, self.experts.apply_one, num_experts)
+        log_dist(f"MoE layer: {num_experts} experts, ep_size={ep_size}, top-{k}", ranks=[0])
+
+    def init_params(self, rng):
+        kg, ke, kr = jax.random.split(rng, 3)
+        params = {"gate": self.gate.init_params(kg),
+                  "experts": self.experts.init_params(ke)}
+        if self.use_residual:
+            res = Experts(1, self.hidden_size, 4 * self.hidden_size)
+            params["residual"] = jax.tree.map(lambda x: x[0], res.init_params(kr))
+            params["coefficient"] = jnp.zeros((self.hidden_size, 2), jnp.float32)
+        return params
+
+    def param_partition_specs(self):
+        specs = {
+            "gate": {"wg": P()},
+            "experts": {"wi": P(EXPERT_AXIS, None, None), "bi": P(EXPERT_AXIS, None),
+                        "wo": P(EXPERT_AXIS, None, None), "bo": P(EXPERT_AXIS, None)},
+        }
+        if self.use_residual:
+            specs["residual"] = {"wi": P(), "bi": P(), "wo": P(), "bo": P()}
+            specs["coefficient"] = P()
+        return specs
+
+    def __call__(self, params, x, rng=None, train: bool = True
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """x: (..., hidden) → (out, l_aux)."""
+        out, l_aux = self.moe_layer(params["gate"], params["experts"], x, rng, train)
+        if self.use_residual:
+            mlp_out = self.experts.apply_one(params["residual"], x.reshape(-1, x.shape[-1]))
+            mlp_out = mlp_out.reshape(x.shape)
+            coef = jax.nn.softmax(
+                x.astype(jnp.float32) @ params["coefficient"], axis=-1)
+            out = out * coef[..., 0:1].astype(x.dtype) + mlp_out * coef[..., 1:2].astype(x.dtype)
+        return out, l_aux

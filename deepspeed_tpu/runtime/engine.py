@@ -455,7 +455,9 @@ class DeepSpeedEngine:
         multihost = jax.process_count() > 1
 
         def put(x):
-            spec = P(*(tuple(self.plan.batch_spec) + (None,) * (np.asarray(x).ndim - len(tuple(self.plan.batch_spec)))))
+            ndim = np.asarray(x).ndim
+            entries = tuple(self.plan.batch_spec)[:ndim]
+            spec = P(*(entries + (None,) * (ndim - len(entries))))
             sh = NamedSharding(self.mesh, spec)
             if hasattr(x, "sharding") and x.sharding == sh:
                 return x

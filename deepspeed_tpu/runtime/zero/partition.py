@@ -193,7 +193,11 @@ def plan_sharding(param_shapes: Any,
 
     if batch_spec is None:
         batch_axes = tuple(a for a in (DATA_AXIS, EXPERT_AXIS) if mesh.shape.get(a, 1) > 1)
-        batch_spec = P(batch_axes if batch_axes else None)
+        if mesh.shape.get(SEQ_AXIS, 1) > 1:
+            # sequence parallelism: tokens dim sharded over 'seq' too
+            batch_spec = P(batch_axes if batch_axes else None, SEQ_AXIS)
+        else:
+            batch_spec = P(batch_axes if batch_axes else None)
 
     plan = ShardingPlan(mesh=mesh, param_specs=param_specs, master_specs=master_specs,
                         grad_specs=grad_specs, batch_spec=batch_spec, zero_stage=stage,

@@ -1,0 +1,98 @@
+"""Expert/data/model parallel group arithmetic.
+
+Counterpart of the reference's ``deepspeed/utils/groups.py`` (initialize :46,
+_create_expert_and_data_parallel :108, _get_expert_parallel_ranks :156,
+_create_expert_data_and_model_parallel :202, accessors :259-392). On TPU,
+groups are mesh-axis slices — no process-group objects to create — but the
+rank-list math is kept (pure python) because checkpoint sharding, debugging,
+and the host-driven tools still reason in flat ranks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+_EXPERT_PARALLEL_GROUP: Dict[str, List[List[int]]] = {}
+_EXPERT_DATA_PARALLEL_GROUP: Dict[str, List[List[int]]] = {}
+_WORLD_SIZE: Optional[int] = None
+_EP_SIZE: Optional[int] = None
+
+
+def _get_expert_parallel_ranks(world_size: int, model_parallel_size: int,
+                               expert_parallel_size: int):
+    """Rank lists for EP and expert-DP groups (reference :156).
+
+    With W ranks, MP size m and EP size e: DP world = W/m; expert-parallel
+    groups are e-sized strided slices of each DP group; expert-data-parallel
+    groups tie together the same expert shard across DP replicas.
+
+    Example W=16, m=2, e=4 (matches the reference docstring example):
+      EP:  [0,2,4,6], [8,10,12,14], [1,3,5,7], [9,11,13,15]
+      EDP: [0,8], [2,10], [4,12], [6,14], [1,9], [3,11], [5,13], [7,15]
+    """
+    dp_world_size = world_size // model_parallel_size
+    expert_parallel_groups = []
+    expert_data_parallel_groups = []
+
+    # DP groups: same position within each MP group
+    data_parallel_groups = [list(range(mp, world_size, model_parallel_size))
+                            for mp in range(model_parallel_size)]
+    for dp_ranks in data_parallel_groups:
+        # chunk each dp group into ep-sized contiguous runs (stride = mp size)
+        for i in range(0, dp_world_size, expert_parallel_size):
+            expert_parallel_groups.append(dp_ranks[i:i + expert_parallel_size])
+        # expert-dp: same offset across the chunks
+        for i in range(expert_parallel_size):
+            expert_data_parallel_groups.append(dp_ranks[i::expert_parallel_size])
+    return expert_parallel_groups, expert_data_parallel_groups
+
+
+def initialize(ep_size: int = 1, mpu=None, world_size: Optional[int] = None,
+               model_parallel_size: int = 1):
+    """Record EP topology (reference initialize :46). On TPU this is
+    bookkeeping only — the mesh already encodes it."""
+    global _WORLD_SIZE, _EP_SIZE
+    import jax
+
+    world_size = world_size or jax.device_count()
+    if mpu is not None and hasattr(mpu, "get_model_parallel_world_size"):
+        model_parallel_size = mpu.get_model_parallel_world_size()
+    if world_size % (ep_size * model_parallel_size) != 0:
+        raise ValueError(f"world {world_size} not divisible by ep {ep_size} × mp {model_parallel_size}")
+    _WORLD_SIZE, _EP_SIZE = world_size, ep_size
+    ep, edp = _get_expert_parallel_ranks(world_size, model_parallel_size, ep_size)
+    name = f"ep_size_{ep_size}"
+    _EXPERT_PARALLEL_GROUP[name] = ep
+    _EXPERT_DATA_PARALLEL_GROUP[name] = edp
+    log_dist(f"expert groups initialized: ep_size={ep_size}, {len(ep)} EP groups", ranks=[0])
+    return ep, edp
+
+
+def _get(group_dict, group_name):
+    if group_name not in group_dict:
+        raise KeyError(f"expert group {group_name} not initialized — call groups.initialize()")
+    return group_dict[group_name]
+
+
+def get_expert_parallel_group(group_name: str):
+    return _get(_EXPERT_PARALLEL_GROUP, group_name)
+
+
+def get_expert_data_parallel_group(group_name: str):
+    return _get(_EXPERT_DATA_PARALLEL_GROUP, group_name)
+
+
+def get_expert_parallel_world_size(group_name: Optional[str] = None) -> int:
+    return _EP_SIZE or 1
+
+
+def get_max_expert_size() -> int:
+    return _EP_SIZE or 1
+
+
+def get_data_parallel_world_size() -> int:
+    import jax
+
+    return _WORLD_SIZE or jax.device_count()

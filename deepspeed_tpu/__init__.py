@@ -73,11 +73,12 @@ def init_inference(model=None, config=None, **kwargs):
         raise NotImplementedError(
             "deepspeed_tpu.inference is not available in this build yet") from e
 
+    engine_kwargs = {k: kwargs.pop(k) for k in ("params", "mesh") if k in kwargs}
     if config is None:
         config = {}
     if isinstance(config, dict):
         config = DeepSpeedInferenceConfig(**{**config, **kwargs})
-    return InferenceEngine(model, config)
+    return InferenceEngine(model, config, **engine_kwargs)
 
 
 def add_config_arguments(parser):

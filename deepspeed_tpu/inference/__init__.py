@@ -1,0 +1,4 @@
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+
+__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine"]

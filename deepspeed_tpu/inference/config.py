@@ -1,0 +1,104 @@
+"""Inference configuration.
+
+Key-compatible with the reference's ``deepspeed/inference/config.py``
+(DeepSpeedInferenceConfig :126, with tp/moe/quant sub-configs :47-123,
+replace_with_kernel_inject :129, max_out_tokens :246). CUDA-graph knobs are
+accepted and ignored (XLA compiles the whole decode loop; there is nothing to
+capture).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    tp_size: int = Field(1, ge=1)
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = [1]
+    type: str = "standard"
+
+
+class QuantTypeEnum:
+    asym = "asymmetric"
+    sym = "symmetric"
+
+
+class BaseQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    num_bits: int = 8
+    q_type: str = QuantTypeEnum.sym
+    q_groups: int = 1
+
+
+class WeightQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+    quantized_initialization: Dict = {}
+    post_init_quant: Dict = {}
+
+
+class ActivationQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+
+
+class QKVQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    activation: ActivationQuantConfig = {}
+    weight: WeightQuantConfig = {}
+    qkv: QKVQuantConfig = {}
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = Field({}, alias="tp")
+    enable_cuda_graph: bool = False  # accepted, meaningless on TPU
+    use_triton: bool = False
+    zero: Dict = {}
+    triangular_masking: bool = Field(True, alias="tm")
+    moe: DeepSpeedMoEConfig = {}
+    quant: QuantizationConfig = {}
+    checkpoint: Optional[str] = None
+    base_dir: str = ""
+    set_empty_params: bool = False
+    save_mp_checkpoint_path: Optional[str] = None
+    checkpoint_config: Dict = Field({}, alias="ckpt_config")
+    return_tuple: bool = True
+    training_mp_size: int = 1
+    replace_method: str = Field("auto", json_schema_extra={"deprecated": True})
+    injection_policy: Optional[Dict] = Field(None, alias="injection_dict")
+    injection_policy_tuple: Optional[tuple] = None
+    config: Optional[Dict] = None
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    transposed_mode: bool = False
+    mp_size: int = Field(1, json_schema_extra={
+        "deprecated": True, "new_param": "tensor_parallel",
+        "new_param_fn": lambda v: DeepSpeedTPConfig(tp_size=v)})
+
+    @property
+    def tp_size(self) -> int:
+        return self.tensor_parallel.tp_size
+
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+
+        return {"float32": jnp.float32, "fp32": jnp.float32,
+                "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                "int8": jnp.int8}[str(self.dtype).replace("torch.", "")]

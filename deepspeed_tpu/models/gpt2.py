@@ -296,6 +296,102 @@ class GPT2Model:
         return jnp.mean(nll)
 
 
+    # ------------------------------------------------------------- inference
+    def init_cache(self, batch_size: int, max_len: int):
+        """KV cache: (L, B, max_len, H, Dh) per k/v, plus current length.
+        The TPU counterpart of the reference's InferenceContext KV workspace
+        (csrc/transformer/inference/includes/inference_context.h:287)."""
+        c = self.config
+        shape = (c.n_layer, batch_size, max_len, c.n_head, c.head_dim)
+        return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    def cache_partition_specs(self):
+        return {"k": P(None, None, None, "tensor", None),
+                "v": P(None, None, None, "tensor", None),
+                "pos": P()}
+
+    def _block_kv(self, x, blk):
+        """One block's q,k,v for the current x (no attention yet)."""
+        c = self.config
+        B, T, D = x.shape
+        h = self._layer_norm(x, blk["ln1_g"], blk["ln1_b"])
+        qkv = h @ blk["qkv_w"].astype(h.dtype) + blk["qkv_b"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, T, c.n_head, c.head_dim)
+        return to_heads(q), to_heads(k), to_heads(v)
+
+    def _block_finish(self, x, blk, attn):
+        B, T, D = x.shape
+        x = x + attn.reshape(B, T, D) @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype)
+        h = self._layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+        h = h @ blk["fc_w"].astype(h.dtype) + blk["fc_b"].astype(h.dtype)
+        h = jax.nn.gelu(h)
+        return x + h @ blk["fc2_w"].astype(x.dtype) + blk["fc2_b"].astype(x.dtype)
+
+    def prefill(self, params, input_ids, cache):
+        """Process the prompt, fill the cache, return last-position logits."""
+        c = self.config
+        B, T = input_ids.shape
+        max_len = cache["k"].shape[2]
+        x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[:T]
+
+        def body(carry, xs):
+            x = carry
+            blk, li = xs
+            q, k, v = self._block_kv(x, blk)
+            attn = self._attention_local(q, k, v)
+            x = self._block_finish(x, blk, attn)
+            k_pad = jnp.zeros((B, max_len, c.n_head, c.head_dim), c.dtype)
+            k_pad = jax.lax.dynamic_update_slice(k_pad, k, (0, 0, 0, 0))
+            v_pad = jnp.zeros((B, max_len, c.n_head, c.head_dim), c.dtype)
+            v_pad = jax.lax.dynamic_update_slice(v_pad, v, (0, 0, 0, 0))
+            return x, (k_pad, v_pad)
+
+        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], jnp.arange(c.n_layer)))
+        x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
+        head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
+        logits = (x[:, -1] @ head).astype(jnp.float32)
+        cache = {"k": ks, "v": vs, "pos": jnp.int32(T)}
+        return logits, cache
+
+    def decode_step(self, params, token, cache):
+        """One token for every sequence: (B,) → logits (B, V), cache advanced.
+        The jitted equivalent of the reference's per-token softmax_context
+        path (csrc/transformer/inference/pt_binding.cpp qkv_gemm_/softmax_context_)."""
+        c = self.config
+        B = token.shape[0]
+        pos = cache["pos"]
+        max_len = cache["k"].shape[2]
+        x = params["wte"].astype(c.dtype)[token][:, None]  # (B, 1, D)
+        x = x + jax.lax.dynamic_slice_in_dim(params["wpe"].astype(c.dtype), pos, 1, 0)[None]
+
+        valid = (jnp.arange(max_len) <= pos)[None, None, None, :]  # (1,1,1,T)
+
+        def body(carry, xs):
+            x = carry
+            blk, k_cache, v_cache = xs
+            q, k, v = self._block_kv(x, blk)           # (B, 1, H, Dh)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+            scale = 1.0 / math.sqrt(c.head_dim)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32) * scale
+            logits = jnp.where(valid, logits, NEG_INF_ATTN)
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+            x = self._block_finish(x, blk, attn)
+            return x, (k_cache, v_cache)
+
+        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+        x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
+        head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
+        logits = (x[:, 0] @ head).astype(jnp.float32)
+        return logits, {"k": ks, "v": vs, "pos": pos + 1}
+
+
+NEG_INF_ATTN = -1e30
+
+
 def synthetic_lm_batch(batch_size: int, seq_len: int, vocab_size: int, seed: int = 0):
     rng = np.random.default_rng(seed)
     return {"input_ids": rng.integers(0, vocab_size, size=(batch_size, seq_len), dtype=np.int32)}

@@ -1,0 +1,3 @@
+from deepspeed_tpu.module_inject.auto_tp import AutoTP, ReplaceWithTensorSlicing, apply_tp
+
+__all__ = ["AutoTP", "ReplaceWithTensorSlicing", "apply_tp"]

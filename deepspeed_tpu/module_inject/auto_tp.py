@@ -1,0 +1,115 @@
+"""AutoTP — automatic tensor-parallel sharding-spec inference.
+
+Counterpart of the reference's ``deepspeed/module_inject/auto_tp.py``
+(AutoTP :13: walks an nn.Module, classifies each Linear as column- or
+row-parallel, swaps in LinearLayer/LinearAllreduce, module_inject/layers.py:15).
+On TPU "replacing a module" is assigning a PartitionSpec: column-parallel =
+output dim over 'tensor', row-parallel = input dim over 'tensor' (GSPMD then
+inserts the per-layer psum that LinearAllreduce hand-codes).
+
+Classification is name-pattern based over the flattened param tree — the same
+signal the reference uses (its policy containers key on submodule names,
+module_inject/containers/). Works for HF Flax param trees and native models.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import TENSOR_AXIS
+from deepspeed_tpu.utils.logging import logger
+
+# row-parallel (input-dim sharded, output psum) — attention output and MLP
+# down projections across the model zoo (cf. reference policy containers:
+# bert/bloom/gpt2/gptj/gptneo/gptneox/llama/megatron/opt):
+ROW_PATTERNS = [
+    r"attn.*(c_proj|o_proj|out_proj|dense\b)", r"attention\.output",
+    r"self_attention\.dense", r"(mlp|ffn).*(c_proj|down_proj|fc2|dense_4h_to_h|w2|wo)\b",
+    r"output\.dense",
+]
+# column-parallel (output-dim sharded):
+COL_PATTERNS = [
+    r"(c_attn|q_proj|k_proj|v_proj|qkv|query|key|value|query_key_value)",
+    r"(mlp|ffn).*(c_fc|up_proj|gate_proj|fc1|dense_h_to_4h|w1|w3|wi)\b",
+    r"intermediate\.dense", r"lm_head", r"embed_out",
+]
+# vocab-sharded embeddings:
+EMBED_PATTERNS = [r"(wte|word_embeddings|embed_tokens|tok_embeddings)\b"]
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+                    for p in path).lower()
+
+
+def _matches(path: str, patterns) -> bool:
+    return any(re.search(pat, path) for pat in patterns)
+
+
+class AutoTP:
+    @staticmethod
+    def infer_specs(param_shapes: Any, policy: Optional[Dict] = None,
+                    tensor_axis: str = TENSOR_AXIS) -> Any:
+        """param pytree (ShapeDtypeStructs or arrays) → PartitionSpec pytree.
+
+        ``policy`` (the reference's injection_policy dict analogue) maps
+        regex → 'row' | 'column' | 'replicate' and takes precedence.
+        """
+        flat, treedef = jax.tree_util.tree_flatten_with_path(param_shapes)
+        specs = []
+        n_col = n_row = 0
+        for path, leaf in flat:
+            p = _path_str(path)
+            ndim = len(leaf.shape)
+            spec = P()
+            cls = None
+            if policy:
+                for pat, kind in policy.items():
+                    if re.search(str(pat).lower(), p):
+                        cls = kind
+                        break
+            if cls is None:
+                if _matches(p, ROW_PATTERNS):
+                    cls = "row"
+                elif ndim >= 2 and (_matches(p, COL_PATTERNS) or _matches(p, EMBED_PATTERNS)):
+                    cls = "column" if not _matches(p, EMBED_PATTERNS) else "embed"
+            if ndim >= 2 and ("kernel" in p or "weight" in p or cls):
+                if cls == "row":
+                    spec = P(*([None] * (ndim - 2) + [tensor_axis, None]))
+                    n_row += 1
+                elif cls == "column":
+                    spec = P(*([None] * (ndim - 1) + [tensor_axis]))
+                    n_col += 1
+                elif cls == "embed":
+                    spec = P(*([tensor_axis] + [None] * (ndim - 1)))
+            elif ndim == 1 and cls == "column":
+                spec = P(tensor_axis)
+            specs.append(spec)
+        logger.info(f"AutoTP: {n_col} column-parallel, {n_row} row-parallel tensors")
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+class ReplaceWithTensorSlicing:
+    """Weight-slicing helper parity (reference replace_module.py:31). On TPU
+    jax.device_put with a NamedSharding IS the slicing; kept for API shape."""
+
+    def __init__(self, mp_group=None, mp_size: int = 1, out_dim: int = 1, in_dim: int = 0):
+        self.mp_size = mp_size
+
+    def merge_assert(self, dim1, dim2):
+        assert dim1 > dim2
+
+
+def apply_tp(params: Any, mesh, policy: Optional[Dict] = None) -> Any:
+    """Shard a concrete param tree over the tensor axis (device_put)."""
+    from jax.sharding import NamedSharding
+
+    specs = AutoTP.infer_specs(jax.eval_shape(lambda: params), policy=policy)
+    sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                      is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(params, sh)

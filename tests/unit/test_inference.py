@@ -1,0 +1,111 @@
+"""Inference tests (reference: tests/unit/inference/test_inference.py sweeps
+models × dtype × injection; here: KV-cache decode == full forward, generate
+determinism, TP sharding, AutoTP classification)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model, synthetic_lm_batch
+from deepspeed_tpu.module_inject.auto_tp import AutoTP
+
+TINY = GPT2Config(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=4,
+                  dtype=jnp.float32, remat=False, use_flash_attention=False)
+
+
+def test_prefill_decode_matches_full_forward():
+    """Incremental decode must reproduce teacher-forced logits exactly."""
+    model = GPT2Model(TINY)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ids = jnp.asarray(synthetic_lm_batch(2, 16, TINY.vocab_size)["input_ids"])
+
+    full_logits = model.apply(params, ids)  # (B, T, V)
+
+    cache = model.init_cache(2, 32)
+    logits_p, cache = model.prefill(params, ids[:, :8], cache)
+    np.testing.assert_allclose(np.asarray(logits_p), np.asarray(full_logits[:, 7]),
+                               rtol=1e-4, atol=1e-4)
+    # feed the true next tokens one by one
+    for t in range(8, 16):
+        logits_d, cache = model.decode_step(params, ids[:, t], cache)
+        np.testing.assert_allclose(np.asarray(logits_d), np.asarray(full_logits[:, t]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_generate_greedy():
+    comm.cdb = None
+    model = GPT2Model(TINY)
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "float32",
+                                                         "max_out_tokens": 128})
+    prompt = np.asarray(synthetic_lm_batch(2, 8, TINY.vocab_size)["input_ids"])
+    out = engine.generate(prompt, max_new_tokens=8)
+    assert out.shape == (2, 16)
+    out2 = engine.generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))  # greedy = deterministic
+    np.testing.assert_array_equal(np.asarray(out[:, :8]), prompt)
+
+
+def test_generate_sampling_respects_seed():
+    comm.cdb = None
+    model = GPT2Model(TINY)
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "float32",
+                                                         "max_out_tokens": 128})
+    prompt = np.asarray(synthetic_lm_batch(1, 4, TINY.vocab_size)["input_ids"])
+    a = engine.generate(prompt, max_new_tokens=6, do_sample=True, temperature=1.0, seed=1)
+    b = engine.generate(prompt, max_new_tokens=6, do_sample=True, temperature=1.0, seed=1)
+    c = engine.generate(prompt, max_new_tokens=6, do_sample=True, temperature=1.0, seed=2)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_inference_tp2_matches_tp1():
+    comm.cdb = None
+    model = GPT2Model(TINY)
+    params = model.init_params(jax.random.PRNGKey(0))
+    e1 = deepspeed_tpu.init_inference(model, config={"dtype": "float32",
+                                                     "max_out_tokens": 128}, params=params)
+    prompt = np.asarray(synthetic_lm_batch(2, 8, TINY.vocab_size)["input_ids"])
+    out1 = np.asarray(e1.generate(prompt, max_new_tokens=8))
+
+    comm.cdb = None
+    e2 = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "tensor_parallel": {"tp_size": 2},
+                       "max_out_tokens": 128}, params=params)
+    assert e2.mp_world_size == 2
+    qkv = e2.params["blocks"]["qkv_w"]
+    assert qkv.addressable_shards[0].data.shape[-1] == qkv.shape[-1] // 2
+    out2 = np.asarray(e2.generate(prompt, max_new_tokens=8))
+    np.testing.assert_array_equal(out1, out2)
+
+
+def test_max_out_tokens_guard():
+    comm.cdb = None
+    model = GPT2Model(TINY)
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "float32",
+                                                         "max_out_tokens": 16})
+    prompt = np.asarray(synthetic_lm_batch(1, 8, TINY.vocab_size)["input_ids"])
+    with pytest.raises(ValueError):
+        engine.generate(prompt, max_new_tokens=32)
+
+
+def test_autotp_classifies_hf_style_tree():
+    shapes = {
+        "transformer": {
+            "h": {"0": {
+                "attn": {"c_attn": {"kernel": jax.ShapeDtypeStruct((64, 192), jnp.float32)},
+                         "c_proj": {"kernel": jax.ShapeDtypeStruct((64, 64), jnp.float32)}},
+                "mlp": {"c_fc": {"kernel": jax.ShapeDtypeStruct((64, 256), jnp.float32)},
+                        "c_proj": {"kernel": jax.ShapeDtypeStruct((256, 64), jnp.float32)}},
+            }},
+            "wte": {"embedding": jax.ShapeDtypeStruct((512, 64), jnp.float32)},
+        }
+    }
+    specs = AutoTP.infer_specs(shapes)
+    h0 = specs["transformer"]["h"]["0"]
+    assert h0["attn"]["c_attn"]["kernel"] == jax.sharding.PartitionSpec(None, "tensor")
+    assert h0["attn"]["c_proj"]["kernel"] == jax.sharding.PartitionSpec("tensor", None)
+    assert h0["mlp"]["c_fc"]["kernel"] == jax.sharding.PartitionSpec(None, "tensor")
+    assert h0["mlp"]["c_proj"]["kernel"] == jax.sharding.PartitionSpec("tensor", None)

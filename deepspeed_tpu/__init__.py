@@ -78,6 +78,9 @@ def init_inference(model=None, config=None, **kwargs):
         config = {}
     if isinstance(config, dict):
         config = DeepSpeedInferenceConfig(**{**config, **kwargs})
+    elif kwargs:
+        # merge stray kwargs into an already-built config (reference behavior)
+        config = DeepSpeedInferenceConfig(**{**config.model_dump(), **kwargs})
     return InferenceEngine(model, config, **engine_kwargs)
 
 

@@ -1,0 +1,89 @@
+"""Collective micro-benchmark — the ``ds_bench`` tool.
+
+Counterpart of reference ``bin/ds_bench`` (communication sweep over message
+sizes printing latency and algorithm/bus bandwidth). Runs each collective
+through the deepspeed_tpu.comm API on the live mesh, sweeping power-of-two
+payloads, and reports algbw plus the NCCL-convention busbw correction
+(all_reduce ×2(n-1)/n, all_gather/reduce_scatter ×(n-1)/n, all_to_all ×(n-1)/n).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bus_factor(op: str, n: int) -> float:
+    if n <= 1:
+        return 1.0
+    if op == "all_reduce":
+        return 2.0 * (n - 1) / n
+    return (n - 1) / n
+
+
+def run_sweep(op: str = "all_reduce", min_bytes: int = 1 << 10, max_bytes: int = 1 << 26,
+              trials: int = 5, warmups: int = 2, dtype=jnp.bfloat16, mesh=None):
+    from deepspeed_tpu.comm import comm as dist
+
+    if not dist.is_initialized():
+        dist.init_distributed(verbose=False)
+    mesh = mesh or dist.get_mesh()
+    world = dist.get_world_size()
+    itemsize = jnp.dtype(dtype).itemsize
+
+    ops: Dict[str, Callable] = {
+        "all_reduce": lambda x: dist.all_reduce(x),
+        "all_gather": lambda x: dist.all_gather(x),
+        "reduce_scatter": lambda x: dist.reduce_scatter(x),
+        "all_to_all": lambda x: dist.all_to_all_single(x),
+    }
+    if op not in ops:
+        raise ValueError(f"unknown op {op!r}; choices {sorted(ops)}")
+    fn = ops[op]
+
+    results = []
+    size = min_bytes
+    while size <= max_bytes:
+        # eager comm convention: leading dim enumerates group members
+        per_member = max(1, size // itemsize // world)
+        n_elem = per_member * world
+        x = jnp.zeros((world, per_member), dtype)
+        for _ in range(warmups):
+            jax.block_until_ready(fn(x))
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            jax.block_until_ready(fn(x))
+        dt = (time.perf_counter() - t0) / trials
+        nbytes = n_elem * itemsize
+        algbw = nbytes / dt / 1e9
+        busbw = algbw * _bus_factor(op, world)
+        results.append(dict(op=op, bytes=nbytes, latency_us=dt * 1e6,
+                            algbw_gbps=algbw, busbw_gbps=busbw))
+        size *= 4
+    return results
+
+
+def main(args=None):
+    p = argparse.ArgumentParser(description="deepspeed_tpu collective benchmark")
+    p.add_argument("--op", default="all_reduce",
+                   choices=["all_reduce", "all_gather", "reduce_scatter", "all_to_all", "all"])
+    p.add_argument("--min-bytes", type=int, default=1 << 10)
+    p.add_argument("--max-bytes", type=int, default=1 << 26)
+    p.add_argument("--trials", type=int, default=5)
+    ns = p.parse_args(args)
+    ops = ["all_reduce", "all_gather", "reduce_scatter", "all_to_all"] if ns.op == "all" else [ns.op]
+    print(f"{'op':<16}{'bytes':>12}{'lat(us)':>12}{'algbw GB/s':>12}{'busbw GB/s':>12}")
+    for op in ops:
+        for r in run_sweep(op, ns.min_bytes, ns.max_bytes, ns.trials):
+            print(f"{r['op']:<16}{r['bytes']:>12}{r['latency_us']:>12.1f}"
+                  f"{r['algbw_gbps']:>12.2f}{r['busbw_gbps']:>12.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    main()

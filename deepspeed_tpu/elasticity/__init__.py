@@ -1,0 +1,13 @@
+"""Elastic training — batch-size math that stays valid as hosts join/leave.
+
+Counterpart of the reference's ``deepspeed/elasticity/`` (elasticity.py
+compute_elastic_config:233, config schema elasticity/config.py, DSElasticAgent
+elastic_agent.py:28). The math is device-agnostic and ports directly; the
+recovery mechanism on TPU is checkpoint-resume over a re-sliced mesh rather
+than torch-elastic rendezvous.
+"""
+
+from deepspeed_tpu.elasticity.config import ElasticityConfig, ElasticityError  # noqa: F401
+from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
+    compute_elastic_config, elasticity_enabled, get_candidate_batch_sizes,
+    get_compatible_chip_counts, validate_elastic_config_from_script_args)

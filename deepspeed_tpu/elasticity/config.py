@@ -1,0 +1,50 @@
+"""Elasticity config schema.
+
+Counterpart of reference ``deepspeed/elasticity/config.py`` (ElasticityConfig,
+immutable-field enforcement :208). Keys match the reference's
+``"elasticity"`` JSON block so configs port unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from pydantic import BaseModel, Field, model_validator
+
+
+class ElasticityError(Exception):
+    """Raised on inconsistent elastic configuration."""
+
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.1.0"
+
+
+class ElasticityConfig(BaseModel):
+    """The ``"elasticity"`` block of ds_config."""
+
+    enabled: bool = False
+    max_train_batch_size: int = Field(2000, ge=1)
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = Field(1, ge=1)
+    max_gpus: int = Field(10000, ge=1)
+    min_time: int = Field(0, ge=0, description="minutes between allowed scaling events")
+    version: float = 0.2
+    prefer_larger_batch: bool = Field(True, alias="prefer_larger_batch_size")
+    ignore_non_elastic_batch_info: bool = False
+    # v0.2 additions: world sizes must be multiples of (chips/host × mp)
+    model_parallel_size: int = Field(1, ge=1)
+    num_gpus_per_node: int = Field(1, ge=1)
+
+    model_config = dict(populate_by_name=True, extra="forbid")
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.min_gpus > self.max_gpus:
+            raise ElasticityError(
+                f"min_gpus ({self.min_gpus}) > max_gpus ({self.max_gpus})")
+        if any(m <= 0 for m in self.micro_batch_sizes):
+            raise ElasticityError(f"micro_batch_sizes must be positive: {self.micro_batch_sizes}")
+        if self.version not in (0.1, 0.2):
+            raise ElasticityError(f"unsupported elasticity version {self.version}")
+        return self

@@ -1,0 +1,123 @@
+"""Environment/compatibility report — the ``ds_report`` tool.
+
+Counterpart of reference ``deepspeed/env_report.py`` (driven by
+``bin/ds_report``), which tabulates op-build status and torch/cuda versions.
+Here: JAX stack versions, backend + device inventory, ICI topology hints,
+per-device memory, and kernel (Pallas) availability.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import shutil
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _version(mod_name: str):
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def software_report():
+    rows = []
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "chex",
+                "einops", "numpy", "pydantic"):
+        v = _version(mod)
+        rows.append((mod, v if v else RED_NO))
+    try:
+        import deepspeed_tpu
+
+        rows.append(("deepspeed_tpu", deepspeed_tpu.__version__))
+    except Exception:
+        rows.append(("deepspeed_tpu", RED_NO))
+    return rows
+
+
+def hardware_report():
+    rows = []
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        devices = jax.devices()
+        rows.append(("backend", backend))
+        rows.append(("process count", jax.process_count()))
+        rows.append(("global devices", len(devices)))
+        rows.append(("local devices", len(jax.local_devices())))
+        if devices:
+            d = devices[0]
+            rows.append(("device kind", d.device_kind))
+            coords = getattr(d, "coords", None)
+            if coords is not None:
+                rows.append(("device 0 coords", coords))
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                pass
+            if stats:
+                lim = stats.get("bytes_limit")
+                use = stats.get("bytes_in_use")
+                if lim:
+                    rows.append(("HBM per device", f"{lim / 2**30:.1f} GiB "
+                                 f"({(use or 0) / 2**30:.2f} in use)"))
+    except Exception as e:  # pragma: no cover
+        rows.append(("jax devices", f"{RED_NO} ({e})"))
+    return rows
+
+
+def kernel_report():
+    rows = []
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        rows.append(("pallas", GREEN_OK))
+    except Exception:
+        rows.append(("pallas", RED_NO))
+    try:
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401
+
+        rows.append(("flash_attention kernel", GREEN_OK))
+    except Exception:
+        rows.append(("flash_attention kernel", RED_NO))
+    try:
+        from deepspeed_tpu.ops.aio import AsyncIOBuilder
+
+        rows.append(("async_io (C++)", GREEN_OK if AsyncIOBuilder().is_compatible() else RED_NO))
+    except Exception:
+        rows.append(("async_io (C++)", RED_NO))
+    for tool in ("g++", "cmake", "ninja"):
+        rows.append((tool, GREEN_OK if shutil.which(tool) else RED_NO))
+    return rows
+
+
+def main(args=None):
+    line = "-" * 72
+    print(line)
+    print("deepspeed_tpu environment report")
+    print(line)
+    print("software:")
+    for k, v in software_report():
+        print(f"  {k:<24} {v}")
+    print(line)
+    print("hardware:")
+    for k, v in hardware_report():
+        print(f"  {k:<24} {v}")
+    print(line)
+    print("kernels/toolchain:")
+    for k, v in kernel_report():
+        print(f"  {k:<24} {v}")
+    print(line)
+    print(f"python: {sys.version.split()[0]}  XLA_FLAGS: {os.environ.get('XLA_FLAGS', '')!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,14 @@ class InferenceEngine:
         if mesh is None:
             if dist.is_initialized():
                 mesh = dist.get_mesh()
+                mesh_tp = mesh.shape.get("tensor", 1)
+                if tp != 1 and mesh_tp != tp:
+                    from deepspeed_tpu.utils.logging import logger
+
+                    logger.warning(
+                        f"init_inference: configured tp_size={tp} but the existing mesh "
+                        f"has tensor={mesh_tp}; using the mesh (pass mesh=None after "
+                        "tearing down comm, or build the mesh with the desired tp)")
             else:
                 n = jax.device_count()
                 if n % tp:
@@ -82,7 +90,10 @@ class InferenceEngine:
 
             shapes = (jax.eval_shape(lambda: params) if params is not None
                       else jax.eval_shape(model.init_params, jax.random.PRNGKey(0)))
-            specs = AutoTP.infer_specs(shapes, policy=self._config.injection_policy)
+            # a policy refines the model's own specs where given; only without
+            # model specs does AutoTP name-pattern inference take over fully
+            specs = AutoTP.infer_specs(shapes, policy=self._config.injection_policy,
+                                       base_specs=specs)
 
         to_dtype = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
         shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
@@ -128,11 +139,15 @@ class InferenceEngine:
         if max_len > self._config.max_out_tokens:
             raise ValueError(f"sequence {max_len} exceeds max_out_tokens "
                              f"{self._config.max_out_tokens} (reference engine raises too)")
-        key = ("gen", T, max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
+        # B and T are NOT in the key: jit re-specializes per input shape, and
+        # gen derives them from ids inside the trace.
+        key = ("gen", max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
         if key not in self._compiled:
             eos = -1 if eos_token_id is None else int(eos_token_id)
 
             def gen(params, ids, rng):
+                B, T = ids.shape
+                max_len = T + max_new_tokens
                 cache = self.module.init_cache(B, max_len)
                 cache = jax.lax.with_sharding_constraint(
                     cache, self.module.cache_partition_specs()) \

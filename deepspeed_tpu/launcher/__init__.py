@@ -1,0 +1,10 @@
+"""Launcher package — CLI entry points for multi-host TPU jobs.
+
+Counterpart of the reference's ``deepspeed/launcher/`` (runner.py:377 CLI,
+launch.py:216 node-local spawner, multinode_runner.py backends). The TPU
+execution model differs fundamentally: one Python process per *host* (JAX
+single-controller-per-host), never one per chip, and rendezvous goes through
+``jax.distributed.initialize`` instead of a NCCL TCP store.
+"""
+
+from deepspeed_tpu.launcher.runner import fetch_hostfile, main, parse_inclusion_exclusion  # noqa: F401

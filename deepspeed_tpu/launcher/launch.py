@@ -1,0 +1,97 @@
+"""Node-local launcher — sets up the JAX distributed env and execs the script.
+
+Counterpart of the reference's ``deepspeed/launcher/launch.py`` (main:216),
+which forks one OS process per GPU and sets RANK/LOCAL_RANK/WORLD_SIZE.
+On TPU there is exactly ONE process per host (the JAX single-controller
+runtime owns all local chips), so this program:
+
+1. decodes the world description (host → chip list) from the runner,
+2. exports ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+   ``JAX_PROCESS_ID`` so ``jax.distributed.initialize()`` can rendezvous
+   (plus RANK/WORLD_SIZE/LOCAL_RANK for scripts written against the
+   reference's env contract),
+3. execs the user script (optionally tee-ing output per host).
+
+Signal handling mirrors the reference's kill-the-tree behavior (:426): we run
+the child in its own process group and forward SIGINT/SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(description="node-local TPU launcher")
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64 json {host: [chip indices]}")
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=8476)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(encoded: str) -> dict:
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def build_env(world_info: dict, node_rank: int, master_addr: str, master_port: int,
+              base_env=None) -> dict:
+    """Env block for the user process — both JAX rendezvous vars and the
+    reference's RANK/WORLD_SIZE contract (one "rank" per host here)."""
+    env = dict(base_env if base_env is not None else os.environ)
+    hosts = list(world_info)
+    num_hosts = len(hosts)
+    env["JAX_COORDINATOR_ADDRESS"] = f"{master_addr}:{master_port}"
+    env["JAX_NUM_PROCESSES"] = str(num_hosts)
+    env["JAX_PROCESS_ID"] = str(node_rank)
+    # reference-compatible names (launch.py:216 contract), host-granular:
+    env["RANK"] = str(node_rank)
+    env["LOCAL_RANK"] = "0"
+    env["WORLD_SIZE"] = str(num_hosts)
+    env["MASTER_ADDR"] = master_addr
+    env["MASTER_PORT"] = str(master_port)
+    env["DS_TPU_CHIPS"] = ",".join(str(c) for c in world_info[hosts[node_rank]])
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    env = build_env(world_info, args.node_rank, args.master_addr, args.master_port)
+    cmd = [sys.executable, "-u", args.user_script] + args.user_args
+
+    stdout = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        stdout = open(os.path.join(args.log_dir, f"host_{args.node_rank}.log"), "ab")
+
+    logger.info(f"launching node_rank={args.node_rank}/{len(world_info)}: {cmd}")
+    proc = subprocess.Popen(cmd, env=env, stdout=stdout,
+                            stderr=subprocess.STDOUT if stdout else None,
+                            start_new_session=True)
+
+    def forward(sig, _frame):
+        try:
+            os.killpg(os.getpgid(proc.pid), sig)
+        except ProcessLookupError:
+            pass
+
+    signal.signal(signal.SIGINT, forward)
+    signal.signal(signal.SIGTERM, forward)
+    sys.exit(proc.wait())
+
+
+if __name__ == "__main__":
+    main()

@@ -200,23 +200,9 @@ class GPT2Model:
         return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
 
     def _block(self, x, blk, rng):
-        c = self.config
-        B, T, D = x.shape
-        dk = (lambda i: jax.random.fold_in(rng, i)) if rng is not None else (lambda i: None)
-        h = self._layer_norm(x, blk["ln1_g"], blk["ln1_b"])
-        qkv = h @ blk["qkv_w"].astype(h.dtype) + blk["qkv_b"].astype(h.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        to_heads = lambda t: t.reshape(B, T, c.n_head, c.head_dim)
-        attn = self._attention(to_heads(q), to_heads(k), to_heads(v))
-        attn = attn.reshape(B, T, D)
-        attn = self._dropout(attn @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype), dk(0))
-        x = x + attn
-        h = self._layer_norm(x, blk["ln2_g"], blk["ln2_b"])
-        h = h @ blk["fc_w"].astype(h.dtype) + blk["fc_b"].astype(h.dtype)
-        h = jax.nn.gelu(h)
-        h = self._dropout(h @ blk["fc2_w"].astype(x.dtype) + blk["fc2_b"].astype(x.dtype), dk(1))
-        x = x + h
-        return x
+        q, k, v = self._block_kv(x, blk)
+        attn = self._attention(q, k, v)
+        return self._block_finish(x, blk, attn, rng)
 
     def apply(self, params, input_ids, rng=None):
         """input_ids (B, T) int32 → logits (B, T, V) fp32."""
@@ -321,13 +307,15 @@ class GPT2Model:
         to_heads = lambda t: t.reshape(B, T, c.n_head, c.head_dim)
         return to_heads(q), to_heads(k), to_heads(v)
 
-    def _block_finish(self, x, blk, attn):
+    def _block_finish(self, x, blk, attn, rng=None):
         B, T, D = x.shape
-        x = x + attn.reshape(B, T, D) @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype)
+        dk = (lambda i: jax.random.fold_in(rng, i)) if rng is not None else (lambda i: None)
+        a = attn.reshape(B, T, D) @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype)
+        x = x + self._dropout(a, dk(0))
         h = self._layer_norm(x, blk["ln2_g"], blk["ln2_b"])
         h = h @ blk["fc_w"].astype(h.dtype) + blk["fc_b"].astype(h.dtype)
         h = jax.nn.gelu(h)
-        return x + h @ blk["fc2_w"].astype(x.dtype) + blk["fc2_b"].astype(x.dtype)
+        return x + self._dropout(h @ blk["fc2_w"].astype(x.dtype) + blk["fc2_b"].astype(x.dtype), dk(1))
 
     def prefill(self, params, input_ids, cache):
         """Process the prompt, fill the cache, return last-position logits."""
@@ -336,9 +324,8 @@ class GPT2Model:
         max_len = cache["k"].shape[2]
         x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[:T]
 
-        def body(carry, xs):
+        def body(carry, blk):
             x = carry
-            blk, li = xs
             q, k, v = self._block_kv(x, blk)
             attn = self._attention_local(q, k, v)
             x = self._block_finish(x, blk, attn)
@@ -348,7 +335,7 @@ class GPT2Model:
             v_pad = jax.lax.dynamic_update_slice(v_pad, v, (0, 0, 0, 0))
             return x, (k_pad, v_pad)
 
-        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], jnp.arange(c.n_layer)))
+        x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
         x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
         head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
         logits = (x[:, -1] @ head).astype(jnp.float32)

@@ -54,19 +54,27 @@ def _matches(path: str, patterns) -> bool:
 class AutoTP:
     @staticmethod
     def infer_specs(param_shapes: Any, policy: Optional[Dict] = None,
-                    tensor_axis: str = TENSOR_AXIS) -> Any:
+                    tensor_axis: str = TENSOR_AXIS, base_specs: Any = None) -> Any:
         """param pytree (ShapeDtypeStructs or arrays) → PartitionSpec pytree.
 
         ``policy`` (the reference's injection_policy dict analogue) maps
-        regex → 'row' | 'column' | 'replicate' and takes precedence.
+        regex → 'row' | 'column' | 'replicate' | 'embed' and takes precedence.
+        ``base_specs``: a model-provided spec tree; leaves the policy does not
+        match keep their base spec (only without base_specs does name-pattern
+        classification run).
         """
         flat, treedef = jax.tree_util.tree_flatten_with_path(param_shapes)
+        base_leaves = None
+        if base_specs is not None:
+            base_leaves = jax.tree_util.tree_flatten(
+                base_specs, is_leaf=lambda x: isinstance(x, P))[0]
+            assert len(base_leaves) == len(flat), \
+                f"base_specs has {len(base_leaves)} leaves, params have {len(flat)}"
         specs = []
         n_col = n_row = 0
-        for path, leaf in flat:
+        for i, (path, leaf) in enumerate(flat):
             p = _path_str(path)
             ndim = len(leaf.shape)
-            spec = P()
             cls = None
             if policy:
                 for pat, kind in policy.items():
@@ -74,11 +82,15 @@ class AutoTP:
                         cls = kind
                         break
             if cls is None:
+                if base_leaves is not None:
+                    specs.append(base_leaves[i])
+                    continue
                 if _matches(p, ROW_PATTERNS):
                     cls = "row"
                 elif ndim >= 2 and (_matches(p, COL_PATTERNS) or _matches(p, EMBED_PATTERNS)):
                     cls = "column" if not _matches(p, EMBED_PATTERNS) else "embed"
-            if ndim >= 2 and ("kernel" in p or "weight" in p or cls):
+            spec = P()
+            if ndim >= 2 and cls:
                 if cls == "row":
                     spec = P(*([None] * (ndim - 2) + [tensor_axis, None]))
                     n_row += 1

@@ -109,3 +109,42 @@ def test_autotp_classifies_hf_style_tree():
     assert h0["attn"]["c_proj"]["kernel"] == jax.sharding.PartitionSpec("tensor", None)
     assert h0["mlp"]["c_fc"]["kernel"] == jax.sharding.PartitionSpec(None, "tensor")
     assert h0["mlp"]["c_proj"]["kernel"] == jax.sharding.PartitionSpec("tensor", None)
+
+
+def test_generate_varying_batch_and_prompt_len():
+    """Regression: the compiled generate must re-specialize when batch size or
+    prompt length changes between calls (B/T derived inside the trace)."""
+    comm.cdb = None
+    model = GPT2Model(TINY)
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "float32",
+                                                         "max_out_tokens": 128})
+    p2 = np.asarray(synthetic_lm_batch(2, 8, TINY.vocab_size)["input_ids"])
+    p4 = np.asarray(synthetic_lm_batch(4, 6, TINY.vocab_size)["input_ids"])
+    out2 = engine.generate(p2, max_new_tokens=4)
+    out4 = engine.generate(p4, max_new_tokens=4)
+    assert out2.shape == (2, 12)
+    assert out4.shape == (4, 10)
+
+
+def test_injection_policy_refines_model_specs():
+    """A policy entry overrides only matched leaves; everything else keeps the
+    model's own partition specs (not AutoTP name patterns)."""
+    from jax.sharding import PartitionSpec as P
+
+    model = GPT2Model(TINY)
+    base = model.param_partition_specs()
+    shapes = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+    refined = AutoTP.infer_specs(shapes, policy={"lm_head|wte": "replicate"},
+                                 base_specs=base)
+    flat_base = jax.tree_util.tree_flatten_with_path(base, is_leaf=lambda x: isinstance(x, P))[0]
+    flat_ref = jax.tree_util.tree_flatten_with_path(refined, is_leaf=lambda x: isinstance(x, P))[0]
+    changed = unchanged_kept = 0
+    for (path_b, sb), (path_r, sr) in zip(flat_base, flat_ref):
+        name = "/".join(str(getattr(p, "key", p)) for p in path_b).lower()
+        if "wte" in name:
+            assert sr == P(), f"{name} should be replicated, got {sr}"
+            changed += 1
+        else:
+            assert sr == sb, f"{name} changed unexpectedly: {sb} -> {sr}"
+            unchanged_kept += 1
+    assert changed >= 1 and unchanged_kept > 0

@@ -2,8 +2,8 @@
 
 Counterpart of reference ``bin/ds_bench`` (communication sweep over message
 sizes printing latency and algorithm/bus bandwidth). Runs each collective
-through the deepspeed_tpu.comm API on the live mesh, sweeping power-of-two
-payloads, and reports algbw plus the NCCL-convention busbw correction
+through the deepspeed_tpu.comm API on the live mesh, sweeping payloads in ×4
+steps from min to max bytes, and reports algbw plus the NCCL-convention busbw correction
 (all_reduce ×2(n-1)/n, all_gather/reduce_scatter ×(n-1)/n, all_to_all ×(n-1)/n).
 """
 
@@ -27,12 +27,11 @@ def _bus_factor(op: str, n: int) -> float:
 
 
 def run_sweep(op: str = "all_reduce", min_bytes: int = 1 << 10, max_bytes: int = 1 << 26,
-              trials: int = 5, warmups: int = 2, dtype=jnp.bfloat16, mesh=None):
+              trials: int = 5, warmups: int = 2, dtype=jnp.bfloat16):
     from deepspeed_tpu.comm import comm as dist
 
     if not dist.is_initialized():
         dist.init_distributed(verbose=False)
-    mesh = mesh or dist.get_mesh()
     world = dist.get_world_size()
     itemsize = jnp.dtype(dtype).itemsize
 

@@ -136,7 +136,7 @@ def compute_elastic_config(ds_config, target_deepspeed_version: str = None,
         # largest candidate micro-batch that divides this world's share
         micro = max(mb for mb in config.micro_batch_sizes if per_step % mb == 0)
         if return_microbatch:
-            return final_batch, valid_gpus, micro
+            return final_batch, valid_gpus, micro, per_step // micro  # + grad-accum steps
         return final_batch, valid_gpus, micro
 
     return final_batch, valid_gpus

@@ -34,6 +34,9 @@ def parse_args(args=None):
     parser.add_argument("--world_info", type=str, required=True,
                         help="base64 json {host: [chip indices]}")
     parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--num_nodes", type=int, default=0,
+                        help="override process count (Cloud TPU: one world_info "
+                             "entry fans out to N workers)")
     parser.add_argument("--master_addr", type=str, default="127.0.0.1")
     parser.add_argument("--master_port", type=int, default=8476)
     parser.add_argument("--log_dir", type=str, default=None)
@@ -47,12 +50,19 @@ def decode_world_info(encoded: str) -> dict:
 
 
 def build_env(world_info: dict, node_rank: int, master_addr: str, master_port: int,
-              base_env=None) -> dict:
+              base_env=None, num_nodes: int = 0) -> dict:
     """Env block for the user process — both JAX rendezvous vars and the
-    reference's RANK/WORLD_SIZE contract (one "rank" per host here)."""
+    reference's RANK/WORLD_SIZE contract (one "rank" per host here).
+
+    ``num_nodes`` overrides the process count when one world_info entry fans
+    out to several workers (Cloud TPU: the pool has one TPU name, node_rank
+    comes from TPU_WORKER_ID and num_nodes from the worker-hostname list).
+    """
     env = dict(base_env if base_env is not None else os.environ)
     hosts = list(world_info)
-    num_hosts = len(hosts)
+    num_hosts = num_nodes if num_nodes > 0 else len(hosts)
+    if node_rank >= num_hosts:
+        raise ValueError(f"node_rank {node_rank} out of range for {num_hosts} nodes")
     env["JAX_COORDINATOR_ADDRESS"] = f"{master_addr}:{master_port}"
     env["JAX_NUM_PROCESSES"] = str(num_hosts)
     env["JAX_PROCESS_ID"] = str(node_rank)
@@ -62,14 +72,16 @@ def build_env(world_info: dict, node_rank: int, master_addr: str, master_port: i
     env["WORLD_SIZE"] = str(num_hosts)
     env["MASTER_ADDR"] = master_addr
     env["MASTER_PORT"] = str(master_port)
-    env["DS_TPU_CHIPS"] = ",".join(str(c) for c in world_info[hosts[node_rank]])
+    chips_host = hosts[node_rank] if node_rank < len(hosts) else hosts[-1]
+    env["DS_TPU_CHIPS"] = ",".join(str(c) for c in world_info[chips_host])
     return env
 
 
 def main(args=None):
     args = parse_args(args)
     world_info = decode_world_info(args.world_info)
-    env = build_env(world_info, args.node_rank, args.master_addr, args.master_port)
+    env = build_env(world_info, args.node_rank, args.master_addr, args.master_port,
+                    num_nodes=args.num_nodes)
     cmd = [sys.executable, "-u", args.user_script] + args.user_args
 
     stdout = None

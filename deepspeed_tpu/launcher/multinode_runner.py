@@ -76,7 +76,10 @@ class SSHRunner(MultiNodeRunner):
             if self.args.launcher_args:
                 ssh += shlex.split(self.args.launcher_args)
             parts.append(" ".join(map(shlex.quote, ssh + [host])) + " " + shlex.quote(remote))
-        script = " & ".join(parts) + " & wait"
+        # wait each pid so a remote failure propagates as our exit code
+        script = ("pids=(); " +
+                  " ".join(f"{p} & pids+=($!);" for p in parts) +
+                  ' rc=0; for p in "${pids[@]}"; do wait "$p" || rc=$?; done; exit $rc')
         return ["/bin/bash", "-c", script]
 
 
@@ -91,11 +94,17 @@ class PDSHRunner(MultiNodeRunner):
         env["PDSH_RCMD_TYPE"] = "ssh"
         exports = " ".join(f"export {k}={shlex.quote(v)};"
                            for k, v in self.exports(env).items())
-        # remote side computes its rank from the host list
+        # remote side computes its rank from the host list; match full, short,
+        # and FQDN hostname forms, and fail loudly when nothing matches
+        # (hostfiles with IPs must use ssh launcher instead)
         hostlist = ",".join(hosts)
-        rank_sh = ("HOSTS=({}); for i in \"${{!HOSTS[@]}}\"; do "
-                   "[ \"${{HOSTS[$i]}}\" = \"$(hostname)\" ] && NODE_RANK=$i; done; "
-                   ).format(" ".join(hosts))
+        rank_sh = ("HOSTS=({hosts}); NODE_RANK=; "
+                   "for i in \"${{!HOSTS[@]}}\"; do "
+                   "for n in \"$(hostname)\" \"$(hostname -s)\" \"$(hostname -f)\"; do "
+                   "[ \"${{HOSTS[$i]}}\" = \"$n\" ] && NODE_RANK=$i; done; done; "
+                   "[ -n \"$NODE_RANK\" ] || {{ echo \"deepspeed_tpu: $(hostname) not in "
+                   "hostfile ({hostlist})\" >&2; exit 3; }}; "
+                   ).format(hosts=" ".join(hosts), hostlist=hostlist)
         launch = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
                   f"--world_info={encode_world_info(active)}",
                   f"--master_addr={self.master_addr}",
@@ -146,13 +155,17 @@ class GcloudRunner(MultiNodeRunner):
         tpu_name = list(active)[0]
         exports = " ".join(f"export {k}={shlex.quote(v)};"
                            for k, v in self.exports(env).items())
+        # worker count from the TPU metadata env (one world_info entry fans
+        # out to all workers); node_rank from TPU_WORKER_ID
+        nw_sh = 'NW=$(awk -F, "{print NF}" <<< "${TPU_WORKER_HOSTNAMES:-localhost}"); '
         launch = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
                   f"--world_info={encode_world_info(active)}",
                   f"--master_addr={self.master_addr}",
                   f"--master_port={self.args.master_port}",
                   "--node_rank=${TPU_WORKER_ID:-0}",
+                  "--num_nodes=$NW",
                   self.args.user_script] + self.args.user_args
-        remote = exports + f" cd {shlex.quote(os.getcwd())}; " + " ".join(launch)
+        remote = exports + f" cd {shlex.quote(os.getcwd())}; " + nw_sh + " ".join(launch)
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
                "--worker=all", f"--command={remote}"]
         if self.args.launcher_args:

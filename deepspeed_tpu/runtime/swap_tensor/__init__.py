@@ -1,0 +1,8 @@
+"""NVMe tensor swapping for ZeRO-Infinity-style offload.
+
+Counterpart of the reference's ``deepspeed/runtime/swap_tensor/`` (partitioned
+param/optimizer swappers over the csrc/aio handle). See ``partition_swapper``.
+"""
+
+from deepspeed_tpu.runtime.swap_tensor.partition_swapper import (  # noqa: F401
+    AsyncTensorSwapper, SwapBuffer)

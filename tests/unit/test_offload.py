@@ -1,0 +1,169 @@
+"""ZeRO-Offload tests: native aio, NVMe tensor swapping, swapped optimizer,
+engine NVMe stepping (reference tests/unit/ops/aio/test_aio.py +
+runtime/zero offload suites)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.simple import SimpleModel
+
+
+def _aio_or_skip():
+    from deepspeed_tpu.ops.aio import aio_available
+
+    if not aio_available():
+        pytest.skip("async_io C++ build unavailable")
+
+
+class TestAio:
+    def test_sync_roundtrip(self, tmp_path):
+        _aio_or_skip()
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+        h = AsyncIOHandle(block_size=4096, thread_count=4)
+        data = np.random.default_rng(0).bytes(100_000)
+        src = np.frombuffer(data, dtype=np.uint8).copy()
+        path = str(tmp_path / "blob.bin")
+        h.sync_pwrite(src, path)
+        assert AsyncIOHandle.file_size(path) == src.nbytes
+        dst = np.zeros_like(src)
+        h.sync_pread(dst, path)
+        np.testing.assert_array_equal(src, dst)
+
+    def test_async_many(self, tmp_path):
+        _aio_or_skip()
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+        h = AsyncIOHandle(block_size=1 << 14, thread_count=8)
+        arrays = [np.random.default_rng(i).integers(0, 255, size=50_000).astype(np.uint8)
+                  for i in range(8)]
+        for i, a in enumerate(arrays):
+            h.async_pwrite(a, str(tmp_path / f"f{i}.bin"))
+        h.wait()
+        outs = [np.zeros_like(a) for a in arrays]
+        for i, o in enumerate(outs):
+            h.async_pread(o, str(tmp_path / f"f{i}.bin"))
+        h.wait()
+        for a, o in zip(arrays, outs):
+            np.testing.assert_array_equal(a, o)
+
+    def test_read_missing_raises(self, tmp_path):
+        _aio_or_skip()
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+        h = AsyncIOHandle()
+        with pytest.raises(IOError):
+            h.async_pread(np.zeros(16, np.uint8), str(tmp_path / "nope.bin"))
+
+
+class TestSwapper:
+    def test_roundtrip_and_stats(self, tmp_path):
+        _aio_or_skip()
+        from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path))
+        t1 = np.random.default_rng(1).normal(size=(64, 32)).astype(np.float32)
+        t2 = np.random.default_rng(2).normal(size=(100,)).astype(np.float16)
+        sw.swap_out("layer1/w", t1)
+        sw.swap_out("layer2.b", t2)
+        sw.synchronize()
+        sw.release("layer1/w")
+        sw.release("layer2.b")
+        assert sw.stats()["resident_buffers"] == 0
+
+        sw.swap_in("layer1/w")
+        sw.swap_in("layer2.b")
+        np.testing.assert_array_equal(sw.retrieve("layer1/w"), t1)
+        np.testing.assert_array_equal(sw.retrieve("layer2.b"), t2)
+
+    def test_unknown_name(self, tmp_path):
+        _aio_or_skip()
+        from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
+
+        sw = AsyncTensorSwapper(str(tmp_path))
+        with pytest.raises(KeyError):
+            sw.swap_in("ghost")
+
+
+class TestSwappedOptimizer:
+    def test_matches_optax_adamw(self, tmp_path):
+        """Disk-swapped Adam must track optax.adamw step for step."""
+        _aio_or_skip()
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import SwappedOptimizer
+
+        rng = np.random.default_rng(0)
+        params = {"a": rng.normal(size=(32, 16)).astype(np.float32),
+                  "b": rng.normal(size=(16,)).astype(np.float32),
+                  "c": rng.normal(size=(8, 8)).astype(np.float32)}
+        hp = dict(lr=1e-2, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01)
+
+        swopt = SwappedOptimizer(str(tmp_path), "adamw", hp, buffer_count=2)
+        swopt.init_from_params(params)
+
+        ref_opt = optax.adamw(hp["lr"], b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+        ref_params = {k: jnp.asarray(v) for k, v in params.items()}
+        ref_state = ref_opt.init(ref_params)
+
+        cur = params
+        for step in range(3):
+            grads = {k: rng.normal(size=v.shape).astype(np.float32)
+                     for k, v in params.items()}
+            cur = swopt.step(grads)
+            updates, ref_state = ref_opt.update({k: jnp.asarray(g) for k, g in grads.items()},
+                                                ref_state, ref_params)
+            ref_params = optax.apply_updates(ref_params, updates)
+        for k in params:
+            np.testing.assert_allclose(cur[k], np.asarray(ref_params[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+class TestEngineOffload:
+    def test_cpu_offload_config_accepted_on_cpu_backend(self):
+        """CPU backend has one memory space — offload downgrades with a log,
+        training still works (the TPU path is exercised in hardware verify)."""
+        model = SimpleModel(hidden_dim=16, nlayers=2)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}},
+            "steps_per_print": 0})
+        assert not engine._host_offload_opt
+        rng = np.random.default_rng(0)
+        batch = (rng.normal(size=(8, 16)).astype(np.float32),
+                 rng.normal(size=(8, 16)).astype(np.float32))
+        l0 = float(engine.train_batch(batch))
+        for _ in range(4):
+            ln = float(engine.train_batch(batch))
+        assert ln < l0
+
+    def test_nvme_offload_end_to_end(self, tmp_path):
+        """Full ZeRO-Infinity-style loop: grads on device, Adam on host with
+        NVMe-swapped state; loss falls and optimizer state lives on disk."""
+        _aio_or_skip()
+        model = SimpleModel(hidden_dim=16, nlayers=2)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "nvme",
+                                                        "nvme_path": str(tmp_path),
+                                                        "buffer_count": 2}},
+            "steps_per_print": 0})
+        assert engine._nvme_optimizer is not None
+        rng = np.random.default_rng(0)
+        batch = (rng.normal(size=(8, 16)).astype(np.float32),
+                 rng.normal(size=(8, 16)).astype(np.float32))
+        losses = [float(engine.train_batch(batch)) for _ in range(5)]
+        assert losses[-1] < losses[0], losses
+        assert engine._nvme_optimizer.state_bytes() > 0
+        swp_files = [f for f in os.listdir(tmp_path) if f.endswith(".swp")]
+        # 3 files (master + 2 moments) per parameter tensor
+        assert len(swp_files) >= 3

@@ -35,11 +35,31 @@ constexpr size_t kDirectAlign = 512;
 
 struct Request {
     int fd = -1;
+    std::string path;
+    int buffered_flags = 0;        // flags for a non-O_DIRECT fallback reopen
+    bool direct = false;
+    std::atomic<int> fallback_fd{-1};
+    std::mutex reopen_mu;
     std::atomic<int> chunks_left{0};
     std::atomic<int> errors{0};
     bool owns_fd = true;
     ~Request() {
         if (owns_fd && fd >= 0) close(fd);
+        int ffd = fallback_fd.load();
+        if (ffd >= 0) close(ffd);
+    }
+    // O_DIRECT open can succeed yet per-op pread/pwrite fail (e.g. EINVAL on
+    // devices with 4096-byte logical blocks when we aligned to 512). Lazily
+    // open one shared buffered fd for the whole request and retry on it.
+    int get_fallback() {
+        int ffd = fallback_fd.load();
+        if (ffd >= 0) return ffd;
+        std::lock_guard<std::mutex> lk(reopen_mu);
+        ffd = fallback_fd.load();
+        if (ffd >= 0) return ffd;
+        ffd = open(path.c_str(), buffered_flags, 0644);
+        fallback_fd.store(ffd);
+        return ffd;
     }
 };
 
@@ -103,11 +123,19 @@ struct Handle {
     void run(Task& t) {
         size_t done = 0;
         bool failed = false;
+        int fd = t.req->fd;
         while (done < t.nbytes) {
             ssize_t n = t.is_write
-                ? pwrite(t.req->fd, t.buf + done, t.nbytes - done, t.offset + done)
-                : pread(t.req->fd, t.buf + done, t.nbytes - done, t.offset + done);
+                ? pwrite(fd, t.buf + done, t.nbytes - done, t.offset + done)
+                : pread(fd, t.buf + done, t.nbytes - done, t.offset + done);
             if (n <= 0) {
+                if (t.req->direct && fd == t.req->fd) {
+                    int ffd = t.req->get_fallback();
+                    if (ffd >= 0) {  // retry this chunk buffered
+                        fd = ffd;
+                        continue;
+                    }
+                }
                 failed = true;
                 break;
             }
@@ -128,12 +156,19 @@ struct Handle {
                        (static_cast<size_t>(offset) % kDirectAlign == 0);
         int flags = is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
         int fd = -1;
-        if (aligned) fd = open(path, flags | O_DIRECT, 0644);
+        bool direct = false;
+        if (aligned) {
+            fd = open(path, flags | O_DIRECT, 0644);
+            direct = fd >= 0;
+        }
         if (fd < 0) fd = open(path, flags, 0644);  // O_DIRECT unsupported → buffered
         if (fd < 0) return -1;
 
         auto req = std::make_shared<Request>();
         req->fd = fd;
+        req->path = path;
+        req->buffered_flags = flags;
+        req->direct = direct;
         size_t n_chunks = nbytes == 0 ? 0 : (nbytes + block_size - 1) / block_size;
         req->chunks_left.store(static_cast<int>(n_chunks));
         {

@@ -102,10 +102,10 @@ def compute_elastic_config(ds_config, target_deepspeed_version: str = None,
                            world_size: int = 0, return_microbatch: bool = False):
     """Resolve the elastic schedule.
 
-    Returns ``(final_batch_size, valid_chip_counts)`` and, when the current
-    ``world_size`` is known (>0), also the micro-batch (and optionally
-    gradient-accumulation steps) this world should run — mirroring reference
-    compute_elastic_config:233.
+    Return contract mirrors reference compute_elastic_config:233: a 2-tuple
+    ``(final_batch_size, valid_chip_counts)``, widened to a 3-tuple with the
+    micro-batch when ``world_size > 0`` (:361) or when ``return_microbatch``
+    is set (:363-376). Grad-accum steps = final_batch // (world * micro).
     """
     if isinstance(ds_config, str):
         with open(ds_config) as f:
@@ -135,10 +135,12 @@ def compute_elastic_config(ds_config, target_deepspeed_version: str = None,
         per_step = final_batch // world_size
         # largest candidate micro-batch that divides this world's share
         micro = max(mb for mb in config.micro_batch_sizes if per_step % mb == 0)
-        if return_microbatch:
-            return final_batch, valid_gpus, micro, per_step // micro  # + grad-accum steps
         return final_batch, valid_gpus, micro
 
+    if return_microbatch:
+        # no world size yet: the largest candidate that divides the batch
+        micro = max(mb for mb in config.micro_batch_sizes if final_batch % mb == 0)
+        return final_batch, valid_gpus, micro
     return final_batch, valid_gpus
 
 

@@ -1,0 +1,2 @@
+from deepspeed_tpu.profiling.flops_profiler.profiler import (FlopsProfiler,  # noqa: F401
+                                                             get_model_profile)

@@ -1,0 +1,210 @@
+"""Activation checkpointing (rematerialization) — TPU-native.
+
+Counterpart of the reference's ``runtime/activation_checkpointing/
+checkpointing.py`` (``checkpoint`` :556, ``configure`` :744, partitioned
+activations :366, CPU checkpointing :461, ``CudaRNGStatesTracker`` :121).
+
+The torch implementation re-implements autograd checkpointing by hand:
+stash inputs, re-run forward in backward, juggle RNG states, optionally
+slice activations across model-parallel ranks or move them to CPU. On TPU
+every one of those mechanics is a *policy* handed to ``jax.checkpoint``:
+
+* recompute-all           → ``nothing_saveable`` (default, like the reference)
+* ``cpu_checkpointing``   → residuals offloaded to host memory via
+                            ``offload_dot_with_no_batch_dims('device',
+                            'pinned_host')`` — XLA schedules the d2h/h2d
+                            copies, no streams to manage (reference :461
+                            does a blocking ``.cpu()`` copy).
+* ``partition_activations`` → saved residuals keep their GSPMD sharding, so
+                            on a TP mesh each rank stores only its slice —
+                            what the reference implements by hand with
+                            narrow+allgather (:366,:255). No-op code-wise:
+                            activations inside shard_map/jit are already
+                            sharded; we only validate the config.
+* deterministic dropout under recompute → automatic: JAX PRNG keys are
+                            values, the recomputed forward sees the same
+                            key (the reference needs the RNG tracker :121
+                            to fork/restore CUDA states).
+
+``checkpoint(fn, *args)`` and ``configure(...)`` keep the reference call
+signatures so ported training code runs unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+# module state (reference keeps the same globals, checkpointing.py:57-100)
+_config = None
+_policy = None
+deepspeed_checkpointing_enabled = False
+
+PARTITION_ACTIVATIONS = False
+CPU_CHECKPOINT = False
+CONTIGUOUS_CHECKPOINTING = False
+SYNCHRONIZE = False
+PROFILE_TIME = False
+num_layers = None
+
+
+def _build_policy(cpu_checkpointing: bool, number_checkpoints: Optional[int]):
+    """Map config → jax.checkpoint policy."""
+    if cpu_checkpointing:
+        # Keep matmul outputs, but in host memory: trades HBM for PCIe/DMA
+        # bandwidth exactly like the reference's CPU checkpointing.
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    # Full recompute — the reference semantics of torch checkpointing.
+    return jax.checkpoint_policies.nothing_saveable
+
+
+def configure(mpu_=None,
+              deepspeed_config=None,
+              partition_activations: Optional[bool] = None,
+              contiguous_checkpointing: Optional[bool] = None,
+              num_checkpoints: Optional[int] = None,
+              checkpoint_in_cpu: Optional[bool] = None,
+              synchronize: Optional[bool] = None,
+              profile: Optional[bool] = None):
+    """Configure module-level checkpointing behavior (reference :744)."""
+    global _config, _policy, deepspeed_checkpointing_enabled
+    global PARTITION_ACTIVATIONS, CPU_CHECKPOINT, CONTIGUOUS_CHECKPOINTING
+    global SYNCHRONIZE, PROFILE_TIME, num_layers
+
+    cfg = None
+    if deepspeed_config is not None:
+        cfg = getattr(deepspeed_config, "activation_checkpointing_config", None)
+        if cfg is None and isinstance(deepspeed_config, dict):
+            from deepspeed_tpu.runtime.config import ActivationCheckpointingConfig
+
+            cfg = ActivationCheckpointingConfig(
+                **deepspeed_config.get("activation_checkpointing", {}))
+
+    PARTITION_ACTIVATIONS = partition_activations if partition_activations is not None \
+        else (cfg.partition_activations if cfg else False)
+    CPU_CHECKPOINT = checkpoint_in_cpu if checkpoint_in_cpu is not None \
+        else (cfg.cpu_checkpointing if cfg else False)
+    CONTIGUOUS_CHECKPOINTING = contiguous_checkpointing if contiguous_checkpointing is not None \
+        else (cfg.contiguous_memory_optimization if cfg else False)
+    SYNCHRONIZE = synchronize if synchronize is not None \
+        else (cfg.synchronize_checkpoint_boundary if cfg else False)
+    PROFILE_TIME = profile if profile is not None else (cfg.profile if cfg else False)
+    num_layers = num_checkpoints if num_checkpoints is not None \
+        else (cfg.number_checkpoints if cfg else None)
+
+    if CONTIGUOUS_CHECKPOINTING:
+        # XLA owns activation buffer layout; contiguity is not a user knob.
+        log_dist("contiguous_memory_optimization is a no-op on TPU (XLA "
+                 "allocates remat buffers)", ranks=[0])
+    _policy = _build_policy(CPU_CHECKPOINT, num_layers)
+    _config = cfg
+    deepspeed_checkpointing_enabled = True
+    log_dist(f"activation checkpointing configured: partition_activations="
+             f"{PARTITION_ACTIVATIONS} cpu_checkpointing={CPU_CHECKPOINT}", ranks=[0])
+
+
+def is_configured() -> bool:
+    return deepspeed_checkpointing_enabled
+
+
+def checkpoint(function: Callable, *args, policy=None, prevent_cse: bool = True):
+    """Checkpoint a forward call: ``out = checkpoint(fn, *args)`` (reference :556).
+
+    Immediately applies — matching reference semantics where `checkpoint`
+    runs the forward and registers the recompute for backward.
+    """
+    pol = policy if policy is not None else (_policy or
+                                             jax.checkpoint_policies.nothing_saveable)
+    return jax.checkpoint(function, policy=pol, prevent_cse=prevent_cse)(*args)
+
+
+def checkpoint_wrapper(function: Callable, policy=None) -> Callable:
+    """Decorator form: returns a remat'ed callable for use inside jit/scan."""
+    pol = policy if policy is not None else (_policy or
+                                             jax.checkpoint_policies.nothing_saveable)
+    return jax.checkpoint(function, policy=pol)
+
+
+def non_reentrant_checkpoint(function, *args):
+    """Reference exposes a non-reentrant variant (:702); identical here."""
+    return checkpoint(function, *args)
+
+
+# --------------------------------------------------------------------------- #
+# RNG tracker API parity (reference CudaRNGStatesTracker :121,
+# model_parallel_cuda_manual_seed :224). JAX PRNG is functional so there is
+# no hidden state to fork/restore; these exist so ported Megatron-style code
+# can call them. `fork()` yields a context manager that is a no-op.
+# --------------------------------------------------------------------------- #
+class _NoopRNGTracker:
+    _MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    class _Fork:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    def fork(self, name=_MODEL_PARALLEL_RNG):
+        return self._Fork()
+
+
+_CUDA_RNG_STATE_TRACKER = _NoopRNGTracker()
+
+
+def get_cuda_rng_tracker():
+    return _CUDA_RNG_STATE_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """Derive distinct per-TP-rank dropout seeds (reference :224). In JAX
+    models do this by folding the mesh axis index into their key
+    (``jax.random.fold_in(key, lax.axis_index('tensor'))``); we record the
+    base seed for API parity."""
+    tracker = get_cuda_rng_tracker()
+    tracker.reset()
+    tracker.add(_NoopRNGTracker._MODEL_PARALLEL_RNG, seed + 2718)
+    return seed
+
+
+def model_parallel_reconfigure_tp_seed(seed: int):
+    return model_parallel_cuda_manual_seed(seed)
+
+
+def partition_activations_in_checkpoint(partition_activation: bool):
+    global PARTITION_ACTIVATIONS
+    PARTITION_ACTIVATIONS = partition_activation
+
+
+def set_num_layers(nlayers):
+    global num_layers
+    num_layers = nlayers
+
+
+def reset():
+    """Reference resets contiguous buffers between train batches (:737)."""
+    return None

@@ -230,6 +230,12 @@ class DeepSpeedEngine:
 
         self.monitor = MonitorMaster(self._config.monitor_config)
         dist.configure(self._config)
+        self.flops_profiler_cfg = self._config.flops_profiler_config
+        if self._config.activation_checkpointing_config.partition_activations or \
+                self._config.activation_checkpointing_config.cpu_checkpointing:
+            from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+            checkpointing.configure(deepspeed_config=self._config)
 
         self.dataloader = None
         if training_data is not None:
@@ -458,41 +464,41 @@ class DeepSpeedEngine:
                               loss_scale=scale, overflow=~finite)
         return new_state, metrics
 
+    def _accumulated_loss_grads(self, state: TrainState, batch, gas: int, scale):
+        """Mean loss + mean grads over the accumulation window — shared by the
+        fused train step and the NVMe host-step path (gas>1: lax.scan over
+        microbatches, reference engine grad-accumulation semantics)."""
+        plan = self.plan
+        params_c = self._compute_params(state.params)
+        if gas == 1:
+            rng = jax.random.fold_in(state.rng, state.step)
+            return self._micro_loss_and_grads(params_c, batch, rng, scale)
+
+        def split(x):  # microbatch split: leading dim -> (gas, micro)
+            return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+
+        mbs = jax.tree.map(split, batch)
+
+        def body(carry, mb):
+            acc, i = carry
+            rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step), i)
+            loss, grads = self._micro_loss_and_grads(params_c, mb, rng, scale)
+            grads = jax.lax.with_sharding_constraint(grads, plan.grad_specs)
+            acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+            return (acc, i + 1), loss
+
+        zero_acc = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32),
+                                jax.eval_shape(lambda: params_c))
+        zero_acc = jax.lax.with_sharding_constraint(zero_acc, plan.grad_specs)
+        (acc, _), losses = jax.lax.scan(body, (zero_acc, jnp.int32(0)), mbs)
+        return jnp.mean(losses), jax.tree.map(lambda g: g / gas, acc)
+
     def _build_train_batch_fn(self, gas: int):
         """Fused train step: scan over gradient-accumulation microbatches."""
-        plan = self.plan
 
         def step_fn(state: TrainState, batch):
             scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
-            params_c = self._compute_params(state.params)
-
-            if gas == 1:
-                rng = jax.random.fold_in(state.rng, state.step)
-                loss, grads = self._micro_loss_and_grads(params_c, batch, rng, scale)
-                mean_loss = loss
-            else:
-                # microbatch split: leading dim -> (gas, micro)
-                def split(x):
-                    x = x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
-                    return x
-
-                mbs = jax.tree.map(split, batch)
-
-                def body(carry, mb):
-                    acc, i = carry
-                    rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step), i)
-                    loss, grads = self._micro_loss_and_grads(params_c, mb, rng, scale)
-                    grads = jax.lax.with_sharding_constraint(grads, plan.grad_specs)
-                    acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
-                    return (acc, i + 1), loss
-
-                zero_acc = jax.tree.map(
-                    lambda s: jnp.zeros(s.shape, jnp.float32), jax.eval_shape(lambda: params_c))
-                zero_acc = jax.lax.with_sharding_constraint(zero_acc, plan.grad_specs)
-                (acc, _), losses = jax.lax.scan(body, (zero_acc, jnp.int32(0)), mbs)
-                grads = jax.tree.map(lambda g: g / gas, acc)
-                mean_loss = jnp.mean(losses)
-
+            mean_loss, grads = self._accumulated_loss_grads(state, batch, gas, scale)
             new_state, metrics = self._apply_grads(state, grads, mean_loss)
             return new_state, metrics
 
@@ -519,33 +525,8 @@ class DeepSpeedEngine:
         if getattr(self, "_compiled_loss_grads", None) is None:
             self._compiled_loss_grads = {}
         if gas not in self._compiled_loss_grads:
-            plan = self.plan
-
             def fn(state: TrainState, batch):
-                params_c = self._compute_params(state.params)
-                if gas == 1:
-                    rng = jax.random.fold_in(state.rng, state.step)
-                    loss, grads = self._micro_loss_and_grads(params_c, batch, rng, jnp.float32(1.0))
-                    return loss, grads
-
-                def split(x):
-                    return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
-
-                mbs = jax.tree.map(split, batch)
-
-                def body(carry, mb):
-                    acc, i = carry
-                    rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step), i)
-                    loss, grads = self._micro_loss_and_grads(params_c, mb, rng, jnp.float32(1.0))
-                    grads = jax.lax.with_sharding_constraint(grads, plan.grad_specs)
-                    acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
-                    return (acc, i + 1), loss
-
-                zero_acc = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32),
-                                        jax.eval_shape(lambda: params_c))
-                zero_acc = jax.lax.with_sharding_constraint(zero_acc, plan.grad_specs)
-                (acc, _), losses = jax.lax.scan(body, (zero_acc, jnp.int32(0)), mbs)
-                return jnp.mean(losses), jax.tree.map(lambda g: g / gas, acc)
+                return self._accumulated_loss_grads(state, batch, gas, jnp.float32(1.0))
 
             self._compiled_loss_grads[gas] = jax.jit(fn)
         return self._compiled_loss_grads[gas]
@@ -606,7 +587,31 @@ class DeepSpeedEngine:
         self._post_step(metrics)
         self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics.loss)
         self.tput_timer.stop(global_step=True, sync_obj=metrics.loss)
+        if self.flops_profiler_cfg.enabled and \
+                getattr(self, "_host_step", 0) == self.flops_profiler_cfg.profile_step:
+            self._run_flops_profiler(batch, gas)
         return metrics.loss
+
+    def _run_flops_profiler(self, batch, gas: int):
+        """Profile the compiled train step (reference engine.forward:1675-1693
+        drives FlopsProfiler at flops_profiler.profile_step)."""
+        from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
+
+        cfg = self.flops_profiler_cfg
+        prof = FlopsProfiler(ds_engine=self)
+        try:
+            with self.mesh:
+                prof.profile_fn(self._build_train_batch_fn(gas), self.state, batch,
+                                params=self.state.params)
+        except Exception as e:
+            logger.warning(f"flops profiling failed: {e}")
+            return
+        if dist.get_rank() == 0:
+            prof.print_model_profile(profile_step=cfg.profile_step,
+                                     module_depth=cfg.module_depth,
+                                     top_modules=cfg.top_modules,
+                                     detailed=cfg.detailed,
+                                     output_file=cfg.output_file)
 
     def _shard_batch(self, batch):
         """Place a host batch onto the mesh, batch dim over the DP axes.

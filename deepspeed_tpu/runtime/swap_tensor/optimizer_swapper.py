@@ -52,18 +52,22 @@ class SwappedOptimizer:
 
     # ------------------------------------------------------------------ init
     def init_from_params(self, named_params: Dict[str, np.ndarray]) -> None:
-        """Write initial fp32 masters + zeroed moments to the swap folder."""
+        """Write initial fp32 masters + zeroed moments to the swap folder.
+
+        Windowed like step(): only buffer_count tensors' buffers are resident
+        at once, so init never needs more host RAM than a step does."""
         self._names = list(named_params)
-        for name, param in named_params.items():
-            master = np.asarray(param, dtype=np.float32)
-            self.swapper.swap_out(f"{name}#w", master)
-            self.swapper.swap_out(f"{name}#m", np.zeros_like(master))
-            self.swapper.swap_out(f"{name}#v", np.zeros_like(master))
-        self.swapper.synchronize()
-        # free host buffers — state now lives on disk only
-        for name in self._names:
-            for suffix in ("#w", "#m", "#v"):
-                self.swapper.release(name + suffix)
+        for window in _windows(self._names, self.buffer_count):
+            for name in window:
+                master = np.asarray(named_params[name], dtype=np.float32)
+                self.swapper.swap_out(f"{name}#w", master)
+                self.swapper.swap_out(f"{name}#m", np.zeros_like(master))
+                self.swapper.swap_out(f"{name}#v", np.zeros_like(master))
+            self.swapper.synchronize()
+            # free host buffers — state now lives on disk only
+            for name in window:
+                for suffix in ("#w", "#m", "#v"):
+                    self.swapper.release(name + suffix)
         total = sum(int(np.prod(p.shape)) for p in named_params.values())
         logger.info(f"SwappedOptimizer: {len(self._names)} tensors, "
                     f"{total * 12 / 2**30:.2f} GiB optimizer state on "

@@ -15,6 +15,7 @@ the swapper's manifest.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 from typing import Any, Dict, Optional, Tuple
@@ -79,8 +80,12 @@ class AsyncTensorSwapper:
         self._swap_in_bytes = 0
 
     def _path(self, name: str) -> str:
+        # Sanitized name + digest of the raw name: distinct tensor names can
+        # collide after separator-flattening ('a.b' vs 'a/b'); the digest
+        # keeps one file per logical tensor.
         safe = name.replace("/", "_").replace(".", "_")
-        return os.path.join(self.swap_folder, f"{safe}.swp")
+        digest = hashlib.sha1(name.encode()).hexdigest()[:8]
+        return os.path.join(self.swap_folder, f"{safe}.{digest}.swp")
 
     # ------------------------------------------------------------------ out
     def swap_out(self, name: str, array: np.ndarray, async_op: bool = True) -> None:

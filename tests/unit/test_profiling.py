@@ -1,0 +1,153 @@
+"""Tests for the flops profiler and activation checkpointing.
+
+Mirrors the reference's profiler unit coverage
+(tests/unit/profiling/flops_profiler/test_flops_profiler.py) and the
+activation-checkpointing suite (tests/unit/runtime/activation_checkpointing/).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.profiling.flops_profiler.profiler import (FlopsProfiler,
+                                                             compiled_cost_analysis,
+                                                             count_jaxpr_flops,
+                                                             flops_to_string,
+                                                             get_model_profile,
+                                                             number_to_string)
+
+
+class TestJaxprFlops:
+    def test_matmul_flops_exact(self):
+        M, K, N = 8, 16, 32
+
+        def fn(a, b):
+            return a @ b
+
+        a = jnp.zeros((M, K))
+        b = jnp.zeros((K, N))
+        total, _ = count_jaxpr_flops(fn, a, b)
+        assert total == 2 * M * K * N
+
+    def test_batched_matmul(self):
+        B, M, K, N = 4, 8, 16, 32
+        total, _ = count_jaxpr_flops(lambda a, b: jnp.einsum("bmk,bkn->bmn", a, b),
+                                     jnp.zeros((B, M, K)), jnp.zeros((B, K, N)))
+        assert total == 2 * B * M * K * N
+
+    def test_scan_multiplies_by_length(self):
+        M = 16
+        W = jnp.zeros((4, M, M))
+
+        def fn(x, Ws):
+            def body(c, w):
+                return c @ w, ()
+
+            out, _ = jax.lax.scan(body, x, Ws)
+            return out
+
+        total, _ = count_jaxpr_flops(fn, jnp.zeros((M, M)), W)
+        assert total == 4 * 2 * M * M * M
+
+    def test_remat_counted_once_in_fwd(self):
+        M = 8
+        f = jax.checkpoint(lambda x, w: x @ w)
+        total, _ = count_jaxpr_flops(f, jnp.zeros((M, M)), jnp.zeros((M, M)))
+        assert total == 2 * M * M * M
+
+
+class TestCostAnalysis:
+    def test_compiled_flops_nonzero(self):
+        res = compiled_cost_analysis(lambda a, b: a @ b,
+                                     jnp.zeros((32, 32)), jnp.zeros((32, 32)))
+        assert res["flops"] > 0
+
+
+class TestProfilerAPI:
+    def test_get_model_profile_numeric(self):
+        params = {"w": jnp.zeros((16, 16))}
+
+        flops, macs, nparams = get_model_profile(
+            fn=lambda p, x: x @ p["w"], args=(params, jnp.zeros((4, 16))),
+            params=params, print_profile=False, as_string=False)
+        assert macs == 4 * 16 * 16
+        assert nparams == 256
+        assert flops >= 2 * macs
+
+    def test_print_profile_smoke(self, capsys):
+        params = {"w": jnp.zeros((8, 8))}
+        get_model_profile(fn=lambda p, x: x @ p["w"], args=(params, jnp.zeros((2, 8))),
+                          params=params, print_profile=True)
+        out = capsys.readouterr().out
+        assert "Flops Profiler" in out and "MACs" in out
+
+    def test_formatters(self):
+        assert number_to_string(1.5e9) == "1.50 G"
+        assert flops_to_string(2e12) == "2.00 TFLOPS"
+
+
+class TestEngineFlopsProfiler:
+    def test_profiler_fires_at_step(self, capsys):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.simple import SimpleModel
+
+        model = SimpleModel(hidden_dim=16, nlayers=2)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "flops_profiler": {"enabled": True, "profile_step": 2}})
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            batch = (rng.randn(8, 16).astype(np.float32),
+                     rng.randn(8, 16).astype(np.float32))
+            engine.train_batch(batch)
+        out = capsys.readouterr().out
+        assert "Flops Profiler" in out
+
+
+class TestActivationCheckpointing:
+    def test_checkpoint_matches_plain_grad(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+        def layer(w, x):
+            return jnp.tanh(x @ w)
+
+        w = jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)
+        x = jnp.asarray(np.random.RandomState(1).randn(4, 8), jnp.float32)
+
+        def loss_plain(w):
+            return jnp.sum(layer(w, x))
+
+        def loss_ckpt(w):
+            return jnp.sum(checkpointing.checkpoint(layer, w, x))
+
+        g1 = jax.grad(loss_plain)(w)
+        g2 = jax.grad(loss_ckpt)(w)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+
+    def test_configure_from_dict(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+        checkpointing.configure(deepspeed_config={
+            "activation_checkpointing": {"partition_activations": True,
+                                         "cpu_checkpointing": False}})
+        assert checkpointing.is_configured()
+        assert checkpointing.PARTITION_ACTIVATIONS
+
+    def test_wrapper_inside_jit(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+        f = checkpointing.checkpoint_wrapper(lambda x: jnp.sin(x) * 2)
+        val, grad = jax.jit(jax.value_and_grad(lambda x: jnp.sum(f(x))))(jnp.ones((4,)))
+        np.testing.assert_allclose(float(val), 2 * np.sin(1.0) * 4, rtol=1e-6)
+
+    def test_rng_tracker_parity_api(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+        checkpointing.model_parallel_cuda_manual_seed(1234)
+        tracker = checkpointing.get_cuda_rng_tracker()
+        with tracker.fork():
+            pass
+        assert tracker.get_states()

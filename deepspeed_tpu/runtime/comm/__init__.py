@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.comm.compressed import (compressed_allreduce,  # noqa: F401
+                                                   compressed_state_shapes)

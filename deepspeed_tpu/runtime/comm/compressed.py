@@ -1,0 +1,159 @@
+"""Error-feedback sign-compressed allreduce — the 1-bit Adam comm primitive.
+
+Counterpart of the reference's compressed collectives
+(``runtime/comm/nccl.py:54 NcclBackend.compressed_allreduce`` and the
+cupy/mpi variant ``runtime/comm/mpi.py:132``): both implement the two-stage
+"worker compress → server average+recompress → broadcast" scheme from the
+1-bit Adam paper, with persistent worker/server error-feedback buffers.
+
+TPU-native re-design: the whole exchange is a pure function over **named mesh
+axes**, traced inside ``shard_map`` — worker chunking maps to
+``lax.all_to_all`` (each worker becomes the "server" for its own chunk over
+ICI) and the final broadcast to ``lax.all_gather``. Signs travel bit-packed
+(8 signs/byte, ``bits=1``) or as int8 (``bits=8``); scales are one f32 per
+chunk. Wire bytes per step ≈ numel/8 * 2 exchanges vs 4*numel for a dense
+fp32 allreduce — a ~16× reduction, same as the reference's.
+
+All functions are jit-traceable with static shapes (pad-to-chunk is static).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _axis_size(axis) -> int:
+    return lax.axis_size(axis)
+
+
+def _l2_scale(x, numel: float):
+    # reference worker_scale = ||buffer|| / sqrt(numel) (nccl.py compressed path)
+    return jnp.linalg.norm(x) / np.sqrt(numel)
+
+
+def _pack_signs(signs_pm1: jnp.ndarray) -> jnp.ndarray:
+    """(n,) ±1 f32 → (n/8,) uint8 bit-packed. n must be a multiple of 8."""
+    bits = (signs_pm1 > 0).astype(jnp.uint8).reshape(-1, 8)
+    weights = (2 ** np.arange(8)).astype(np.uint8)
+    return (bits * weights).sum(axis=1).astype(jnp.uint8)
+
+
+def _unpack_signs(packed: jnp.ndarray) -> jnp.ndarray:
+    """(n/8,) uint8 → (n,) ±1 f32."""
+    shifts = np.arange(8, dtype=np.uint8)
+    bits = (packed[:, None] >> shifts) & jnp.uint8(1)
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+
+
+def chunk_size(numel: int, world: int) -> int:
+    """Per-worker chunk length: ceil(numel/world) rounded up to 8 for packing."""
+    c = math.ceil(numel / world)
+    return ((c + 7) // 8) * 8
+
+
+def compressed_state_shapes(numel: int, world: int) -> Tuple[int, int]:
+    """(worker_error_len, server_error_len) for a flat buffer of ``numel``."""
+    c = chunk_size(numel, world)
+    return world * c, c
+
+
+def compressed_allreduce(flat: jnp.ndarray,
+                         worker_error: jnp.ndarray,
+                         server_error: jnp.ndarray,
+                         axis: str = "data",
+                         bits: int = 1):
+    """Average ``flat`` (f32 vector, same length on every worker) across the
+    mesh axis using sign-compression with error feedback.
+
+    Must be called inside a traced per-device context (shard_map) where
+    ``axis`` is a bound mesh axis. ``worker_error`` has length
+    ``world*chunk`` (padded numel), ``server_error`` length ``chunk``
+    (this worker's server chunk). Returns ``(avg, new_worker_error,
+    new_server_error)`` — ``avg`` has ``flat``'s original length.
+
+    cf. reference nccl.py:54: phase 1 = worker compression + igather-to-server
+    (here: all_to_all over ICI), phase 2 = server average + recompress +
+    allgather.
+    """
+    assert bits in (1, 8), "bits must be 1 (packed) or 8 (int8 transport)"
+    world = _axis_size(axis)
+    numel = flat.shape[0]
+    padded = worker_error.shape[0]
+    chunk = server_error.shape[0]
+    assert padded == world * chunk, (padded, world, chunk)
+
+    # ---- phase 1: worker compression -----------------------------------
+    buf = jnp.zeros((padded,), jnp.float32).at[:numel].set(flat.astype(jnp.float32))
+    compensated = buf + worker_error
+    w_scale = _l2_scale(compensated, padded)
+    signs = jnp.where(compensated >= 0, 1.0, -1.0).astype(jnp.float32)
+    new_worker_error = compensated - w_scale * signs
+
+    rows = signs.reshape(world, chunk)  # row w = my signs for server w's chunk
+    if bits == 1:
+        payload = jax.vmap(_pack_signs)(rows)                      # (world, chunk/8) u8
+    else:
+        payload = rows.astype(jnp.int8)                            # (world, chunk) i8
+    # all_to_all: I receive row w = worker w's signs for MY chunk
+    recv = lax.all_to_all(payload, axis, split_axis=0, concat_axis=0, tiled=False)
+    recv = recv.reshape(world, -1)
+    scales = lax.all_gather(w_scale, axis)                         # (world,)
+
+    # ---- phase 2: server average + recompression ------------------------
+    if bits == 1:
+        decoded = jax.vmap(_unpack_signs)(recv)                    # (world, chunk)
+    else:
+        decoded = recv.astype(jnp.float32)
+    avg_chunk = jnp.mean(scales[:, None] * decoded, axis=0)        # (chunk,)
+    compensated_s = avg_chunk + server_error
+    s_scale = _l2_scale(compensated_s, chunk)
+    s_signs = jnp.where(compensated_s >= 0, 1.0, -1.0).astype(jnp.float32)
+    new_server_error = compensated_s - s_scale * s_signs
+
+    if bits == 1:
+        s_payload = _pack_signs(s_signs)
+    else:
+        s_payload = s_signs.astype(jnp.int8)
+    all_payload = lax.all_gather(s_payload, axis)                  # (world, chunk[/8])
+    all_scales = lax.all_gather(s_scale, axis)                     # (world,)
+    if bits == 1:
+        all_signs = jax.vmap(_unpack_signs)(all_payload)
+    else:
+        all_signs = all_payload.astype(jnp.float32)
+    result = (all_scales[:, None] * all_signs).reshape(-1)[:numel]
+    return result, new_worker_error, new_server_error
+
+
+class FlatSpec(NamedTuple):
+    """Layout of a pytree flattened into one f32 vector."""
+    shapes: tuple
+    dtypes: tuple
+    treedef: object
+    numel: int
+
+
+def flatten_tree(tree) -> Tuple[jnp.ndarray, FlatSpec]:
+    leaves, treedef = jax.tree.flatten(tree)
+    flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves]) \
+        if leaves else jnp.zeros((0,), jnp.float32)
+    spec = FlatSpec(shapes=tuple(l.shape for l in leaves),
+                    dtypes=tuple(l.dtype for l in leaves),
+                    treedef=treedef,
+                    numel=int(flat.shape[0]))
+    return flat, spec
+
+
+def unflatten_tree(flat: jnp.ndarray, spec: FlatSpec):
+    leaves = []
+    i = 0
+    for shape, dtype in zip(spec.shapes, spec.dtypes):
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        leaves.append(flat[i:i + n].reshape(shape).astype(dtype))
+        i += n
+    return jax.tree.unflatten(spec.treedef, leaves)

@@ -67,6 +67,8 @@ def _is_optax_like(opt) -> bool:
 
 
 def _supports_lr_override(opt) -> bool:
+    if not hasattr(opt, "update"):
+        return False
     try:
         return "lr_override" in inspect.signature(opt.update).parameters
     except (TypeError, ValueError):
@@ -182,6 +184,26 @@ class DeepSpeedEngine:
         # ---- optimizer ---------------------------------------------------
         self.optimizer = self._configure_optimizer()
         self._lr_supports_override = _supports_lr_override(self.optimizer)
+
+        # 1-bit optimizer family: the update runs inside a shard_map over the
+        # data axis so grads stay worker-local and the compressed exchange is
+        # real (reference onebit/adam.py + runtime/comm/nccl.py roles).
+        self._onebit = bool(getattr(self.optimizer, "is_onebit", False))
+        if self._onebit:
+            if self._config.fp16.enabled:
+                raise ValueError("1-bit optimizers support bf16/fp32 (fp16 dynamic "
+                                 "loss scaling would sit inside the compressed loop)")
+            if self.zero_stage != 0:
+                raise ValueError("1-bit optimizers require ZeRO stage 0 (parity with "
+                                 "the reference: compressed comm replaces ZeRO's)")
+            for ax, n in dict(mesh.shape).items():
+                if ax != DATA_AXIS and n > 1:
+                    raise ValueError(f"1-bit optimizers need a pure-DP mesh; axis "
+                                     f"{ax!r} has size {n}")
+            if self._config.gradient_clipping:
+                log_dist("gradient_clipping is ignored with 1-bit optimizers "
+                         "(clipping before compression would break error feedback)",
+                         ranks=[0])
 
         # ---- lr schedule -------------------------------------------------
         self.lr_scheduler = self._configure_lr_scheduler()
@@ -315,7 +337,10 @@ class DeepSpeedEngine:
         # opt-state shardings: match master-param placement structurally
         opt_shapes = jax.eval_shape(lambda: opt_state)
         master_shapes = jax.eval_shape(lambda: master if master is not None else params)
-        opt_specs = plan.map_opt_state_specs(opt_shapes, master_shapes)
+        if self._onebit:
+            opt_specs = self.optimizer.state_partition_specs()
+        else:
+            opt_specs = plan.map_opt_state_specs(opt_shapes, master_shapes)
         opt_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), opt_specs)
         if self._host_offload_opt:
             opt_sh = jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), opt_sh)
@@ -514,6 +539,92 @@ class DeepSpeedEngine:
                 out_shardings=(self.state_shardings, None))
         return self._compiled_train_batch[gas]
 
+    # ------------------------------------------------- 1-bit optimizer path
+    def _build_train_batch_fn_onebit(self, gas: int, phase: str):
+        """Train step with worker-local grads: loss+grad+momentum+compressed
+        sync+update all inside one shard_map over the data axis. Phase
+        ('warmup'/'compressed'[...]) is host-selected like the reference's
+        python stage switch — no collective inside lax.cond."""
+        opt = self.optimizer
+        mesh = self.mesh
+        spec_of = lambda tree: jax.tree.map(lambda s: s.spec, tree)
+        state_specs = spec_of(self.state_shardings)
+
+        def local_step(state: TrainState, batch):
+            masters0 = state.master if state.master is not None else state.params
+            fwd_params = opt.effective_params(state.params, masters0, state.opt_state)
+            state = state._replace(params=fwd_params)
+            if gas == 1:
+                rng = jax.random.fold_in(state.rng, state.step)
+                loss, grads = self._micro_loss_and_grads(state.params, batch, rng,
+                                                         jnp.float32(1.0))
+            else:
+                def split(x):
+                    return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+
+                mbs = jax.tree.map(split, batch)
+
+                def body(carry, mb):
+                    acc, i = carry
+                    rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step), i)
+                    l, g = self._micro_loss_and_grads(state.params, mb, rng, jnp.float32(1.0))
+                    acc = jax.tree.map(lambda a, x: a + x.astype(jnp.float32), acc, g)
+                    return (acc, i + 1), l
+
+                zero_acc = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                (acc, _), losses = jax.lax.scan(body, (zero_acc, jnp.int32(0)), mbs)
+                grads = jax.tree.map(lambda g: g / gas, acc)
+                loss = jnp.mean(losses)
+
+            masters = masters0  # the SYNCED values (never the drifted fwd params)
+            lr = self._lr_at(state.step)
+            updates, new_opt = opt.update_local(grads, state.opt_state, masters, lr, phase)
+            new_masters = jax.tree.map(
+                lambda m, u: (m.astype(jnp.float32) + u.astype(jnp.float32)).astype(m.dtype),
+                masters, updates)
+            if state.master is not None:
+                new_params = jax.tree.map(
+                    lambda m, p: m.astype(p.dtype) if jnp.issubdtype(p.dtype, jnp.floating) else m,
+                    new_masters, state.params)
+                master_out = new_masters
+            else:
+                new_params, master_out = new_masters, None
+
+            loss_avg = jax.lax.pmean(loss.astype(jnp.float32), DATA_AXIS)
+            # ||g||-proxy: sqrt(E_w ||g_local||²) — the dense global-mean grad
+            # never exists in the compressed stage, so report the RMS of the
+            # local-grad norms instead (documented deviation).
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(grads))
+            gnorm = jnp.sqrt(jax.lax.pmean(sq, DATA_AXIS))
+            new_state = TrainState(step=state.step + 1, params=new_params,
+                                   master=master_out, opt_state=new_opt,
+                                   scaler=None,
+                                   rng=jax.random.fold_in(state.rng, state.step),
+                                   skipped_steps=state.skipped_steps)
+            metrics = StepMetrics(loss=loss_avg, grad_norm=gnorm, lr=lr,
+                                  loss_scale=jnp.float32(1.0), overflow=jnp.bool_(False))
+            return new_state, metrics
+
+        def step_fn(state, batch):
+            batch_specs = jax.tree.map(lambda x: P(DATA_AXIS, *([None] * (x.ndim - 1))), batch)
+            repl = jax.tree.map(lambda _: P(), jax.eval_shape(lambda: StepMetrics(
+                jnp.float32(0), jnp.float32(0), jnp.float32(0), jnp.float32(0), jnp.bool_(False))))
+            return jax.shard_map(local_step, mesh=mesh,
+                                 in_specs=(state_specs, batch_specs),
+                                 out_specs=(state_specs, repl),
+                                 check_vma=False)(state, batch)
+
+        return step_fn
+
+    def _get_compiled_onebit(self, gas: int, phase: str):
+        key = (gas, phase)
+        if key not in self._compiled_train_batch:
+            self._compiled_train_batch[key] = jax.jit(
+                self._build_train_batch_fn_onebit(gas, phase), donate_argnums=(0,),
+                in_shardings=(self.state_shardings, None),
+                out_shardings=(self.state_shardings, None))
+        return self._compiled_train_batch[key]
+
     # --------------------------------------------------- NVMe-offload stepping
     @staticmethod
     def _leaf_name(path) -> str:
@@ -578,6 +689,10 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         if self._nvme_optimizer is not None:
             metrics = self._train_batch_nvme(batch, gas)
+        elif self._onebit:
+            phase = self.optimizer.phase_for_step(getattr(self, "_host_step", 0))
+            with self.mesh:
+                self.state, metrics = self._get_compiled_onebit(gas, phase)(self.state, batch)
         else:
             with self.mesh:
                 self.state, metrics = self._get_compiled_train_batch(gas)(self.state, batch)
@@ -642,6 +757,9 @@ class DeepSpeedEngine:
         """Compute loss AND stash this microbatch's gradients (fused — same
         cost as the reference's forward+backward pair; see module docstring)."""
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self._onebit:
+            raise NotImplementedError("1-bit optimizers use the fused train_batch() "
+                                      "path (grads must stay worker-local)")
         if self._compiled_fwd_bwd is None:
             def fwd_bwd(state: TrainState, batch):
                 scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)

@@ -1,0 +1,26 @@
+"""1-bit (communication-compressed) optimizers.
+
+Counterpart of the reference's ``deepspeed/runtime/fp16/onebit/`` —
+``OnebitAdam`` (adam.py:13), ``OnebitLamb`` (lamb.py), ``ZeroOneAdam``
+(zoadam.py) — re-designed for TPU: the compressed exchange is an XLA
+collective program over the data mesh axis (see
+deepspeed_tpu.runtime.comm.compressed) instead of NCCL/MPI+cupy.
+"""
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam, ZeroOneAdam  # noqa: F401
+from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb  # noqa: F401
+
+
+def build_onebit_optimizer(name: str, params_cfg: dict):
+    """ds_config ``optimizer.type`` → optimizer object (engine hook)."""
+    cfg = dict(params_cfg or {})
+    for ignored in ("cuda_aware", "comm_backend_name"):
+        cfg.pop(ignored, None)
+    name = name.lower()
+    if name == "onebitadam":
+        return OnebitAdam(**cfg)
+    if name == "zerooneadam":
+        return ZeroOneAdam(**cfg)
+    if name == "onebitlamb":
+        return OnebitLamb(**cfg)
+    raise ValueError(f"unknown 1-bit optimizer {name!r}")

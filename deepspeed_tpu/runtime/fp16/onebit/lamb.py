@@ -1,0 +1,105 @@
+"""1-bit LAMB — compressed-communication LAMB.
+
+Counterpart of the reference's ``runtime/fp16/onebit/lamb.py`` (OnebitLamb,
+445 LoC): warmup runs exact LAMB on dense-allreduced grads; the compressed
+stage communicates the sign-compressed momentum per tensor and applies
+LAMB's per-tensor trust ratio on top.
+
+Like the reference (lamb.py "scaling_coeff" freeze), the per-tensor trust
+ratios are tracked as an EMA during warmup and FROZEN at the stage switch:
+computing live trust ratios on sign-compressed momentum is unstable (the
+compressed update's norm doesn't shrink near an optimum, so ||w||/||u||
+saturates the clamp and oscillates — observed empirically here too).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import _OnebitBase
+
+
+class OnebitLambState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+    worker_error: Any
+    server_error: Any
+    trust: jnp.ndarray           # (n_leaves,) EMA of per-tensor trust ratios
+
+
+class OnebitLamb(_OnebitBase):
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 freeze_step=100, max_coeff=10.0, min_coeff=0.01,
+                 coeff_beta=0.9, bits=1, **unused):
+        super().__init__(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+                         freeze_step=freeze_step, bits=bits)
+        self.max_coeff = float(max_coeff)
+        self.min_coeff = float(min_coeff)
+        self.coeff_beta = float(coeff_beta)
+
+    def init(self, params) -> OnebitLambState:
+        base = super().init(params)
+        n_leaves = len(jax.tree.leaves(params))
+        return OnebitLambState(*base, trust=jnp.ones((n_leaves,), jnp.float32))
+
+    def state_partition_specs(self) -> OnebitLambState:
+        base = super().state_partition_specs()
+        return OnebitLambState(*base, trust=P())
+
+    def update_local(self, grads, state: OnebitLambState, masters, lr, phase: str):
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+
+        if phase == "warmup":
+            g_avg = jax.tree.map(
+                lambda g: jax.lax.pmean(g.astype(jnp.float32), self.comm_axis), grads)
+            mu = jax.tree.map(lambda m, g: self.b1 * m[0] + (1 - self.b1) * g,
+                              state.mu, g_avg)
+            nu = jax.tree.map(lambda v, g: self.b2 * v + (1 - self.b2) * jnp.square(g),
+                              state.nu, g_avg)
+            new_we, new_se = state.worker_error, state.server_error
+            mu_sync = mu
+        else:
+            mu = jax.tree.map(lambda m, g: self.b1 * m[0] + (1 - self.b1) * g.astype(jnp.float32),
+                              state.mu, grads)
+            nu = state.nu
+            mu_sync, new_we, new_se = self._compress_tree(
+                mu, state.worker_error, state.server_error)
+            mu = mu_sync
+
+        # bias correction — reference LAMB keeps it (fused_lamb semantics)
+        bc1 = 1 - self.b1 ** cf
+        bc2 = 1 - self.b2 ** cf
+
+        leaves_m, tdef = jax.tree.flatten(mu_sync)
+        leaves_v = jax.tree.leaves(nu)
+        leaves_p = jax.tree.leaves(masters)
+        new_trust, updates_leaves = [], []
+        for i, (m, v, p) in enumerate(zip(leaves_m, leaves_v, leaves_p)):
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.weight_decay != 0.0:
+                u = u + self.weight_decay * p.astype(jnp.float32)
+            if phase == "warmup":
+                w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+                u_norm = jnp.linalg.norm(u)
+                live = jnp.where((w_norm > 0) & (u_norm > 0),
+                                 jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                                 1.0)
+                # EMA tracked in warmup, then frozen (reference scaling_coeff)
+                trust = self.coeff_beta * state.trust[i] + (1 - self.coeff_beta) * live
+            else:
+                trust = state.trust[i]
+            new_trust.append(trust)
+            updates_leaves.append(-lr * trust * u)
+
+        updates = tdef.unflatten(updates_leaves)
+        mu_out = jax.tree.map(lambda m: m[None], mu)
+        new_state = OnebitLambState(count=count, mu=mu_out, nu=nu,
+                                    worker_error=new_we, server_error=new_se,
+                                    trust=jnp.stack(new_trust))
+        return updates, new_state

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 
@@ -202,6 +203,10 @@ class GPT2Model:
     def _block(self, x, blk, rng):
         q, k, v = self._block_kv(x, blk)
         attn = self._attention(q, k, v)
+        # named so remat='attn' can save exactly this tensor (the only one
+        # whose recompute re-runs the flash kernel) while rematerializing
+        # the cheap-to-recompute matmul/elementwise chain
+        attn = checkpoint_name(attn, "attn_out")
         return self._block_finish(x, blk, attn, rng)
 
     def apply(self, params, input_ids, rng=None):
@@ -225,6 +230,14 @@ class GPT2Model:
         elif c.remat == "dots":
             block_fn = jax.checkpoint(
                 block_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        elif c.remat == "attn":
+            # save per-layer attention outputs only (~1×d per token): the
+            # backward re-runs the qkv/mlp matmuls but never the flash
+            # attention kernel — the best flops/HBM trade when full 'dots'
+            # saving doesn't fit
+            block_fn = jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
 
         layer_rngs = jax.random.split(rng, c.n_layer) if (rng is not None and c.dropout > 0.0) else None
 

@@ -713,10 +713,23 @@ class DeepSpeedEngine:
         from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
 
         cfg = self.flops_profiler_cfg
+        if self._nvme_optimizer is not None:
+            logger.warning("flops profiler: unsupported for the NVMe-offload "
+                           "optimizer path (host-side stepping); skipping")
+            return
         prof = FlopsProfiler(ds_engine=self)
+        # profile the step function the engine actually runs for this config;
+        # _host_step was already incremented by _post_step, so the step just
+        # executed used phase_for_step(_host_step - 1)
+        if self._onebit:
+            phase = self.optimizer.phase_for_step(
+                max(0, getattr(self, "_host_step", 1) - 1))
+            step_fn = self._build_train_batch_fn_onebit(gas, phase)
+        else:
+            step_fn = self._build_train_batch_fn(gas)
         try:
             with self.mesh:
-                prof.profile_fn(self._build_train_batch_fn(gas), self.state, batch,
+                prof.profile_fn(step_fn, self.state, batch,
                                 params=self.state.params)
         except Exception as e:
             logger.warning(f"flops profiling failed: {e}")

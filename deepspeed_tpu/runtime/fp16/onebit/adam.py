@@ -64,13 +64,16 @@ class _OnebitBase:
     comm_axis = DATA_AXIS
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-                 freeze_step=100, bits=1, **unused):
+                 freeze_step=100, bits=1, denom_floor_frac=0.1,
+                 update_clip=10.0, **unused):
         self.lr = float(lr)
         self.b1, self.b2 = float(betas[0]), float(betas[1])
         self.eps = float(eps)
         self.weight_decay = float(weight_decay)
         self.freeze_step = int(freeze_step)
         self.bits = int(bits)
+        self.denom_floor_frac = float(denom_floor_frac)
+        self.update_clip = float(update_clip)
         self._world = None
         self._param_treedef = None
 
@@ -149,6 +152,39 @@ class _OnebitBase:
             return u + self.weight_decay * p.astype(jnp.float32)
         return u
 
+    def _floored_denom(self, v):
+        """``sqrt(v)+eps`` with a per-tensor floor, for the compressed stage.
+
+        Sign reconstruction gives EVERY momentum entry magnitude ≈ the
+        tensor's RMS scale, so an entry whose frozen variance is near zero
+        would be amplified by up to scale/eps (observed 1e8× → NaN in two
+        steps). Floor the denominator at ``denom_floor_frac`` × the tensor's
+        RMS denominator, capping amplification at ~1/frac of typical. The
+        reference handles the same hazard with ``exp_avg_mask``
+        (fp16/onebit/adam.py:216-227); a data-independent floor suits SPMD.
+        """
+        return jnp.maximum(jnp.sqrt(v),
+                           self.denom_floor_frac * jnp.sqrt(jnp.mean(v))) + self.eps
+
+    def _compressed_precond(self, m, v):
+        """Update direction m/denom for the compressed stage: floored
+        denominator, hard zero where the variance never saw a gradient, and
+        an element-wise clip. Steady-state Adam has |m/sqrt(v)| ≈ 1; in the
+        compressed stage the momentum tracks LOCAL (per-worker, noisier)
+        gradients while v was frozen from dense-averaged ones, so the ratio
+        can legitimately spike orders of magnitude — bound it."""
+        u = jnp.where(v > 0.0, m / self._floored_denom(v), 0.0)
+        return jnp.clip(u, -self.update_clip, self.update_clip)
+
+    def _sync_momentum(self, mu, worker_error, server_error):
+        """Compressed-allreduce the momentum tree — or skip it entirely when
+        the data axis has size 1 (reference only calls compressed_allreduce
+        when world size > 1, adam.py:210: quantizing with no communication
+        to save would only destroy accuracy)."""
+        if self._world_size() == 1:
+            return mu, worker_error, server_error
+        return self._compress_tree(mu, worker_error, server_error)
+
     def update_local(self, grads, state: OnebitAdamState, masters, lr, phase: str
                      ) -> Tuple[Any, OnebitAdamState]:
         """One step, called inside shard_map over the data axis.
@@ -169,16 +205,21 @@ class _OnebitBase:
                               state.nu, g_avg)
             new_we, new_se = state.worker_error, state.server_error
             mu_sync = mu
+            precond = lambda m, v: m / (jnp.sqrt(v) + self.eps)
         else:
             mu = jax.tree.map(lambda m, g: self.b1 * m[0] + (1 - self.b1) * g.astype(jnp.float32),
                               state.mu, grads)
             nu = state.nu  # frozen (reference: "v is frozen after freeze_step")
-            mu_sync, new_we, new_se = self._compress_tree(
+            mu_sync, new_we, new_se = self._sync_momentum(
                 mu, state.worker_error, state.server_error)
             mu = mu_sync
+            # exact momentum when dp=1 → exact Adam formula; compressed
+            # reconstruction otherwise → floored/masked preconditioner
+            precond = (lambda m, v: m / (jnp.sqrt(v) + self.eps)) \
+                if self._world_size() == 1 else self._compressed_precond
 
         updates = jax.tree.map(
-            lambda m, v, p: -lr * self._apply_wd(m / (jnp.sqrt(v) + self.eps), p),
+            lambda m, v, p: -lr * self._apply_wd(precond(m, v), p),
             mu_sync, nu, masters)
         mu_out = jax.tree.map(lambda m: m[None], mu)
         new_state = OnebitAdamState(count=count, mu=mu_out, nu=nu,
@@ -217,9 +258,11 @@ class ZeroOneAdam(_OnebitBase):
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
                  var_freeze_step=100, var_update_scaler=16,
-                 local_step_scaler=32678, local_step_clipper=16, bits=1, **unused):
+                 local_step_scaler=32678, local_step_clipper=16, bits=1,
+                 denom_floor_frac=0.1, update_clip=10.0, **unused):
         super().__init__(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
-                         freeze_step=var_freeze_step, bits=bits)
+                         freeze_step=var_freeze_step, bits=bits,
+                         denom_floor_frac=denom_floor_frac, update_clip=update_clip)
         self.var_freeze_step = int(var_freeze_step)
         self.var_update_scaler = int(var_update_scaler)
         self.local_step_scaler = int(local_step_scaler)
@@ -238,7 +281,7 @@ class ZeroOneAdam(_OnebitBase):
         return ZeroOneAdamState(*base, drift=per_leaf, lrs=P())
 
     def phases(self):
-        return ("warmup", "compressed", "compressed_local")
+        return ("warmup", "warmup_novar", "compressed", "compressed_local")
 
     def _sync_interval(self, host_step: int) -> int:
         """Doubling local-step schedule (reference zoadam.py interval logic):
@@ -249,9 +292,32 @@ class ZeroOneAdam(_OnebitBase):
         k = (host_step - self.var_freeze_step) // max(1, self.local_step_scaler)
         return 2 ** min(k, self.local_step_clipper)
 
+    def _variance_update_due(self, host_step: int) -> bool:
+        """Exponential variance-update schedule (reference zoadam.py:268-273):
+        ``var_interval`` starts at 1; every ``var_update_scaler`` variance
+        updates it doubles; variance only updates when
+        ``step % var_interval == 0``, and is frozen after var_freeze_step.
+
+        Host-driven like the reference's per-param state; memoised
+        incrementally and recomputed from 0 on a backwards jump (resume)."""
+        if host_step >= self.var_freeze_step:
+            return False
+        s, interval, counter = getattr(self, "_var_sched", (0, 1, 0))
+        if s > host_step:                      # resumed earlier than the cache
+            s, interval, counter = 0, 1, 0
+        while s < host_step:
+            if s % interval == 0:
+                counter += 1
+                if counter >= self.var_update_scaler:
+                    counter = 0
+                    interval *= 2
+            s += 1
+        self._var_sched = (s, interval, counter)
+        return host_step % interval == 0
+
     def phase_for_step(self, host_step: int) -> str:
         if host_step < self.var_freeze_step:
-            return "warmup"
+            return "warmup" if self._variance_update_due(host_step) else "warmup_novar"
         interval = self._sync_interval(host_step)
         return "compressed" if (host_step - self.var_freeze_step) % interval == 0 \
             else "compressed_local"
@@ -266,28 +332,50 @@ class ZeroOneAdam(_OnebitBase):
         count = state.count + 1
         lead = lambda tree: jax.tree.map(lambda x: x[None], tree)
 
-        if phase == "warmup":
-            g_avg = jax.tree.map(
-                lambda g: jax.lax.pmean(g.astype(jnp.float32), self.comm_axis), grads)
+        if phase in ("warmup", "warmup_novar"):
+            new_we, new_se = state.worker_error, state.server_error
+            if phase == "warmup":
+                # variance-update step: dense allreduced grad feeds BOTH
+                # moments (reference zoadam.py:208-210 with backward
+                # allreduce enabled for this step)
+                g_avg = jax.tree.map(
+                    lambda g: jax.lax.pmean(g.astype(jnp.float32), self.comm_axis), grads)
+                nu = jax.tree.map(lambda v, g: self.b2 * v + (1 - self.b2) * jnp.square(g),
+                                  state.nu, g_avg)
+            else:
+                # var_interval skip: momentum updates from the SIGN-COMPRESSED
+                # grad allreduce (reference zoadam.py:212-220 grad_onebit) —
+                # this is where 0/1 Adam saves warmup bandwidth
+                g_avg, new_we, new_se = self._sync_momentum(
+                    jax.tree.map(lambda g: g.astype(jnp.float32), grads),
+                    state.worker_error, state.server_error)
+                nu = state.nu
             mu = jax.tree.map(lambda m, g: self.b1 * m[0] + (1 - self.b1) * g,
                               state.mu, g_avg)
-            nu = jax.tree.map(lambda v, g: self.b2 * v + (1 - self.b2) * jnp.square(g),
-                              state.nu, g_avg)
+            # warmup_novar momentum is sign-reconstructed (±scale everywhere)
+            # when world>1 — same amplification hazard as the compressed stage
+            precond = (lambda m, v: m / (jnp.sqrt(v) + self.eps)) \
+                if (phase == "warmup" or self._world_size() == 1) \
+                else self._compressed_precond
             updates = jax.tree.map(
-                lambda m, v, p: -lr * self._apply_wd(m / (jnp.sqrt(v) + self.eps), p),
+                lambda m, v, p: -lr * self._apply_wd(precond(m, v), p),
                 mu, nu, masters)
             new_state = ZeroOneAdamState(count=count, mu=lead(mu), nu=nu,
-                                         worker_error=state.worker_error,
-                                         server_error=state.server_error,
+                                         worker_error=new_we,
+                                         server_error=new_se,
                                          drift=state.drift, lrs=state.lrs)
             return updates, new_state
 
         nu = state.nu
-        denom = jax.tree.map(lambda v: jnp.sqrt(v) + self.eps, nu)
+        # floored denominator + zero-variance masking: local drift and the
+        # sync reconstruction both divide sign-scale-magnitude values by the
+        # frozen denom — same hazard as 1-bit Adam's compressed stage.
+        denom = jax.tree.map(self._floored_denom, nu)
         mu = jax.tree.map(lambda m, g: self.b1 * m[0] + (1 - self.b1) * g.astype(jnp.float32),
                           state.mu, grads)                       # LOCAL momentum
-        drift = jax.tree.map(lambda d, m, dn: d[0] + (-lr) * (m / dn),
-                             state.drift, mu, denom)              # local param delta
+        drift = jax.tree.map(
+            lambda d, m, v: d[0] + (-lr) * self._compressed_precond(m, v),
+            state.drift, mu, nu)                                  # local param delta
         lrs = state.lrs + lr
 
         if phase == "compressed_local":
@@ -301,9 +389,10 @@ class ZeroOneAdam(_OnebitBase):
 
         # sync step (reference zoadam.py:246-261)
         comm_buffer = jax.tree.map(lambda d, dn: d * dn, drift, denom)
-        comm_avg, new_we, new_se = self._compress_tree(
+        comm_avg, new_we, new_se = self._sync_momentum(
             comm_buffer, state.worker_error, state.server_error)
-        updates = jax.tree.map(lambda s, dn: s / dn, comm_avg, denom)
+        updates = jax.tree.map(
+            lambda s, dn, v: jnp.where(v > 0.0, s / dn, 0.0), comm_avg, denom, nu)
         inv_lrs = 1.0 / jnp.maximum(lrs, 1e-12)
         new_mu = jax.tree.map(lambda s: -s * inv_lrs, comm_avg)
         new_drift = jax.tree.map(lambda d: jnp.zeros_like(d)[None], drift)

@@ -35,9 +35,11 @@ class OnebitLambState(NamedTuple):
 class OnebitLamb(_OnebitBase):
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
                  freeze_step=100, max_coeff=10.0, min_coeff=0.01,
-                 coeff_beta=0.9, bits=1, **unused):
+                 coeff_beta=0.9, bits=1, denom_floor_frac=0.1,
+                 update_clip=10.0, **unused):
         super().__init__(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
-                         freeze_step=freeze_step, bits=bits)
+                         freeze_step=freeze_step, bits=bits,
+                         denom_floor_frac=denom_floor_frac, update_clip=update_clip)
         self.max_coeff = float(max_coeff)
         self.min_coeff = float(min_coeff)
         self.coeff_beta = float(coeff_beta)
@@ -68,7 +70,7 @@ class OnebitLamb(_OnebitBase):
             mu = jax.tree.map(lambda m, g: self.b1 * m[0] + (1 - self.b1) * g.astype(jnp.float32),
                               state.mu, grads)
             nu = state.nu
-            mu_sync, new_we, new_se = self._compress_tree(
+            mu_sync, new_we, new_se = self._sync_momentum(
                 mu, state.worker_error, state.server_error)
             mu = mu_sync
 
@@ -80,8 +82,14 @@ class OnebitLamb(_OnebitBase):
         leaves_v = jax.tree.leaves(nu)
         leaves_p = jax.tree.leaves(masters)
         new_trust, updates_leaves = [], []
+        compressed = phase != "warmup" and self._world_size() > 1
         for i, (m, v, p) in enumerate(zip(leaves_m, leaves_v, leaves_p)):
-            u = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if compressed:
+                # sign-reconstructed momentum: floored denom + zero-variance
+                # mask (see _OnebitBase._compressed_precond)
+                u = self._compressed_precond(m / bc1, v / bc2)
+            else:
+                u = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
             if self.weight_decay != 0.0:
                 u = u + self.weight_decay * p.astype(jnp.float32)
             if phase == "warmup":

@@ -37,10 +37,12 @@ def main():
     seq = int(os.environ.get("BENCH_SEQ", min(1024, config.n_positions)))
     per_chip_bs = int(os.environ.get("BENCH_BS", 16 if on_tpu else 2))
     steps = int(os.environ.get("BENCH_STEPS", 10 if on_tpu else 3))
-    batch_size = per_chip_bs * n_dev
+    gas = int(os.environ.get("BENCH_GAS", 1))
+    batch_size = per_chip_bs * n_dev * gas
 
     ds_config = {
         "train_batch_size": batch_size,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 3 if n_dev > 1 else 1},

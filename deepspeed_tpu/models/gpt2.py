@@ -50,6 +50,12 @@ class GPT2Config:
     # (parallel/sequence.py — long-context support beyond the reference)
     sequence_parallel: Any = False
 
+    VALID_REMAT = (False, None, "none", True, "full", "dots", "attn")
+
+    def __post_init__(self):
+        if self.remat not in self.VALID_REMAT:
+            raise ValueError(f"remat={self.remat!r} not in {self.VALID_REMAT}")
+
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head

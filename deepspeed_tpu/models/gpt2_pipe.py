@@ -96,5 +96,8 @@ class PipelinedGPT2(GPT2Model):
                 last_stage_loss_fn=self._last_stage_loss_fn,
                 num_micro=self.num_micro,
                 mesh=comm.get_mesh(),
-                remat_stage=self.config.remat in (True, "full", "dots"))
+                # any enabled remat policy maps to whole-stage remat here: the
+                # in-jit pipeline recomputes per stage, so the finer-grained
+                # 'dots'/'attn' policies of the non-pipelined model don't apply
+                remat_stage=self.config.remat not in (False, None, "none"))
         return self._pipe_loss(params, batch, rng)

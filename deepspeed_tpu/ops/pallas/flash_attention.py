@@ -14,8 +14,23 @@ Backward recomputes P from the saved logsumexp:
   P = exp(S - lse); dV = Pᵀ dO; dS = P ∘ (dO Vᵀ - Δ); dQ = dS K; dK = dSᵀ Q
 with Δ = rowsum(dO ∘ O) computed outside the kernel.
 
+Causal execution (the perf-critical path for LM training):
+
+* **Triangular grid** — when ``block_q == block_k``, the (qi, ki) iteration
+  space is the lower block-triangle ONLY, flattened to a 1-D grid whose
+  block coordinates are looked up from scalar-prefetch arrays
+  (``pltpu.PrefetchScalarGridSpec``). Above-diagonal blocks are never
+  fetched or executed, so causal costs ~half of non-causal in both DMA and
+  grid steps — a ``pl.when`` skip alone saves neither (the pipeline still
+  pays the block DMA).
+* **Diagonal-only masking** — interior blocks (entirely below the diagonal)
+  run a mask-free softmax block; only blocks crossing the diagonal pay the
+  iota/compare/select VPU passes. Flash attention at small head_dim is
+  VPU-bound on TPU (softmax ops ~O(T²) on the 8×128 VPU vs matmul flops
+  O(T²·D) on the MXU), so shaving VPU passes is worth more than it looks.
+
 Layout: (B, T, H, D) in/out (matches deepspeed_tpu.models); internally
-(B·H, T, D). Causal blocks entirely above the diagonal are skipped (≈2×).
+(B·H, T, D).
 """
 
 from __future__ import annotations
@@ -26,11 +41,12 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -41,7 +57,80 @@ def _pick_block(t: int, preferred: int) -> int:
     return max(b, 1)
 
 
-# --------------------------------------------------------------------- forward
+def _causal_pairs(nq: int):
+    """Lower-triangle block pairs, row-major (ki ascending within each qi)."""
+    qi = np.concatenate([np.full(i + 1, i, np.int32) for i in range(nq)])
+    ki = np.concatenate([np.arange(i + 1, dtype=np.int32) for i in range(nq)])
+    return qi, ki
+
+
+def _causal_pairs_colmajor(nq: int):
+    """Lower-triangle block pairs, column-major (qi ascending within each ki)
+    — the dkv iteration order: each ki row accumulates over qi = ki..nq-1."""
+    ki = np.concatenate([np.full(nq - i, i, np.int32) for i in range(nq)])
+    qi = np.concatenate([np.arange(i, nq, dtype=np.int32) for i in range(nq)])
+    return ki, qi
+
+
+def _online_softmax_block(q, k, v, acc_sc, m_sc, l_sc, scale, mask_rc=None):
+    """One FA2 streaming-softmax block update. ``mask_rc`` = (rows, cols)
+    global index iotas when the block crosses the diagonal, else None
+    (interior blocks skip the mask's VPU passes entirely)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if mask_rc is not None:
+        rows, cols = mask_rc
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m_prev = m_sc[:, :1]                       # (bq, 1)
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)             # (bq, 1)
+    l_sc[:] = jnp.broadcast_to(l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+                               l_sc.shape)
+    acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+
+
+def _block_iotas(block_q, block_k, qi, ki):
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + qi * block_q
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + ki * block_k
+    return rows, cols
+
+
+# ------------------------------------------------- forward (causal, tri-grid)
+def _fwd_tri_kernel(qi_arr, ki_arr, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                    acc_sc, m_sc, l_sc, *, scale: float, block: int):
+    f = pl.program_id(1)
+    qi = qi_arr[f]
+    ki = ki_arr[f]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    @pl.when(ki < qi)
+    def _interior():                               # fully below diagonal
+        _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
+                              acc_sc, m_sc, l_sc, scale)
+
+    @pl.when(ki == qi)
+    def _diagonal():                               # crosses the diagonal
+        _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
+                              acc_sc, m_sc, l_sc, scale,
+                              mask_rc=_block_iotas(block, block, qi, ki))
+        # last block of this row: write out
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_sc[:, :1] + jnp.log(l_safe)).astype(jnp.float32)
+
+
+# --------------------------------------------- forward (rectangular fallback)
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
                 *, scale: float, causal: bool, block_q: int, block_k: int, num_k: int):
     qi = pl.program_id(1)
@@ -53,32 +142,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
         m_sc[:] = jnp.full_like(m_sc, NEG_INF)
         l_sc[:] = jnp.zeros_like(l_sc)
 
-    should_run = True
     if causal:
-        should_run = ki * block_k < (qi + 1) * block_q
+        # interior: last col <= first row → no masking needed
+        interior = ki * block_k + block_k - 1 <= qi * block_q
+        crosses = (ki * block_k < (qi + 1) * block_q) & jnp.logical_not(interior)
 
-    @pl.when(should_run)
-    def _compute():
-        q = q_ref[0]                              # (bq, D) input dtype
-        k = k_ref[0]                              # (bk, D)
-        v = v_ref[0]                              # (bk, D)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + qi * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        m_prev = m_sc[:, :1]                       # (bq, 1)
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)             # (bq, 1)
-        l_new = l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
-        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+        @pl.when(interior)
+        def _interior():
+            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
+                                  acc_sc, m_sc, l_sc, scale)
+
+        @pl.when(crosses)
+        def _diag():
+            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
+                                  acc_sc, m_sc, l_sc, scale,
+                                  mask_rc=_block_iotas(block_q, block_k, qi, ki))
+    else:
+        _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
+                              acc_sc, m_sc, l_sc, scale)
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -95,10 +176,42 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k):
     bk = _pick_block(t_k, block_k)
     nq, nk = t_q // bq, t_k // bk
 
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk, num_k=nk)
     out_shapes = (jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
                   jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32))
+    scratch = [pltpu.VMEM((bq, d), jnp.float32),
+               pltpu.VMEM((bq, 128), jnp.float32),
+               pltpu.VMEM((bq, 128), jnp.float32)]
+
+    if causal and t_q == t_k and bq == bk:
+        qi_arr, ki_arr = _causal_pairs(nq)
+        o, lse = pl.pallas_call(
+            functools.partial(_fwd_tri_kernel, scale=scale, block=bq),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, len(qi_arr)),
+                in_specs=[
+                    pl.BlockSpec((1, bq, d), lambda b, f, qa, ka: (b, qa[f], 0)),
+                    pl.BlockSpec((1, bk, d), lambda b, f, qa, ka: (b, ka[f], 0)),
+                    pl.BlockSpec((1, bk, d), lambda b, f, qa, ka: (b, ka[f], 0)),
+                ],
+                out_specs=(
+                    pl.BlockSpec((1, bq, d), lambda b, f, qa, ka: (b, qa[f], 0)),
+                    pl.BlockSpec((1, bq, 1), lambda b, f, qa, ka: (b, qa[f], 0)),
+                ),
+                scratch_shapes=scratch,
+            ),
+            out_shape=out_shapes,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            cost_estimate=pl.CostEstimate(
+                flops=int(2 * bh * t_q * t_k * d),   # causal: half the blocks run
+                bytes_accessed=int((q.size + k.size + v.size + q.size) * q.dtype.itemsize),
+                transcendentals=int(bh * t_q * t_k // 2)),
+        )(jnp.asarray(qi_arr), jnp.asarray(ki_arr), q, k, v)
+        return o, lse
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk, num_k=nk)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
@@ -112,11 +225,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ),
         out_shape=out_shapes,
-        scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-        ],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -128,6 +237,81 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k):
 
 
 # -------------------------------------------------------------------- backward
+def _bwd_p_ds(q, k, v, do, lse, delta, scale, mask_rc=None):
+    """Recompute P and dS for one block (shared by dq and dkv kernels)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if mask_rc is not None:
+        rows, cols = mask_rc
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta) * scale).astype(k.dtype)
+    return p, ds
+
+
+def _bwd_dq_tri_kernel(qi_arr, ki_arr, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                       delta_ref, dq_ref, dq_sc, *, scale, block):
+    f = pl.program_id(1)
+    qi = qi_arr[f]
+    ki = ki_arr[f]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    def _acc(mask_rc):
+        _, ds = _bwd_p_ds(q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0],
+                          delta_ref[0], scale, mask_rc)
+        dq_sc[:] += jax.lax.dot_general(ds, k_ref[0], (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(ki < qi)
+    def _interior():
+        _acc(None)
+
+    @pl.when(ki == qi)
+    def _diagonal():
+        _acc(_block_iotas(block, block, qi, ki))
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_tri_kernel(ki_arr, qi_arr, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, dk_ref, dv_ref, dk_sc, dv_sc,
+                        *, scale, block, num_q):
+    f = pl.program_id(1)
+    ki = ki_arr[f]
+    qi = qi_arr[f]
+
+    @pl.when(qi == ki)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    def _acc(mask_rc):
+        p, ds = _bwd_p_ds(q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0],
+                          delta_ref[0], scale, mask_rc)
+        dv_sc[:] += jax.lax.dot_general(p.astype(do_ref.dtype), do_ref[0],
+                                        (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        dk_sc[:] += jax.lax.dot_general(ds, q_ref[0], (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(qi == ki)
+    def _diagonal():
+        _acc(_block_iotas(block, block, qi, ki))
+
+    @pl.when(qi > ki)
+    def _interior():
+        _acc(None)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
                    *, scale, causal, block_q, block_k, num_k):
     qi = pl.program_id(1)
@@ -137,30 +321,25 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
     def _init():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    should_run = True
-    if causal:
-        should_run = ki * block_k < (qi + 1) * block_q
-
-    @pl.when(should_run)
-    def _compute():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0]                            # (bq, 1)
-        delta = delta_ref[0]                        # (bq, 1)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + qi * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(k.dtype)
-        dq_sc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+    def _acc(mask_rc):
+        _, ds = _bwd_p_ds(q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0],
+                          delta_ref[0], scale, mask_rc)
+        dq_sc[:] += jax.lax.dot_general(ds, k_ref[0], (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        interior = ki * block_k + block_k - 1 <= qi * block_q
+        crosses = (ki * block_k < (qi + 1) * block_q) & jnp.logical_not(interior)
+
+        @pl.when(interior)
+        def _interior():
+            _acc(None)
+
+        @pl.when(crosses)
+        def _diag():
+            _acc(_block_iotas(block_q, block_k, qi, ki))
+    else:
+        _acc(None)
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -177,32 +356,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    should_run = True
-    if causal:
-        should_run = (qi + 1) * block_q > ki * block_k
+    def _acc(mask_rc):
+        p, ds = _bwd_p_ds(q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0],
+                          delta_ref[0], scale, mask_rc)
+        dv_sc[:] += jax.lax.dot_general(p.astype(do_ref.dtype), do_ref[0],
+                                        (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        dk_sc[:] += jax.lax.dot_general(ds, q_ref[0], (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
 
-    @pl.when(should_run)
-    def _compute():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0]                            # (bq, 1)
-        delta = delta_ref[0]                        # (bq, 1)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + qi * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                        # (bq, bk)
-        dv_sc[:] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(q.dtype)  # (bq, bk)
-        dk_sc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+    if causal:
+        interior = ki * block_k + block_k - 1 <= qi * block_q
+        crosses = ((qi + 1) * block_q > ki * block_k) & jnp.logical_not(interior)
+
+        @pl.when(interior)
+        def _interior():
+            _acc(None)
+
+        @pl.when(crosses)
+        def _diag():
+            _acc(_block_iotas(block_q, block_k, qi, ki))
+    else:
+        _acc(None)
 
     @pl.when(qi == num_q - 1)
     def _finalize():
@@ -220,6 +395,60 @@ def _flash_backward(res, g, scale, causal, block_q, block_k):
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)  # (bh, t_q, 1)
+
+    tri = causal and t_q == t_k and bq == bk
+    if tri:
+        qi_arr, ki_arr = _causal_pairs(nq)
+        # dq: iterate (qi, ki≤qi) row-major; first prefetch array indexes q/dq
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_tri_kernel, scale=scale, block=bq),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, len(qi_arr)),
+                in_specs=[
+                    pl.BlockSpec((1, bq, d), lambda b, f, qa, ka: (b, qa[f], 0)),
+                    pl.BlockSpec((1, bk, d), lambda b, f, qa, ka: (b, ka[f], 0)),
+                    pl.BlockSpec((1, bk, d), lambda b, f, qa, ka: (b, ka[f], 0)),
+                    pl.BlockSpec((1, bq, d), lambda b, f, qa, ka: (b, qa[f], 0)),
+                    pl.BlockSpec((1, bq, 1), lambda b, f, qa, ka: (b, qa[f], 0)),
+                    pl.BlockSpec((1, bq, 1), lambda b, f, qa, ka: (b, qa[f], 0)),
+                ],
+                out_specs=pl.BlockSpec((1, bq, d), lambda b, f, qa, ka: (b, qa[f], 0)),
+                scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            ),
+            out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+        )(jnp.asarray(qi_arr), jnp.asarray(ki_arr), q, k, v, do, lse, delta)
+
+        # dkv: iterate (ki, qi≥ki) — the transposed triangle
+        ki2, qi2 = _causal_pairs_colmajor(nq)
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_tri_kernel, scale=scale, block=bq, num_q=nq),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, len(ki2)),
+                in_specs=[
+                    pl.BlockSpec((1, bq, d), lambda b, f, ka, qa: (b, qa[f], 0)),
+                    pl.BlockSpec((1, bk, d), lambda b, f, ka, qa: (b, ka[f], 0)),
+                    pl.BlockSpec((1, bk, d), lambda b, f, ka, qa: (b, ka[f], 0)),
+                    pl.BlockSpec((1, bq, d), lambda b, f, ka, qa: (b, qa[f], 0)),
+                    pl.BlockSpec((1, bq, 1), lambda b, f, ka, qa: (b, qa[f], 0)),
+                    pl.BlockSpec((1, bq, 1), lambda b, f, ka, qa: (b, qa[f], 0)),
+                ],
+                out_specs=(
+                    pl.BlockSpec((1, bk, d), lambda b, f, ka, qa: (b, ka[f], 0)),
+                    pl.BlockSpec((1, bk, d), lambda b, f, ka, qa: (b, ka[f], 0)),
+                ),
+                scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                                pltpu.VMEM((bk, d), jnp.float32)],
+            ),
+            out_shape=(jax.ShapeDtypeStruct((bh, t_k, d), k.dtype),
+                       jax.ShapeDtypeStruct((bh, t_k, d), v.dtype)),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+        )(jnp.asarray(ki2), jnp.asarray(qi2), q, k, v, do, lse, delta)
+        return dq, dk, dv
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,

@@ -247,7 +247,8 @@ class DeepSpeedEngine:
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
         self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
         self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size(),
-                                          steps_per_output=self._config.steps_per_print)
+                                          steps_per_output=self._config.steps_per_print,
+                                          sync_every_step=self.wall_clock_breakdown)
         from deepspeed_tpu.monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(self._config.monitor_config)

@@ -352,11 +352,13 @@ class ZeroOneAdam(_OnebitBase):
                 nu = state.nu
             mu = jax.tree.map(lambda m, g: self.b1 * m[0] + (1 - self.b1) * g,
                               state.mu, g_avg)
-            # warmup_novar momentum is sign-reconstructed (±scale everywhere)
-            # when world>1 — same amplification hazard as the compressed stage
+            # Once world>1, warmup_novar steps write sign-reconstructed values
+            # (±scale everywhere) into the momentum HISTORY, so even the
+            # interleaved variance-update ('warmup') steps divide contaminated
+            # momentum — the floored/masked preconditioner must apply to both
+            # warmup phases; only dp=1 keeps exact momentum throughout.
             precond = (lambda m, v: m / (jnp.sqrt(v) + self.eps)) \
-                if (phase == "warmup" or self._world_size() == 1) \
-                else self._compressed_precond
+                if self._world_size() == 1 else self._compressed_precond
             updates = jax.tree.map(
                 lambda m, v, p: -lr * self._apply_wd(precond(m, v), p),
                 mu, nu, masters)

@@ -71,12 +71,20 @@ def _shard_over_dp(shape: Tuple[int, ...], base_spec: Optional[P], dp_axes: Sequ
     if any(a in used for a in dp_axes):
         return P(*entries)  # already dp-sharded (e.g. expert-stacked weights)
 
-    best_dim, best_size = -1, -1
+    # Dim choice: (1) prefer a dim already tp-sharded — dp extends the same
+    # dim (fsdp-over-tp, the layout the forward pass already uses), then
+    # (2) prefer LATER dims — leading dims are layer-stack/position dims that
+    # lax.scan and wpe[:T]-style slices cut through, and slicing a dp-sharded
+    # dim forces SPMD "involuntary full rematerialization" (observed on the
+    # (n_positions, d) table when n_positions tied n_embd).
+    best_dim, best_key = -1, (-1, -1)
     for d, size in enumerate(shape):
         tp_factor = int(np.prod([mesh.shape[a] for a in _axes_of(entries[d])])) or 1
         local = size // tp_factor
-        if local % dp_size == 0 and local // dp_size > 0 and size > best_size:
-            best_dim, best_size = d, size
+        if local % dp_size == 0 and local // dp_size > 0:
+            key = (1 if tp_factor > 1 else 0, d)
+            if key > best_key:
+                best_dim, best_key = d, key
     if best_dim < 0:
         return P(*entries)
     entries[best_dim] = tuple(_axes_of(entries[best_dim])) + tuple(dp_axes)

@@ -170,7 +170,8 @@ class ThroughputTimer:
     """Samples/sec + TFLOPs reporting (cf. reference ThroughputTimer timer.py:137)."""
 
     def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
-                 monitor_memory: bool = False, logging_fn=None):
+                 monitor_memory: bool = False, logging_fn=None,
+                 sync_every_step: bool = True):
         self.start_time = 0.0
         self.end_time = 0.0
         self.started = False
@@ -185,6 +186,14 @@ class ThroughputTimer:
         self.monitor_memory = monitor_memory and _PSUTIL
         self.logging = logging_fn or (lambda m: log_dist(m, ranks=[0]))
         self.initialized = False
+        # syncing on every stop() costs a device round-trip per step (over a
+        # remote-tunnel runtime that is ~100ms); when off, only the stops that
+        # emit a log line sync, and intermediate steps pipeline freely. Note
+        # un-synced windows attribute host time between steps to the device
+        # (the device computes through those gaps), so reported samples/sec
+        # can read high when the input pipeline stalls — enable
+        # wall_clock_breakdown for strict per-step accounting.
+        self.sync_every_step = sync_every_step
 
     def update_epoch_count(self):
         self.epoch_count += 1
@@ -207,14 +216,16 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0:
-            _device_sync(sync_obj)
+            will_log = (global_step and report_speed and self.steps_per_output
+                        and self.global_step_count % self.steps_per_output == 0)
+            if self.sync_every_step or will_log:
+                _device_sync(sync_obj)
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
             self.start_time = 0.0
-            if (global_step and report_speed and self.steps_per_output
-                    and self.global_step_count % self.steps_per_output == 0):
+            if will_log:
                 self.logging(
                     f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                     f"global_step={self.global_step_count}, "

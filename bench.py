@@ -6,7 +6,9 @@ Baseline: the north-star from BASELINE.md — ≥50% MFU for GPT-2-class ZeRO-3
 pretraining (the reference's best published efficiency is 52% of peak on V100,
 docs/_posts/2020-05-19-bert-record.md:13). vs_baseline = MFU / 0.50.
 
-Env knobs: BENCH_MODEL (preset name), BENCH_BS, BENCH_SEQ, BENCH_STEPS.
+Env knobs: BENCH_MODEL (preset name), BENCH_BS (per-chip microbatch),
+BENCH_SEQ, BENCH_STEPS, BENCH_GAS (gradient accumulation), BENCH_REMAT
+(none|full|dots|attn; default attn).
 """
 
 import json
@@ -32,10 +34,13 @@ def main():
     import dataclasses
 
     config = PRESETS[model_name]
-    remat = os.environ.get("BENCH_REMAT", "full")
+    # 'attn' (save flash-attention outputs, recompute the cheap matmul chain)
+    # + bs=12 is the measured single-chip sweet spot for gpt2-760m on v5e:
+    # 'full' wastes a flash recompute, 'dots'/bs>=16 exceed 16G HBM
+    remat = os.environ.get("BENCH_REMAT", "attn")
     config = dataclasses.replace(config, remat=remat if remat != "none" else False)
     seq = int(os.environ.get("BENCH_SEQ", min(1024, config.n_positions)))
-    per_chip_bs = int(os.environ.get("BENCH_BS", 16 if on_tpu else 2))
+    per_chip_bs = int(os.environ.get("BENCH_BS", 12 if on_tpu else 2))
     steps = int(os.environ.get("BENCH_STEPS", 10 if on_tpu else 3))
     gas = int(os.environ.get("BENCH_GAS", 1))
     batch_size = per_chip_bs * n_dev * gas

@@ -1,0 +1,188 @@
+"""HF checkpoint bridge — load real pretrained models into the TPU runtime.
+
+The reference's module-injection value is wrapping EXISTING models: its
+per-architecture policy containers (``module_inject/containers/``,
+``replace_module.py:282``) rewrite a loaded HF torch module in place, and the
+inference engine loads sharded torch checkpoints (``inference/engine.py:
+336-506``). The TPU-native equivalent is *conversion*: a HF checkpoint's
+state dict becomes a jax pytree (for AutoTP spec inference + ``apply_tp``
+device placement), and for supported architectures it is repacked into the
+in-tree TPU model's layer-stacked layout, after which training
+(``deepspeed_tpu.initialize(model_parameters=...)``), inference
+(``init_inference(params=...)``), ZeRO, TP, and checkpointing all apply
+unchanged.
+
+Supported today: GPT-2 family (``GPT2LMHeadModel`` — the flagship).
+Everything else still gets ``state_dict_to_tree`` + AutoTP's name-pattern
+classification (reference auto_tp.py role) for TP placement of the raw tree.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def hf_state_dict(model_or_sd: Any) -> Dict[str, np.ndarray]:
+    """A torch ``nn.Module`` | state_dict | dict of arrays → numpy dict."""
+    sd = model_or_sd
+    if hasattr(sd, "state_dict") and callable(sd.state_dict):
+        sd = sd.state_dict()
+    out = {}
+    for k, v in sd.items():
+        if hasattr(v, "detach"):        # torch tensor, no torch import needed
+            v = v.detach().cpu().numpy()
+        out[k] = np.asarray(v)
+    return out
+
+
+def state_dict_to_tree(sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Flat dotted-key state dict → nested dict pytree (AutoTP walkable)."""
+    tree: Dict[str, Any] = {}
+    for key, val in sd.items():
+        node = tree
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return tree
+
+
+# ------------------------------------------------------------------- GPT-2
+def load_gpt2(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``GPT2LMHeadModel`` (or its state dict) → (GPT2Config, params) for
+    ``deepspeed_tpu.models.gpt2.GPT2Model``.
+
+    HF's Conv1D stores weights as (in_features, out_features) — exactly the
+    layout our matmuls use, so attention/MLP weights stack without transposes;
+    per-layer tensors are stacked on a leading layer dim for the ``lax.scan``
+    trunk (models/gpt2.py).
+    """
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    sd = hf_state_dict(model_or_sd)
+    # accept both "transformer.h.0..." (LMHead model) and "h.0..." (bare)
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    g = lambda name: sd[prefix + name].astype(dtype)
+
+    layer_ids = sorted({int(m.group(1)) for k in sd
+                        for m in [re.match(rf"{re.escape(prefix)}h\.(\d+)\.", k)] if m})
+    n_layer = len(layer_ids)
+    assert layer_ids == list(range(n_layer)), f"non-contiguous layers {layer_ids}"
+
+    wte = g("wte.weight")
+    wpe = g("wpe.weight")
+    vocab, d = wte.shape
+    qkv0 = g("h.0.attn.c_attn.weight")
+    assert qkv0.shape == (d, 3 * d), f"unexpected c_attn shape {qkv0.shape}"
+
+    stack = lambda name: np.stack([g(f"h.{i}.{name}") for i in range(n_layer)])
+    params = {
+        "wte": wte,
+        "wpe": wpe,
+        "blocks": {
+            "ln1_g": stack("ln_1.weight"),
+            "ln1_b": stack("ln_1.bias"),
+            "qkv_w": stack("attn.c_attn.weight"),
+            "qkv_b": stack("attn.c_attn.bias"),
+            "proj_w": stack("attn.c_proj.weight"),
+            "proj_b": stack("attn.c_proj.bias"),
+            "ln2_g": stack("ln_2.weight"),
+            "ln2_b": stack("ln_2.bias"),
+            "fc_w": stack("mlp.c_fc.weight"),
+            "fc_b": stack("mlp.c_fc.bias"),
+            "fc2_w": stack("mlp.c_proj.weight"),
+            "fc2_b": stack("mlp.c_proj.bias"),
+        },
+        "lnf_g": g("ln_f.weight"),
+        "lnf_b": g("ln_f.bias"),
+    }
+    import jax.numpy as jnp
+
+    n_head = _infer_gpt2_heads(model_or_sd, d)
+    compute_dtype = jnp.dtype(np.dtype(dtype)) if np.dtype(dtype) != np.float32 \
+        else jnp.float32
+    mk_config = lambda tied: GPT2Config(
+        vocab_size=vocab, n_positions=wpe.shape[0], n_embd=d, n_layer=n_layer,
+        n_head=n_head, tie_embeddings=tied, dtype=compute_dtype)
+    config = mk_config(True)
+    # HF ties lm_head to wte; an untied lm_head.weight (V, d) becomes ours (d, V)
+    if "lm_head.weight" in sd:
+        lm = sd["lm_head.weight"].astype(dtype)
+        if not np.array_equal(lm, wte):
+            params["lm_head"] = lm.T
+            config = mk_config(False)
+    logger.info(f"load_gpt2: {n_layer} layers, d={d}, vocab={vocab}, "
+                f"heads={config.n_head}")
+    return config, params
+
+
+def _infer_gpt2_heads(model_or_sd: Any, d: int) -> int:
+    cfg = getattr(model_or_sd, "config", None)
+    if cfg is not None and getattr(cfg, "n_head", None):
+        return int(cfg.n_head)
+    # a bare state dict carries no head count; pick the GPT-2 family default
+    # (head_dim 64) when it divides, else the largest power-of-two divisor
+    if d % 64 == 0:
+        return d // 64
+    h = 1
+    while d % (h * 2) == 0:
+        h *= 2
+    return h
+
+
+def export_gpt2(params: Dict[str, Any], prefix: str = "transformer.") -> Dict[str, np.ndarray]:
+    """Inverse of ``load_gpt2``: TPU param tree → HF-layout state dict
+    (for handing checkpoints back to the torch ecosystem)."""
+    blocks = params["blocks"]
+    n_layer = int(np.asarray(blocks["ln1_g"]).shape[0])
+    sd: Dict[str, np.ndarray] = {
+        prefix + "wte.weight": np.asarray(params["wte"]),
+        prefix + "wpe.weight": np.asarray(params["wpe"]),
+        prefix + "ln_f.weight": np.asarray(params["lnf_g"]),
+        prefix + "ln_f.bias": np.asarray(params["lnf_b"]),
+    }
+    names = [("ln_1.weight", "ln1_g"), ("ln_1.bias", "ln1_b"),
+             ("attn.c_attn.weight", "qkv_w"), ("attn.c_attn.bias", "qkv_b"),
+             ("attn.c_proj.weight", "proj_w"), ("attn.c_proj.bias", "proj_b"),
+             ("ln_2.weight", "ln2_g"), ("ln_2.bias", "ln2_b"),
+             ("mlp.c_fc.weight", "fc_w"), ("mlp.c_fc.bias", "fc_b"),
+             ("mlp.c_proj.weight", "fc2_w"), ("mlp.c_proj.bias", "fc2_b")]
+    for i in range(n_layer):
+        for hf_name, ours in names:
+            sd[f"{prefix}h.{i}.{hf_name}"] = np.asarray(blocks[ours][i])
+    if "lm_head" in params:
+        sd["lm_head.weight"] = np.asarray(params["lm_head"]).T
+    else:
+        sd["lm_head.weight"] = sd[prefix + "wte.weight"]
+    return sd
+
+
+_LOADERS = {"gpt2": load_gpt2}
+
+
+def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,
+                  dtype=np.float32):
+    """Dispatch: HF model/state dict → (tpu_model, params).
+
+    ``architecture`` defaults to the HF config's ``model_type``. Returns an
+    object satisfying the deepspeed_tpu model protocol plus its param tree —
+    ready for ``initialize(model=..., model_parameters=...)`` or
+    ``init_inference(model=..., params=...)``.
+    """
+    from deepspeed_tpu.models.gpt2 import GPT2Model
+
+    if architecture is None:
+        cfg = getattr(model_or_sd, "config", None)
+        architecture = getattr(cfg, "model_type", None)
+    if architecture not in _LOADERS:
+        raise NotImplementedError(
+            f"no TPU repack for architecture {architecture!r} (have: "
+            f"{sorted(_LOADERS)}); use state_dict_to_tree + AutoTP.apply_tp "
+            "for spec-only TP placement of the raw tree")
+    config, params = _LOADERS[architecture](model_or_sd, dtype=dtype)
+    return GPT2Model(config), params

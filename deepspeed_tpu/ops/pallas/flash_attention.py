@@ -77,7 +77,9 @@ def _online_softmax_block(q, k, v, acc_sc, m_sc, l_sc, scale, mask_rc=None):
     global index iotas when the block crosses the diagonal, else None
     (interior blocks skip the mask's VPU passes entirely)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+                            preferred_element_type=jnp.float32)
+    if scale != 1.0:
+        s = s * scale
     if mask_rc is not None:
         rows, cols = mask_rc
         s = jnp.where(rows >= cols, s, NEG_INF)
@@ -98,6 +100,23 @@ def _block_iotas(block_q, block_k, qi, ki):
     rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + qi * block_q
     cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + ki * block_k
     return rows, cols
+
+
+def _causal_dispatch(qi, ki, block_q, block_k, compute):
+    """Rectangular-grid causal dispatch shared by fwd/dq/dkv kernels:
+    run ``compute(mask_rc)`` mask-free on blocks fully below the diagonal,
+    with the iota mask on blocks the diagonal crosses, and not at all on
+    blocks fully above it."""
+    interior = ki * block_k + block_k - 1 <= qi * block_q
+    crosses = (ki * block_k < (qi + 1) * block_q) & jnp.logical_not(interior)
+
+    @pl.when(interior)
+    def _interior():
+        compute(None)
+
+    @pl.when(crosses)
+    def _diag():
+        compute(_block_iotas(block_q, block_k, qi, ki))
 
 
 # ------------------------------------------------- forward (causal, tri-grid)
@@ -143,20 +162,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
         l_sc[:] = jnp.zeros_like(l_sc)
 
     if causal:
-        # interior: last col <= first row → no masking needed
-        interior = ki * block_k + block_k - 1 <= qi * block_q
-        crosses = (ki * block_k < (qi + 1) * block_q) & jnp.logical_not(interior)
-
-        @pl.when(interior)
-        def _interior():
-            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
-                                  acc_sc, m_sc, l_sc, scale)
-
-        @pl.when(crosses)
-        def _diag():
-            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
-                                  acc_sc, m_sc, l_sc, scale,
-                                  mask_rc=_block_iotas(block_q, block_k, qi, ki))
+        _causal_dispatch(qi, ki, block_q, block_k,
+                         lambda mask_rc: _online_softmax_block(
+                             q_ref[0], k_ref[0], v_ref[0],
+                             acc_sc, m_sc, l_sc, scale, mask_rc=mask_rc))
     else:
         _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
                               acc_sc, m_sc, l_sc, scale)
@@ -240,14 +249,19 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k):
 def _bwd_p_ds(q, k, v, do, lse, delta, scale, mask_rc=None):
     """Recompute P and dS for one block (shared by dq and dkv kernels)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+                            preferred_element_type=jnp.float32)
+    if scale != 1.0:
+        s = s * scale
     if mask_rc is not None:
         rows, cols = mask_rc
         s = jnp.where(rows >= cols, s, NEG_INF)
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = (p * (dp - delta) * scale).astype(k.dtype)
+    ds = p * (dp - delta)
+    if scale != 1.0:
+        ds = ds * scale
+    ds = ds.astype(k.dtype)
     return p, ds
 
 
@@ -328,16 +342,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
                                         preferred_element_type=jnp.float32)
 
     if causal:
-        interior = ki * block_k + block_k - 1 <= qi * block_q
-        crosses = (ki * block_k < (qi + 1) * block_q) & jnp.logical_not(interior)
-
-        @pl.when(interior)
-        def _interior():
-            _acc(None)
-
-        @pl.when(crosses)
-        def _diag():
-            _acc(_block_iotas(block_q, block_k, qi, ki))
+        _causal_dispatch(qi, ki, block_q, block_k, _acc)
     else:
         _acc(None)
 
@@ -366,16 +371,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
                                         preferred_element_type=jnp.float32)
 
     if causal:
-        interior = ki * block_k + block_k - 1 <= qi * block_q
-        crosses = ((qi + 1) * block_q > ki * block_k) & jnp.logical_not(interior)
-
-        @pl.when(interior)
-        def _interior():
-            _acc(None)
-
-        @pl.when(crosses)
-        def _diag():
-            _acc(_block_iotas(block_q, block_k, qi, ki))
+        _causal_dispatch(qi, ki, block_q, block_k, _acc)
     else:
         _acc(None)
 
@@ -521,9 +517,13 @@ def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
     t_k = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    # fold the softmax scale into q OUTSIDE the kernels: one multiply over
+    # (T, D) instead of a VPU pass over every (T², causal-half) score element
+    # in the forward and in both backward kernels; autodiff scales dq back
+    q = q * jnp.asarray(scale, q.dtype)
     to_bhtd = lambda x, t: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     o = _flash_bhtd(to_bhtd(q, t_q), to_bhtd(k, t_k), to_bhtd(v, t_k),
-                    float(scale), bool(causal), int(block_q), int(block_k))
+                    1.0, bool(causal), int(block_q), int(block_k))
     return o.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
 
 

@@ -1,0 +1,149 @@
+"""HF-model integration: real checkpoint → TPU runtime.
+
+The reference's per-arch injection containers + checkpoint loading
+(module_inject/replace_module.py:282, inference/engine.py:336-506) are
+exercised here as conversion: a genuine ``transformers`` GPT-2 (random
+weights — no network in CI) round-trips into the TPU model, matches the
+torch forward exactly, serves TP=2 == TP=1 logits, generates greedily like
+torch, and trains through ``deepspeed_tpu.initialize``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models.gpt2 import GPT2Model
+from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from deepspeed_tpu.module_inject.hf import (export_gpt2, hf_state_dict, load_gpt2,
+                                            load_hf_model, state_dict_to_tree)
+from deepspeed_tpu.parallel.topology import build_mesh
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def hf_gpt2():
+    from transformers import GPT2Config as HFConfig, GPT2LMHeadModel
+
+    torch.manual_seed(0)
+    cfg = HFConfig(vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+                   resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    model = GPT2LMHeadModel(cfg).eval()
+    return model
+
+
+@pytest.fixture()
+def ids():
+    rng = np.random.RandomState(0)
+    return rng.randint(0, 128, size=(2, 16)).astype(np.int32)
+
+
+class TestGPT2Conversion:
+    def test_logits_match_torch(self, hf_gpt2, ids):
+        model, params = load_hf_model(hf_gpt2)
+        assert isinstance(model, GPT2Model)
+        import dataclasses
+        model = GPT2Model(dataclasses.replace(model.config, dtype=jnp.float32,
+                                              use_flash_attention=False, remat=False))
+        ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+        with torch.no_grad():
+            theirs = hf_gpt2(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+    def test_export_roundtrip(self, hf_gpt2):
+        sd = hf_state_dict(hf_gpt2)
+        _, params = load_gpt2(sd)
+        back = export_gpt2(params)
+        for k, v in sd.items():
+            if k.endswith("attn.bias") or k.endswith("attn.masked_bias"):
+                continue  # HF causal-mask buffers, not parameters
+            np.testing.assert_allclose(back[k], v.astype(np.float32), rtol=1e-6,
+                                       err_msg=k)
+
+    def test_generate_matches_torch_greedy(self, hf_gpt2, ids):
+        model, params = load_hf_model(hf_gpt2)
+        import dataclasses
+        model = GPT2Model(dataclasses.replace(model.config, dtype=jnp.float32,
+                                              use_flash_attention=False, remat=False))
+        engine = deepspeed_tpu.init_inference(
+            model, config={"dtype": "fp32", "max_out_tokens": 64}, params=params)
+        out = np.asarray(engine.generate(ids, max_new_tokens=8, do_sample=False))
+        with torch.no_grad():
+            ref = hf_gpt2.generate(torch.tensor(ids, dtype=torch.long),
+                                   max_new_tokens=8, do_sample=False).numpy()
+        np.testing.assert_array_equal(out, ref)
+
+
+class TestHFTensorParallel:
+    def test_tp2_logits_match_tp1(self, hf_gpt2, ids):
+        import dataclasses
+        model, params = load_hf_model(hf_gpt2)
+        model = GPT2Model(dataclasses.replace(model.config, dtype=jnp.float32,
+                                              use_flash_attention=False, remat=False))
+        outs = {}
+        for tp in (1, 2):
+            comm.cdb = None
+            mesh = build_mesh(axis_dims={"pipe": 1, "data": 8 // tp, "expert": 1,
+                                         "seq": 1, "tensor": tp})
+            comm.init_distributed(mesh=mesh, verbose=False)
+            engine = deepspeed_tpu.init_inference(
+                model, config={"dtype": "fp32", "max_out_tokens": 64},
+                params=params, mesh=mesh)
+            outs[tp] = np.asarray(engine.forward(ids))
+        np.testing.assert_allclose(outs[2], outs[1], rtol=1e-5, atol=1e-5)
+
+
+class TestHFTraining:
+    def test_train_through_initialize(self, hf_gpt2):
+        import dataclasses
+        model, params = load_hf_model(hf_gpt2)
+        model = GPT2Model(dataclasses.replace(model.config,
+                                              use_flash_attention=False))
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "bf16": {"enabled": True},
+                    "zero_optimization": {"stage": 1},
+                    "steps_per_print": 0})
+        rng = np.random.RandomState(1)
+        batch = {"input_ids": rng.randint(0, 128, size=(8, 32)).astype(np.int32)}
+        losses = [float(engine.train_batch(batch)) for _ in range(6)]
+        assert losses[-1] < losses[0], losses
+
+
+class TestAutoTPOnForeignTrees:
+    def test_llama_style_state_dict_classification(self):
+        """AutoTP's name patterns must classify a llama-shaped tree (the
+        reference's policy-container coverage, containers/llama.py)."""
+        d, ffn = 16, 44
+        sd = {}
+        for i in range(2):
+            p = f"model.layers.{i}."
+            sd[p + "self_attn.q_proj.weight"] = np.zeros((d, d), np.float32)
+            sd[p + "self_attn.k_proj.weight"] = np.zeros((d, d), np.float32)
+            sd[p + "self_attn.v_proj.weight"] = np.zeros((d, d), np.float32)
+            sd[p + "self_attn.o_proj.weight"] = np.zeros((d, d), np.float32)
+            sd[p + "mlp.gate_proj.weight"] = np.zeros((d, ffn), np.float32)
+            sd[p + "mlp.up_proj.weight"] = np.zeros((d, ffn), np.float32)
+            sd[p + "mlp.down_proj.weight"] = np.zeros((ffn, d), np.float32)
+            sd[p + "input_layernorm.weight"] = np.zeros((d,), np.float32)
+        sd["model.embed_tokens.weight"] = np.zeros((256, d), np.float32)
+        sd["lm_head.weight"] = np.zeros((d, 256), np.float32)
+        tree = state_dict_to_tree(sd)
+        specs = AutoTP.infer_specs(jax.eval_shape(lambda: tree))
+        flat = {"/".join(str(getattr(k, "key", k)) for k in path): s
+                for path, s in jax.tree_util.tree_flatten_with_path(
+                    specs, is_leaf=lambda x: hasattr(x, "index"))[0]}
+        get = lambda frag: next(v for k, v in flat.items() if frag in k)
+        assert tuple(get("layers/0/self_attn/q_proj")) == (None, "tensor")
+        assert tuple(get("layers/0/self_attn/o_proj")) == ("tensor", None)
+        assert tuple(get("layers/0/mlp/up_proj")) == (None, "tensor")
+        assert tuple(get("layers/0/mlp/down_proj")) == ("tensor", None)
+        assert tuple(get("embed_tokens")) == ("tensor", None)
+        assert tuple(get("layers/0/input_layernorm")) == ()

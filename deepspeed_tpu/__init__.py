@@ -36,6 +36,7 @@ def initialize(args=None,
     deepspeed_tpu.models) or a bare loss callable with ``model_parameters``
     as the initial pytree.
     """
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 
     log_dist(f"deepspeed_tpu {__version__} initialize()", ranks=[0])
@@ -44,16 +45,28 @@ def initialize(args=None,
     if config is None and args is not None and getattr(args, "deepspeed_config", None) is not None:
         config = args.deepspeed_config
 
-    engine = DeepSpeedEngine(args=args,
-                             model=model,
-                             optimizer=optimizer,
-                             model_parameters=model_parameters,
-                             training_data=training_data,
-                             lr_scheduler=lr_scheduler,
-                             mpu=mpu,
-                             dist_init_required=dist_init_required,
-                             collate_fn=collate_fn,
-                             config=config)
+    # parse/validate ONCE; the engine receives the built config_class
+    ds_config = DeepSpeedConfig(config if config is not None else {})
+
+    # RLHF actors get the hybrid train<->generate engine (reference
+    # __init__.py:58 DeepSpeedHybridEngine branch on hybrid_engine.enabled)
+    engine_cls = DeepSpeedEngine
+    if ds_config.hybrid_engine.enabled:
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine_cls = DeepSpeedHybridEngine
+
+    engine = engine_cls(args=args,
+                        model=model,
+                        optimizer=optimizer,
+                        model_parameters=model_parameters,
+                        training_data=training_data,
+                        lr_scheduler=lr_scheduler,
+                        mpu=mpu,
+                        dist_init_required=dist_init_required,
+                        collate_fn=collate_fn,
+                        config=config,
+                        config_class=ds_config)
     return engine, engine.optimizer, engine.dataloader, engine.lr_scheduler
 
 

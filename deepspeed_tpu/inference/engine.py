@@ -52,6 +52,44 @@ def _sample(logits, rng, temperature: float, top_k: int, top_p: float, greedy: b
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def build_generate_fn(module, max_new_tokens: int, do_sample: bool,
+                      temperature: float, top_k: int, top_p: float,
+                      eos_token_id: Optional[int], param_transform=None):
+    """The jittable prefill + scan-decode generation program, shared by
+    InferenceEngine.generate and DeepSpeedHybridEngine.generate.
+    ``param_transform`` preprocesses the param tree inside the trace (e.g.
+    the training engine's host-offload stream-in)."""
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    def gen(params, ids, rng):
+        if param_transform is not None:
+            params = param_transform(params)
+        B, T = ids.shape
+        max_len = T + max_new_tokens
+        cache = module.init_cache(B, max_len)
+        if hasattr(module, "cache_partition_specs"):
+            cache = jax.lax.with_sharding_constraint(
+                cache, module.cache_partition_specs())
+        logits, cache = module.prefill(params, ids, cache)
+
+        def step(carry, _):
+            logits, cache, done, rng = carry
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits, sub, temperature, top_k, top_p,
+                          greedy=not do_sample)
+            nxt = jnp.where(done, jnp.int32(max(eos, 0)), nxt)
+            done = done | (nxt == eos)
+            logits, cache = module.decode_step(params, nxt, cache)
+            return (logits, cache, done, rng), nxt
+
+        done0 = jnp.zeros((B,), jnp.bool_)
+        _, toks = jax.lax.scan(step, (logits, cache, done0, rng),
+                               None, length=max_new_tokens)
+        return jnp.concatenate([ids, toks.T.astype(ids.dtype)], axis=1)
+
+    return gen
+
+
 class InferenceEngine:
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
                  params: Any = None, mesh=None):
@@ -143,32 +181,9 @@ class InferenceEngine:
         # gen derives them from ids inside the trace.
         key = ("gen", max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
         if key not in self._compiled:
-            eos = -1 if eos_token_id is None else int(eos_token_id)
-
-            def gen(params, ids, rng):
-                B, T = ids.shape
-                max_len = T + max_new_tokens
-                cache = self.module.init_cache(B, max_len)
-                cache = jax.lax.with_sharding_constraint(
-                    cache, self.module.cache_partition_specs()) \
-                    if hasattr(self.module, "cache_partition_specs") else cache
-                logits, cache = self.module.prefill(params, ids, cache)
-
-                def step(carry, i):
-                    logits, cache, done, rng = carry
-                    rng, sub = jax.random.split(rng)
-                    nxt = _sample(logits, sub, temperature, top_k, top_p, greedy=not do_sample)
-                    nxt = jnp.where(done, jnp.int32(max(eos, 0)), nxt)
-                    done = done | (nxt == eos)
-                    logits, cache = self.module.decode_step(params, nxt, cache)
-                    return (logits, cache, done, rng), nxt
-
-                done0 = jnp.zeros((B,), jnp.bool_)
-                (_, _, _, _), toks = jax.lax.scan(
-                    step, (logits, cache, done0, rng), jnp.arange(max_new_tokens))
-                return jnp.concatenate([ids, toks.T.astype(ids.dtype)], axis=1)
-
-            self._compiled[key] = jax.jit(gen)
+            self._compiled[key] = jax.jit(build_generate_fn(
+                self.module, max_new_tokens, do_sample, temperature, top_k,
+                top_p, eos_token_id))
         with self.mesh:
             return self._compiled[key](self.params, ids, jax.random.PRNGKey(seed))
 

@@ -166,6 +166,21 @@ class AioConfig(DeepSpeedConfigModel):
     overlap_events: bool = True
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """cf. reference runtime/hybrid_engine.py:32 + config HybridEngineConfig.
+
+    ``inference_tp_size`` / ``pin_parameters`` / ``tp_gather_partition_size``
+    are accepted for ds_config compatibility but are no-ops on TPU: generation
+    runs over the live sharded training params (see runtime/hybrid_engine.py
+    module docstring)."""
+    enabled: bool = False
+    max_out_tokens: int = Field(512, gt=0)
+    inference_tp_size: int = Field(1, ge=1)
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = Field(8, ge=1)
+
+
 class ElasticityConfig(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -211,6 +226,7 @@ class DeepSpeedConfig:
         self.data_types_config = DataTypesConfig(**pd.get("data_types", {}))
         self.aio_config = AioConfig(**pd.get("aio", {}))
         self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}))
+        self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
         self.sparse_attention = pd.get("sparse_attention", None)

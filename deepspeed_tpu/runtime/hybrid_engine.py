@@ -1,0 +1,120 @@
+"""Hybrid engine — one object that trains AND generates over shared weights.
+
+Counterpart of the reference's ``runtime/hybrid_engine.py`` (DeepSpeedHybridEngine
+:32) — the RLHF-actor workhorse: the same model must alternate
+``generate()`` (experience collection) and ``train_batch()`` (policy update)
+every iteration. The reference flips each module between its training form and
+an optimized inference container, gathering ZeRO-3 partitions into inference
+shards and releasing them after (``generate`` :178, ``populate_all_inference_policies``
+:302). On TPU none of that machinery is needed — and that's the design:
+
+* training state is a functional pytree; the jitted generation program simply
+  takes ``state.params`` as an argument. Weight sharing is zero-copy by
+  construction — no gather/scatter flip, no pinned inference shards.
+* ZeRO-3/TP shardings stay as they are: GSPMD inserts the per-layer
+  all-gathers for decode exactly as it does for the forward pass (the role of
+  the reference's ``gather_all_layers`` / inference_tp resharding).
+* the whole prefill + sampling loop is one compiled program (see
+  inference/engine.py), reused across RLHF iterations because only the param
+  VALUES change, never the program.
+
+Latency bookkeeping mirrors the reference's (``_generate_latency``,
+``generate_samples_per_sec`` role) so RLHF scripts can report both phases.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """Training engine + jitted generation over the live training params."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._gen_compiled = {}
+        # reference parity: per-phase wall-clock accounting (excludes the
+        # one-time XLA compile of the generation program)
+        self._generate_latency = 0.0
+        self._generate_calls = 0
+        self._generated_tokens = 0
+        hc = self._config.hybrid_engine
+        self._max_out_tokens = hc.max_out_tokens
+        log_dist("DeepSpeedHybridEngine ready (train<->generate over shared "
+                 "params)", ranks=[0])
+
+    # ----------------------------------------------------------------- modes
+    def eval(self):
+        """Reference .eval()/.train() API parity. Mode flips are no-ops on
+        TPU: there is no module state to rewrite — generation always reads
+        the live training params (see module docstring)."""
+        return self
+
+    def train(self, mode: bool = True):
+        return self
+
+    # -------------------------------------------------------------- generate
+    def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None, seed: Optional[int] = None,
+                 **kwargs):
+        """Autoregressive generation with the CURRENT training params.
+
+        Same jitted prefill+scan structure as InferenceEngine.generate, but
+        the params argument is ``self.state.params`` — the very tree the next
+        ``train_batch()`` will update. Returns (B, T+max_new_tokens) ids.
+        """
+        module = self.module
+        if not hasattr(module, "prefill") or not hasattr(module, "decode_step"):
+            raise NotImplementedError(
+                "hybrid generate() needs the model inference protocol "
+                "(prefill/decode_step/init_cache) — see models/gpt2.py")
+        ids = jnp.asarray(np.asarray(input_ids))
+        B, T = ids.shape
+        if T + max_new_tokens > self._max_out_tokens:
+            raise ValueError(f"sequence {T + max_new_tokens} exceeds hybrid_engine."
+                             f"max_out_tokens={self._max_out_tokens}")
+        key = (max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
+        first_call = key not in self._gen_compiled
+        if first_call:
+            from deepspeed_tpu.inference.engine import build_generate_fn
+
+            # _compute_params inside the trace: streams host-offloaded params
+            # into HBM exactly like the training forward (engine.py)
+            self._gen_compiled[key] = jax.jit(build_generate_fn(
+                module, max_new_tokens, do_sample, temperature, top_k, top_p,
+                eos_token_id, param_transform=self._compute_params))
+        rng = jax.random.PRNGKey(self._host_rng_seed() if seed is None else seed)
+        t0 = time.time()
+        with self.mesh:
+            out = self._gen_compiled[key](self.state.params, ids, rng)
+        out.block_until_ready()
+        if not first_call:   # don't pollute tok/s with the one-time compile
+            self._generate_latency += time.time() - t0
+        self._generate_calls += 1
+        self._generated_tokens += B * max_new_tokens
+        return out
+
+    def _host_rng_seed(self) -> int:
+        # fresh seed per call so repeated sampling differs across RLHF steps
+        return int(getattr(self, "_host_step", 0)) * 100003 + self._generate_calls
+
+    # ------------------------------------------------------------ accounting
+    def generate_samples_per_sec(self) -> float:
+        if self._generate_latency == 0:
+            return 0.0
+        return self._generated_tokens / self._generate_latency
+
+    def hybrid_stats(self) -> dict:
+        return {"generate_calls": self._generate_calls,
+                "generate_latency_s": self._generate_latency,
+                "generated_tokens": self._generated_tokens,
+                "generate_tok_per_sec": self.generate_samples_per_sec()}

@@ -96,6 +96,12 @@ class InferenceEngine:
         self._config = config or DeepSpeedInferenceConfig()
         self.module = model
         self.dtype = self._config.jnp_dtype()
+        # dtype int8 = weight-only quantized serving (reference engine.py
+        # quantization path + GroupQuantizer): weights stored int8/int4,
+        # compute stays bf16 — dequant fuses into the compiled forward
+        self._quantize_weights = self.dtype == jnp.int8
+        if self._quantize_weights:
+            self.dtype = jnp.bfloat16
 
         tp = self._config.tp_size
         if mesh is None:
@@ -145,6 +151,36 @@ class InferenceEngine:
                     lambda: jax.tree.map(to_dtype, model.init_params(jax.random.PRNGKey(0))),
                     out_shardings=shardings)()
         self._param_specs = specs
+        self._dequant = None
+        if self._quantize_weights:
+            from deepspeed_tpu.ops.quantizer import (dequantize_params,
+                                                     quantize_params,
+                                                     quantized_nbytes)
+
+            wq = self._config.quant.weight
+            if not (self._config.quant.enabled and wq.enabled):
+                log_dist("dtype int8 but quant.weight disabled: serving bf16 "
+                         "weights unquantized", ranks=[0])
+            else:
+                bits = wq.num_bits if wq.num_bits in (4, 8) else 8
+                if bits != wq.num_bits:
+                    from deepspeed_tpu.utils.logging import logger
+
+                    logger.warning(f"quant.weight.num_bits={wq.num_bits} "
+                                   f"unsupported; using {bits}")
+                before = sum(x.nbytes for x in jax.tree.leaves(self.params))
+                with mesh:
+                    self.params = quantize_params(
+                        self.params, num_bits=bits,
+                        symmetric=(wq.q_type != "asymmetric"),
+                        q_groups=wq.q_groups if wq.q_groups > 1 else None,
+                        min_numel=int(wq.quantized_initialization.get(
+                            "min_numel", 1 << 16)))
+                dtype = self.dtype
+                self._dequant = lambda p: dequantize_params(p, dtype)
+                log_dist(f"weight quantization: {before/1e6:.1f}MB -> "
+                         f"{quantized_nbytes(self.params)/1e6:.1f}MB "
+                         f"(int{bits})", ranks=[0])
         self._compiled = {}
         log_dist(f"InferenceEngine ready: dtype={jnp.dtype(self.dtype).name}, tp={self.mp_world_size}",
                  ranks=[0])
@@ -154,7 +190,8 @@ class InferenceEngine:
         """Full-sequence logits (HF-style forward)."""
         key = ("fwd",)
         if key not in self._compiled:
-            self._compiled[key] = jax.jit(lambda p, ids: self.module.apply(p, ids))
+            dq = self._dequant or (lambda p: p)
+            self._compiled[key] = jax.jit(lambda p, ids: self.module.apply(dq(p), ids))
         ids = jnp.asarray(np.asarray(input_ids))
         with self.mesh:
             return self._compiled[key](self.params, ids)
@@ -183,7 +220,7 @@ class InferenceEngine:
         if key not in self._compiled:
             self._compiled[key] = jax.jit(build_generate_fn(
                 self.module, max_new_tokens, do_sample, temperature, top_k,
-                top_p, eos_token_id))
+                top_p, eos_token_id, param_transform=self._dequant))
         with self.mesh:
             return self._compiled[key](self.params, ids, jax.random.PRNGKey(seed))
 

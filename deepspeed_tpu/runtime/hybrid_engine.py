@@ -97,10 +97,12 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         with self.mesh:
             out = self._gen_compiled[key](self.state.params, ids, rng)
         out.block_until_ready()
-        if not first_call:   # don't pollute tok/s with the one-time compile
-            self._generate_latency += time.time() - t0
         self._generate_calls += 1
-        self._generated_tokens += B * max_new_tokens
+        if not first_call:
+            # steady-state throughput accounting: the one-time XLA compile
+            # call contributes neither latency nor tokens
+            self._generate_latency += time.time() - t0
+            self._generated_tokens += B * max_new_tokens
         return out
 
     def _host_rng_seed(self) -> int:

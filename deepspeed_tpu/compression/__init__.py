@@ -1,0 +1,14 @@
+from deepspeed_tpu.compression.basic_ops import (channel_prune, fake_quantize,
+                                                 head_prune, layer_reduce,
+                                                 row_prune, sparse_prune,
+                                                 topk_mask)
+from deepspeed_tpu.compression.compress import (CompressionTransform,
+                                                init_compression,
+                                                redundancy_clean,
+                                                student_initialization)
+from deepspeed_tpu.compression.config import CompressionConfig
+
+__all__ = ["CompressionConfig", "CompressionTransform", "init_compression",
+           "redundancy_clean", "student_initialization", "fake_quantize",
+           "sparse_prune", "row_prune", "channel_prune", "head_prune",
+           "layer_reduce", "topk_mask"]

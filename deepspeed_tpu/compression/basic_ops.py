@@ -1,0 +1,153 @@
+"""Compression primitives — the functional core of the subsystem.
+
+Counterpart of the reference's ``compression/basic_layer.py``
+(LinearLayer_Compress :767 — a Linear subclass that mixes in quantization /
+sparse / row / head / channel pruning) and ``compression/utils.py``
+(TopKBinarizer, SymQuantizer/AsymQuantizer autograd functions with
+straight-through gradients). TPU-native: each technique is a pure function
+``w -> w'`` applied to the param pytree inside the jitted train step —
+autograd functions become ``jax.custom_vjp`` straight-through estimators,
+binarizers become quantile masks, and "replacing a Linear module" is just
+mapping the transform over the matching leaves.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+# ------------------------------------------------------- quantization (QAT)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def fake_quantize(w, num_bits: int, num_groups: int, symmetric: bool,
+                  stochastic: bool):
+    """Quantize-dequantize with straight-through gradients (reference
+    SymQuantizer/AsymQuantizer, utils.py). Groups tile the flattened tensor
+    (reference semantics: ``quantize_groups`` per tensor)."""
+    return _fake_quantize_fwd_impl(w, num_bits, num_groups, symmetric, stochastic)
+
+
+def _fake_quantize_fwd_impl(w, num_bits, num_groups, symmetric, stochastic):
+    shape = w.shape
+    n = w.size
+    g = max(1, min(num_groups, n))
+    pad = (-n) % g
+    flat = jnp.pad(w.reshape(-1).astype(jnp.float32), (0, pad)).reshape(g, -1)
+    qmax = float(2 ** (num_bits - 1) - 1)
+    if symmetric:
+        scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
+        zero = 0.0
+    else:
+        lo = jnp.min(flat, axis=1, keepdims=True)
+        hi = jnp.max(flat, axis=1, keepdims=True)
+        scale = (hi - lo) / (2 * qmax)
+        zero = (hi + lo) / 2
+    scale = jnp.maximum(scale, 1e-12)
+    x = (flat - zero) / scale
+    if stochastic:
+        # stochastic rounding (reference ROUNDING=stochastic): seed from the
+        # value bits so the noise pattern changes as the weights change —
+        # a fixed key would re-round every entry the same way each step and
+        # reintroduce the systematic bias stochastic rounding removes
+        seed = jax.lax.bitcast_convert_type(
+            jnp.sum(x, dtype=jnp.float32), jnp.int32)
+        noise = jax.random.uniform(
+            jax.random.PRNGKey(seed), x.shape, minval=-0.5, maxval=0.5)
+        q = jnp.floor(x + 0.5 + noise)
+    else:
+        q = jnp.round(x)
+    q = jnp.clip(q, -qmax, qmax)
+    out = (q * scale + zero).reshape(-1)[:n].reshape(shape)
+    return out.astype(w.dtype)
+
+
+def _fake_quantize_fwd(w, num_bits, num_groups, symmetric, stochastic):
+    return _fake_quantize_fwd_impl(w, num_bits, num_groups, symmetric, stochastic), None
+
+
+def _fake_quantize_bwd(num_bits, num_groups, symmetric, stochastic, _, g):
+    return (g,)   # straight-through
+
+
+fake_quantize.defvjp(_fake_quantize_fwd, _fake_quantize_bwd)
+
+
+# ---------------------------------------------------------------- binarizers
+def topk_mask(scores, dense_ratio: float):
+    """1.0 where ``scores`` is in the top ``dense_ratio`` fraction, else 0.0
+    (reference TopKBinarizer role, without the learned-threshold variant)."""
+    flat = scores.reshape(-1).astype(jnp.float32)
+    thresh = jnp.quantile(flat, 1.0 - dense_ratio)
+    return (scores >= thresh).astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def ste_mask_apply(w, dense_ratio: float, scores):
+    """w * mask(scores) with straight-through gradient to w."""
+    return w * topk_mask(scores, dense_ratio).astype(w.dtype)
+
+
+def _ste_mask_fwd(w, dense_ratio, scores):
+    return w * topk_mask(scores, dense_ratio).astype(w.dtype), None
+
+
+def _ste_mask_bwd(dense_ratio, _, g):
+    return (g, None)
+
+
+ste_mask_apply.defvjp(_ste_mask_fwd, _ste_mask_bwd)
+
+
+# ------------------------------------------------------------------- pruning
+def sparse_prune(w, dense_ratio: float, method: str = "l1"):
+    """Unstructured magnitude pruning (reference SPARSE_PRUNING, method l1 =
+    magnitude scores, topk = same scores + STE masking)."""
+    scores = jnp.abs(w.astype(jnp.float32))
+    if method == "topk":
+        return ste_mask_apply(w, dense_ratio, scores)
+    return w * topk_mask(scores, dense_ratio).astype(w.dtype)
+
+
+def row_prune(w, dense_ratio: float, method: str = "l1"):
+    """Structured row pruning: score = L1 norm per INPUT row of an
+    (..., in, out) weight (reference ROW_PRUNING)."""
+    scores = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=-1, keepdims=True)
+    mask = topk_mask(scores, dense_ratio)
+    return w * jnp.broadcast_to(mask, w.shape).astype(w.dtype)
+
+
+def channel_prune(w, dense_ratio: float, method: str = "l1"):
+    """Structured output-channel pruning (reference CHANNEL_PRUNING): score =
+    L1 norm per output column."""
+    scores = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    mask = topk_mask(scores, dense_ratio)
+    return w * jnp.broadcast_to(mask, w.shape).astype(w.dtype)
+
+
+def head_prune(w, num_heads: int, dense_ratio: float):
+    """Attention head pruning (reference HEAD_PRUNING): the output dim of an
+    attention projection is split into ``num_heads`` groups; lowest-L1 heads
+    are zeroed."""
+    *lead, n_in, n_out = w.shape
+    assert n_out % num_heads == 0, (n_out, num_heads)
+    per = n_out // num_heads
+    g = w.reshape(*lead, n_in, num_heads, per)
+    scores = jnp.sum(jnp.abs(g.astype(jnp.float32)),
+                     axis=tuple(range(len(lead))) + (-3, -1)) if lead else \
+        jnp.sum(jnp.abs(g.astype(jnp.float32)), axis=(-3, -1))
+    # scores: (num_heads,) [shared across stacked layers when lead dims exist]
+    k = max(1, int(round(num_heads * dense_ratio)))
+    thresh = jnp.sort(scores)[-k]
+    mask = (scores >= thresh).astype(jnp.float32)        # (num_heads,)
+    return (g * mask[..., :, None].astype(w.dtype)).reshape(w.shape)
+
+
+# -------------------------------------------------------------- layer reduce
+def layer_reduce(stacked, teacher_layer):
+    """Slice a layer-stacked leaf (L, ...) down to ``teacher_layer`` indices —
+    the reference's layer_reduction student initialization
+    (compress.py student_initialization) expressed on stacked params."""
+    idx = jnp.asarray(list(teacher_layer), jnp.int32)
+    return jnp.take(stacked, idx, axis=0)

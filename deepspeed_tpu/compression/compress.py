@@ -1,0 +1,197 @@
+"""Compression orchestration — init_compression / redundancy_clean.
+
+Counterpart of the reference's ``compression/compress.py`` (init_compression
+:95 rewrites matching nn.Modules into LinearLayer_Compress and arms their
+techniques; redundancy_clean :123 makes masks/quantization permanent after
+training; scheduler.py gates each technique on its ``schedule_offset``).
+
+TPU-native: ``init_compression`` compiles the config into ONE pure function
+``transform(params, step)`` applied to the param tree inside the jitted train
+step. Schedule offsets become ``jnp.where(step >= offset, compressed, raw)``
+— traced once, no per-phase recompilation, and the engine's ``state.step``
+drives it. Module scopes are matched against the flattened param paths (the
+same name signals the reference matches against module names)."""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression import basic_ops
+from deepspeed_tpu.compression.config import (CompressionConfig, PruneGroupParams,
+                                              PruneSharedParams, QuantGroupParams,
+                                              QuantSharedParams)
+from deepspeed_tpu.utils.logging import logger
+
+
+from deepspeed_tpu.utils.pytree import path_str as _path_of
+
+
+def _matches_scope(path: str, modules) -> bool:
+    for pat in modules:
+        pat = str(pat).lower()
+        if pat == "*" or pat in path or fnmatch.fnmatch(path, f"*{pat}*") \
+                or re.search(pat, path):
+            return True
+    return False
+
+
+def _weight_like(leaf) -> bool:
+    return hasattr(leaf, "shape") and len(leaf.shape) >= 2 and \
+        hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating)
+
+
+class CompressionTransform:
+    """The compiled plan: per-leaf list of (offset, fn) to apply in order."""
+
+    def __init__(self, config: CompressionConfig, param_shapes: Any):
+        self.config = config
+        flat, self._treedef = jax.tree_util.tree_flatten_with_path(param_shapes)
+        self._plans = []          # per leaf: list of (schedule_offset, fn)
+        n_armed = 0
+        for path, leaf in flat:
+            p = _path_of(path)
+            plan = []
+            if _weight_like(leaf):
+                plan += self._quant_plan(p)
+                plan += self._prune_plans(p, leaf)
+            self._plans.append(plan)
+            n_armed += bool(plan)
+        logger.info(f"init_compression: {n_armed} tensors armed")
+
+    # ------------------------------------------------------------ per leaf
+    def _quant_plan(self, path):
+        tc = self.config.weight_quantization
+        shared = QuantSharedParams(**tc.shared_parameters)
+        if not shared.enabled:
+            return []
+        for group in tc.different_groups.values():
+            if _matches_scope(path, group.modules):
+                gp = QuantGroupParams(**group.params)
+                bits = int(gp.target_bits)
+                sym = shared.quantization_type != "asymmetric"
+                sto = shared.rounding == "stochastic"
+                groups = shared.quantize_groups
+                return [(shared.schedule_offset,
+                         lambda w: basic_ops.fake_quantize(w, bits, groups, sym, sto))]
+        return []
+
+    def _prune_plans(self, path, leaf):
+        plans = []
+        for tc, fn_name in ((self.config.sparse_pruning, "sparse_prune"),
+                            (self.config.row_pruning, "row_prune"),
+                            (self.config.channel_pruning, "channel_prune"),
+                            (self.config.head_pruning, "head_prune")):
+            shared = PruneSharedParams(**tc.shared_parameters)
+            if not shared.enabled:
+                continue
+            for group in tc.different_groups.values():
+                if not _matches_scope(path, group.modules):
+                    continue
+                gp = PruneGroupParams(**group.params)
+                if fn_name == "head_prune":
+                    nh = int(gp.num_heads or 1)
+                    plans.append((shared.schedule_offset,
+                                  lambda w, nh=nh, r=gp.dense_ratio:
+                                  basic_ops.head_prune(w, nh, r)))
+                else:
+                    fn = getattr(basic_ops, fn_name)
+                    plans.append((shared.schedule_offset,
+                                  lambda w, fn=fn, r=gp.dense_ratio,
+                                  m=shared.method: fn(w, r, m)))
+                break
+        return plans
+
+    # ------------------------------------------------------------- applying
+    def transform(self, params: Any, step) -> Any:
+        """Jit-traceable: apply each armed technique once its offset passes."""
+        leaves = jax.tree_util.tree_leaves(params)
+        out = []
+        for leaf, plan in zip(leaves, self._plans):
+            w = leaf
+            for offset, fn in plan:
+                w = jnp.where(step >= offset, fn(w), w)
+            out.append(w)
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def finalize(self, params: Any) -> Any:
+        """Make compression permanent (reference redundancy_clean): apply all
+        armed techniques unconditionally to concrete params."""
+        leaves = jax.tree_util.tree_leaves(params)
+        out = []
+        for leaf, plan in zip(leaves, self._plans):
+            w = leaf
+            for _, fn in plan:
+                w = fn(w)
+            out.append(w)
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+
+def init_compression(model_or_engine, deepspeed_config, teacher_model=None,
+                     mpu=None) -> Any:
+    """Arm compression (reference compress.py:95).
+
+    * DeepSpeedEngine → installs the transform into the engine's forward
+      path (every subsequent train step sees compressed weights) and returns
+      the engine.
+    * param pytree → returns a ``CompressionTransform`` for manual use.
+    """
+    cfg = CompressionConfig.from_ds_config(
+        deepspeed_config if isinstance(deepspeed_config, dict)
+        else {"compression_training": getattr(deepspeed_config, "compression_config", {})})
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    if isinstance(model_or_engine, DeepSpeedEngine):
+        engine = model_or_engine
+        shapes = jax.eval_shape(lambda: engine.state.params)
+        engine._compression = CompressionTransform(cfg, shapes)
+        engine._compiled_train_batch.clear()   # retrace with the transform
+        return engine
+    shapes = jax.eval_shape(lambda: model_or_engine)
+    return CompressionTransform(cfg, shapes)
+
+
+def redundancy_clean(model_or_params, deepspeed_config, mpu=None):
+    """Post-training cleanup (reference compress.py:123): masks/quantization
+    become permanent values in the returned param tree."""
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    if isinstance(model_or_params, DeepSpeedEngine):
+        engine = model_or_params
+        tr = getattr(engine, "_compression", None)
+        if tr is None:
+            tr = init_compression(jax.eval_shape(lambda: engine.state.params),
+                                  deepspeed_config)
+        new_params = tr.finalize(engine.state.params)
+        engine.state = engine.state._replace(params=new_params)
+        return engine
+    tr = CompressionTransform(
+        CompressionConfig.from_ds_config(deepspeed_config),
+        jax.eval_shape(lambda: model_or_params))
+    return tr.finalize(model_or_params)
+
+
+def student_initialization(student_params, teacher_params, deepspeed_config):
+    """Layer-reduction student init (reference compress.py student_initialization):
+    stacked (L, ...) leaves are sliced to ``teacher_layer`` indices; other
+    leaves copy through."""
+    cfg = CompressionConfig.from_ds_config(deepspeed_config)
+    lr = cfg.layer_reduction
+    if not lr.enabled:
+        return teacher_params
+    teacher_idx = list(lr.teacher_layer)
+
+    def pick(s_leaf, t_leaf):
+        if hasattr(t_leaf, "shape") and t_leaf.shape and hasattr(s_leaf, "shape") \
+                and s_leaf.shape != t_leaf.shape \
+                and s_leaf.shape[1:] == t_leaf.shape[1:] \
+                and s_leaf.shape[0] == len(teacher_idx):
+            return basic_ops.layer_reduce(t_leaf, teacher_idx)
+        return t_leaf if s_leaf.shape == t_leaf.shape else s_leaf
+
+    return jax.tree_util.tree_map(pick, student_params, teacher_params)

@@ -1,0 +1,79 @@
+"""Compression config — key-compatible with the reference's
+``compression/config.py`` + ``constants.py`` (``compression_training`` block:
+weight_quantization / activation_quantization / sparse_pruning / row_pruning /
+head_pruning / channel_pruning / layer_reduction, each with
+``shared_parameters`` and per-group ``different_groups``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class QuantSharedParams(DeepSpeedConfigModel):
+    enabled: bool = False
+    quantizer_kernel: bool = False          # accepted; XLA fuses the fake-quant
+    schedule_offset: int = Field(0, ge=0)
+    quantize_groups: int = Field(1, ge=1)
+    quantize_verbose: bool = False
+    quantization_type: str = "symmetric"    # symmetric | asymmetric
+    quantize_weight_in_forward: bool = True
+    rounding: str = "nearest"               # nearest | stochastic
+    fp16_mixed_quantize: Dict = {}
+    quantization_period: int = Field(1, ge=1)
+
+
+class QuantGroupParams(DeepSpeedConfigModel):
+    start_bits: int = 8
+    target_bits: int = 8
+    quantization_period: Optional[int] = None
+
+
+class PruneSharedParams(DeepSpeedConfigModel):
+    enabled: bool = False
+    schedule_offset: int = Field(1000, ge=0)
+    method: str = "l1"                      # l1 | topk
+
+
+class PruneGroupParams(DeepSpeedConfigModel):
+    dense_ratio: float = Field(0.5, gt=0.0, le=1.0)
+    num_heads: Optional[int] = None         # head_pruning only
+
+
+class CompressionGroup(DeepSpeedConfigModel):
+    params: Dict[str, Any] = {}
+    modules: List[str] = ["*"]
+    related_modules: Optional[List[Any]] = None
+
+
+class TechniqueConfig(DeepSpeedConfigModel):
+    shared_parameters: Dict[str, Any] = {}
+    different_groups: Dict[str, CompressionGroup] = {}
+
+
+class LayerReductionConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    keep_number_layer: Optional[int] = None
+    module_name_prefix: str = ""
+    teacher_layer: List[int] = []
+    other_module_name: List[str] = []
+
+
+class CompressionConfig(DeepSpeedConfigModel):
+    weight_quantization: TechniqueConfig = {}
+    activation_quantization: TechniqueConfig = {}
+    sparse_pruning: TechniqueConfig = {}
+    row_pruning: TechniqueConfig = {}
+    head_pruning: TechniqueConfig = {}
+    channel_pruning: TechniqueConfig = {}
+    layer_reduction: LayerReductionConfig = {}
+
+    @classmethod
+    def from_ds_config(cls, ds_config: Dict) -> "CompressionConfig":
+        """Accept either the full ds_config or the compression_training block."""
+        block = ds_config.get("compression_training", ds_config) if isinstance(
+            ds_config, dict) else {}
+        return cls(**block)

@@ -25,9 +25,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-QUANT_KEY = "__quant__"     # kept for backward-compat introspection
-
-
 @jax.tree_util.register_pytree_node_class
 class QuantizedTensor:
     """A quantized weight leaf: int8/packed-int4 codes + per-group scales.
@@ -84,6 +81,11 @@ def quantize_tensor(w, num_bits: int = 8, group_size: int = 128,
     """
     assert num_bits in (8, 4), num_bits
     orig_dtype = w.dtype
+    orig_shape = tuple(int(s) for s in w.shape)
+    if w.ndim == 1:
+        # flat buffers (reference ds_quantizer quantizes 1-D gradients too):
+        # treat as a single-column matrix, group along the length
+        w = w.reshape(-1, 1)
     g, group_size = _group_reshape(w.astype(jnp.float32), group_size)
     qmax = 127.0 if num_bits == 8 else 7.0
     if symmetric:
@@ -107,7 +109,7 @@ def quantize_tensor(w, num_bits: int = 8, group_size: int = 128,
     return QuantizedTensor(
         num_bits, q, scale.squeeze(-2).astype(jnp.float32),
         zero.squeeze(-2).astype(jnp.float32) if zero is not None else None,
-        tuple(int(s) for s in w.shape), jnp.dtype(orig_dtype))
+        orig_shape, jnp.dtype(orig_dtype))
 
 
 def dequantize_tensor(leaf: "QuantizedTensor", dtype=None):
@@ -133,7 +135,7 @@ def is_quantized_leaf(x) -> bool:
     return isinstance(x, QuantizedTensor)
 
 
-def _eligible(path_str: str, leaf, min_numel: int, exclude) -> bool:
+def _eligible(path: str, leaf, min_numel: int, exclude) -> bool:
     if not hasattr(leaf, "shape") or len(leaf.shape) < 2:
         return False
     if not jnp.issubdtype(jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype")
@@ -141,7 +143,7 @@ def _eligible(path_str: str, leaf, min_numel: int, exclude) -> bool:
         return False
     if int(np.prod(leaf.shape)) < min_numel:
         return False
-    return not any(pat in path_str for pat in (exclude or ()))
+    return not any(pat in path for pat in (exclude or ()))
 
 
 DEFAULT_EXCLUDE = ("wte", "wpe", "embed", "ln", "bias")
@@ -155,10 +157,12 @@ def quantize_params(params: Any, num_bits: int = 8, group_size: int = 128,
     excluded by default — like the reference, only the projection matrices
     are quantized. ``q_groups`` (reference semantics: groups per tensor)
     overrides ``group_size`` per leaf as in_dim // q_groups."""
+    from deepspeed_tpu.utils.pytree import path_str
+
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     out = []
     for path, leaf in flat:
-        p = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path).lower()
+        p = path_str(path)
         if _eligible(p, leaf, min_numel, exclude):
             gs = group_size if not q_groups else max(1, leaf.shape[-2] // q_groups)
             out.append(quantize_tensor(leaf, num_bits=num_bits,
@@ -198,7 +202,8 @@ class Quantizer:
         self.symmetric = symmetric
 
     def quantize(self, w):
-        group_size = max(1, w.shape[-2] // self.q_groups) if len(w.shape) >= 2 else 0
+        group_dim = w.shape[-2] if w.ndim >= 2 else w.shape[0]
+        group_size = max(1, group_dim // self.q_groups)
         return quantize_tensor(w, num_bits=self.num_bits, group_size=group_size,
                                symmetric=self.symmetric)
 

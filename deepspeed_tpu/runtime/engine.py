@@ -264,6 +264,15 @@ class DeepSpeedEngine:
         if training_data is not None:
             self.dataloader = self.deepspeed_io(training_data)
 
+        # arm compression-aware training when ds_config carries a
+        # compression_training block (clients may also call
+        # deepspeed_tpu.compression.init_compression explicitly)
+        self._compression = None
+        if self._config.compression_config:
+            from deepspeed_tpu.compression.compress import init_compression
+
+            init_compression(self, {"compression_training": self._config.compression_config})
+
         log_dist(f"engine ready: dtype={jnp.dtype(self.train_dtype).name}, zero={self.zero_stage}, "
                  f"dp={self.dp_world_size}, tp={self.mp_world_size}, "
                  f"micro_batch={self.train_micro_batch_size_per_gpu()}, "
@@ -379,10 +388,15 @@ class DeepSpeedEngine:
         """Device-memory twins of (possibly host-resident) shardings."""
         return jax.tree.map(lambda s: s.with_memory_kind("device"), shardings)
 
-    def _compute_params(self, params):
-        """Inside-trace: stream host-offloaded params into HBM for compute."""
+    def _compute_params(self, params, step=None):
+        """Inside-trace: stream host-offloaded params into HBM for compute;
+        apply the armed compression transform (QAT fake-quant / pruning
+        masks, compression/compress.py) when a step is in scope."""
         if self._host_offload_param:
-            return jax.device_put(params, self._dev_kind(self.state_shardings.params))
+            params = jax.device_put(params, self._dev_kind(self.state_shardings.params))
+        comp = getattr(self, "_compression", None)
+        if comp is not None and step is not None:
+            params = comp.transform(params, step)
         return params
 
     def _micro_loss_and_grads(self, params, batch, rng, scale):
@@ -495,7 +509,7 @@ class DeepSpeedEngine:
         fused train step and the NVMe host-step path (gas>1: lax.scan over
         microbatches, reference engine grad-accumulation semantics)."""
         plan = self.plan
-        params_c = self._compute_params(state.params)
+        params_c = self._compute_params(state.params, step=state.step)
         if gas == 1:
             rng = jax.random.fold_in(state.rng, state.step)
             return self._micro_loss_and_grads(params_c, batch, rng, scale)
@@ -554,6 +568,7 @@ class DeepSpeedEngine:
         def local_step(state: TrainState, batch):
             masters0 = state.master if state.master is not None else state.params
             fwd_params = opt.effective_params(state.params, masters0, state.opt_state)
+            fwd_params = self._compute_params(fwd_params, step=state.step)
             state = state._replace(params=fwd_params)
             if gas == 1:
                 rng = jax.random.fold_in(state.rng, state.step)
@@ -779,8 +794,9 @@ class DeepSpeedEngine:
                 scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
                 rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step),
                                          jnp.int32(0))
-                loss, grads = self._micro_loss_and_grads(self._compute_params(state.params),
-                                                         batch, rng, scale)
+                loss, grads = self._micro_loss_and_grads(
+                    self._compute_params(state.params, step=state.step),
+                    batch, rng, scale)
                 grads = jax.lax.with_sharding_constraint(grads, self.plan.grad_specs)
                 return loss, grads
 
@@ -849,7 +865,7 @@ class DeepSpeedEngine:
         """Loss without grads (for eval loops)."""
         if self._compiled_eval is None:
             def ev(state, batch):
-                p = self._compute_params(state.params)
+                p = self._compute_params(state.params, step=state.step)
                 out = self._loss_fn(p, batch, state.rng) if self._loss_accepts_rng() \
                     else self._loss_fn(p, batch)
                 return out[0] if isinstance(out, tuple) else out

@@ -87,15 +87,23 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         if first_call:
             from deepspeed_tpu.inference.engine import build_generate_fn
 
-            # _compute_params inside the trace: streams host-offloaded params
-            # into HBM exactly like the training forward (engine.py)
-            self._gen_compiled[key] = jax.jit(build_generate_fn(
+            inner = build_generate_fn(
                 module, max_new_tokens, do_sample, temperature, top_k, top_p,
-                eos_token_id, param_transform=self._compute_params))
+                eos_token_id)
+
+            # _compute_params inside the trace: streams host-offloaded params
+            # into HBM and applies the armed compression transform at the
+            # CURRENT step — rollouts must use the same effective policy the
+            # train step optimizes
+            def gen(params, ids, rng, step):
+                return inner(self._compute_params(params, step=step), ids, rng)
+
+            self._gen_compiled[key] = jax.jit(gen)
         rng = jax.random.PRNGKey(self._host_rng_seed() if seed is None else seed)
         t0 = time.time()
         with self.mesh:
-            out = self._gen_compiled[key](self.state.params, ids, rng)
+            out = self._gen_compiled[key](self.state.params, ids, rng,
+                                          self.state.step)
         out.block_until_ready()
         self._generate_calls += 1
         if not first_call:

@@ -1,0 +1,9 @@
+"""Small pytree helpers shared across subsystems."""
+
+from __future__ import annotations
+
+
+def path_str(path) -> str:
+    """jax key-path → lowercase slash-joined string ("blocks/qkv_w")."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+                    for k in path).lower()

@@ -51,6 +51,13 @@ class TestQuantizeTensor:
         back = q.dequantize(q.quantize(w))
         assert float(jnp.max(jnp.abs(back - w))) < 0.05
 
+    def test_quantizer_1d_buffer(self):
+        q = Quantizer(q_groups=4, num_bits=8)
+        w = jnp.asarray(np.random.RandomState(3).randn(256).astype(np.float32))
+        back = q.dequantize(q.quantize(w))
+        assert back.shape == w.shape
+        assert float(jnp.max(jnp.abs(back - w))) < 0.05
+
 
 class TestQuantizeParams:
     def test_tree_transform_and_memory(self):

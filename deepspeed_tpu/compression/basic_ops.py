@@ -134,10 +134,9 @@ def head_prune(w, num_heads: int, dense_ratio: float):
     assert n_out % num_heads == 0, (n_out, num_heads)
     per = n_out // num_heads
     g = w.reshape(*lead, n_in, num_heads, per)
+    # scores: (num_heads,) — shared across stacked layers when lead dims exist
     scores = jnp.sum(jnp.abs(g.astype(jnp.float32)),
-                     axis=tuple(range(len(lead))) + (-3, -1)) if lead else \
-        jnp.sum(jnp.abs(g.astype(jnp.float32)), axis=(-3, -1))
-    # scores: (num_heads,) [shared across stacked layers when lead dims exist]
+                     axis=tuple(range(len(lead))) + (-3, -1))
     k = max(1, int(round(num_heads * dense_ratio)))
     thresh = jnp.sort(scores)[-k]
     mask = (scores >= thresh).astype(jnp.float32)        # (num_heads,)

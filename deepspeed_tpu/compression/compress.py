@@ -33,11 +33,18 @@ from deepspeed_tpu.utils.pytree import path_str as _path_of
 
 
 def _matches_scope(path: str, modules) -> bool:
+    """Module-scope match: '*' wildcard, substring, glob, then regex (only
+    when the pattern compiles — glob-style strings like '*attn*' are not
+    valid regexes and must not crash plan building)."""
     for pat in modules:
         pat = str(pat).lower()
-        if pat == "*" or pat in path or fnmatch.fnmatch(path, f"*{pat}*") \
-                or re.search(pat, path):
+        if pat == "*" or pat in path or fnmatch.fnmatch(path, f"*{pat}*"):
             return True
+        try:
+            if re.search(pat, path):
+                return True
+        except re.error:
+            pass
     return False
 
 
@@ -73,12 +80,23 @@ class CompressionTransform:
         for group in tc.different_groups.values():
             if _matches_scope(path, group.modules):
                 gp = QuantGroupParams(**group.params)
-                bits = int(gp.target_bits)
                 sym = shared.quantization_type != "asymmetric"
                 sto = shared.rounding == "stochastic"
                 groups = shared.quantize_groups
-                return [(shared.schedule_offset,
-                         lambda w: basic_ops.fake_quantize(w, bits, groups, sym, sto))]
+                period = int(gp.quantization_period or shared.quantization_period)
+                # staged bit annealing (reference basic_layer bit reduction):
+                # start_bits at schedule_offset, one bit fewer every
+                # quantization_period steps until target_bits. Later (coarser)
+                # stages override earlier ones in transform()'s sequential
+                # where-chain.
+                start = int(gp.start_bits)
+                target = int(gp.target_bits)
+                plan = []
+                for i, bits in enumerate(range(start, target - 1, -1)):
+                    plan.append((shared.schedule_offset + i * period,
+                                 lambda w, b=bits: basic_ops.fake_quantize(
+                                     w, b, groups, sym, sto)))
+                return plan
         return []
 
     def _prune_plans(self, path, leaf):
@@ -150,7 +168,7 @@ def init_compression(model_or_engine, deepspeed_config, teacher_model=None,
         engine = model_or_engine
         shapes = jax.eval_shape(lambda: engine.state.params)
         engine._compression = CompressionTransform(cfg, shapes)
-        engine._compiled_train_batch.clear()   # retrace with the transform
+        engine.invalidate_compiled()           # retrace EVERY path with the transform
         return engine
     shapes = jax.eval_shape(lambda: model_or_engine)
     return CompressionTransform(cfg, shapes)

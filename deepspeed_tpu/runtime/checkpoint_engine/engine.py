@@ -127,5 +127,11 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.micro_steps = meta.get("micro_steps", 0)
         if engine.lr_scheduler is not None and meta.get("lr_scheduler"):
             engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    # host-side step counter drives curriculum difficulty + logging cadence:
+    # resume it from the restored device step, or a resumed run would replay
+    # the whole curriculum ramp from min difficulty
+    engine._host_step = int(engine.state.step)
+    if getattr(engine, "curriculum_scheduler", None) is not None:
+        engine.curriculum_scheduler.update_difficulty(engine._host_step + 1)
     log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
     return path, meta.get("client_state", {})

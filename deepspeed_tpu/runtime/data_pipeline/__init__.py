@@ -1,0 +1,8 @@
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (RandomLTDScheduler,
+                                                              random_ltd_gather,
+                                                              random_ltd_scatter)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling import apply_seqlen_curriculum
+
+__all__ = ["CurriculumScheduler", "RandomLTDScheduler", "random_ltd_gather",
+           "random_ltd_scatter", "apply_seqlen_curriculum"]

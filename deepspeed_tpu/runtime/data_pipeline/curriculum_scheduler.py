@@ -1,0 +1,113 @@
+"""Curriculum learning scheduler — difficulty as a function of global step.
+
+Counterpart of the reference's ``runtime/data_pipeline/curriculum_scheduler.py``
+(CurriculumScheduler; schedules: fixed_linear / fixed_root / fixed_discrete /
+custom), the legacy ``"curriculum_learning"`` ds_config block, and the engine
+hookup (reference engine.py:336, 1702-1705). Pure host-side step math — the
+part of the data pipeline that ports to any accelerator unchanged.
+
+TPU note: each distinct difficulty value changes the compiled train-step
+shapes, so ``difficulty_step`` (reference: multiple of 8 for tensor cores; on
+TPU use ≥128-multiples of the sequence dim where possible) directly bounds
+the number of recompilations.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    """config keys (reference constants.py): curriculum_type, min_difficulty,
+    max_difficulty, schedule_type, schedule_config{...}."""
+
+    def __init__(self, config: Dict):
+        for req in ("min_difficulty", "max_difficulty", "schedule_type"):
+            assert req in config, f"Curriculum learning requires the config '{req}'"
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.curriculum_type = config.get("curriculum_type", "seqlen")
+        self.schedule_type = config["schedule_type"]
+        self.schedule_config = dict(config.get("schedule_config", {}))
+        self.current_difficulty = self.min_difficulty
+        self._custom_fn: Optional[Callable[[int], int]] = None
+
+        sc = self.schedule_config
+        if self.schedule_type == FIXED_DISCRETE:
+            diff = sc.get("difficulty", [])
+            max_step = sc.get("max_step", [])
+            assert len(diff) > 0 and len(diff) == len(max_step) + 1, \
+                "fixed_discrete needs len(difficulty) == len(max_step) + 1"
+        elif self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            assert "total_curriculum_step" in sc, \
+                f"{self.schedule_type} requires schedule_config.total_curriculum_step"
+            assert "difficulty_step" in sc, \
+                f"{self.schedule_type} requires schedule_config.difficulty_step"
+            if self.schedule_type == FIXED_ROOT:
+                assert "root_degree" in sc, \
+                    "fixed_root requires schedule_config.root_degree"
+            if int(sc["difficulty_step"]) % 8 != 0:
+                logger.warning(
+                    "curriculum difficulty_step should be a multiple of 8 "
+                    "(and ideally of the TPU lane width 128 for the seqlen "
+                    "metric) to limit padding waste and recompilations")
+        elif self.schedule_type == CUSTOM:
+            pass
+        else:
+            raise ValueError(f"unknown curriculum schedule_type {self.schedule_type!r}")
+
+    # ------------------------------------------------------------- schedules
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        """reference set_custom_curriculum_learning_schedule analogue."""
+        self._custom_fn = fn
+
+    def _fixed_root(self, step: int, root_degree: Optional[int] = None) -> int:
+        sc = self.schedule_config
+        if root_degree is None:
+            root_degree = int(sc["root_degree"])
+        frac = (float(step) / float(sc["total_curriculum_step"])) ** (1.0 / root_degree)
+        nxt = int(math.floor(frac * (self.max_difficulty - self.min_difficulty)
+                             + self.min_difficulty))
+        nxt -= nxt % int(sc["difficulty_step"])
+        return max(self.min_difficulty, min(nxt, self.max_difficulty))
+
+    def _fixed_discrete(self, step: int) -> int:
+        diff = self.schedule_config["difficulty"]
+        max_step = self.schedule_config["max_step"]
+        for d, ms in zip(diff, max_step):
+            if step <= ms:
+                return int(d)
+        return int(diff[-1])
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.schedule_type == FIXED_LINEAR:
+            return self._fixed_root(global_steps, root_degree=1)
+        if self.schedule_type == FIXED_ROOT:
+            return self._fixed_root(global_steps)
+        if self.schedule_type == FIXED_DISCRETE:
+            return self._fixed_discrete(global_steps)
+        assert self._custom_fn is not None, \
+            "custom schedule requires set_custom_get_difficulty(fn)"
+        return int(self._custom_fn(global_steps))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    # ------------------------------------------------------------ checkpoint
+    def state_dict(self) -> Dict:
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd: Dict):
+        self.current_difficulty = int(sd["current_difficulty"])

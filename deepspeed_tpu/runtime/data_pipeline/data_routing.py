@@ -1,0 +1,101 @@
+"""Data routing — random layer token dropping (random-LTD).
+
+Counterpart of the reference's ``data_pipeline/data_routing`` package
+(``scheduler.py`` RandomLTDScheduler, ``basic_layer.py`` RandomLayerTokenDrop
+and the gather/scatter in ``csrc/random_ltd``): middle transformer layers are
+trained on a random SUBSET of tokens, with the kept-token count ramping from
+``min_value`` to ``max_value`` on a fixed_linear schedule. TPU-native: the
+gather/scatter CUDA kernels become ``jnp.take_along_axis`` ops (static kept
+count per compiled program — the schedule's ``seq_per_step`` granularity
+bounds recompiles, exactly like curriculum seqlen)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """reference data_routing/scheduler.py: fixed_linear kept-token ramp +
+    consumed-layer-token accounting.
+
+    config: {total_layer_num, random_ltd_layer_num, global_batch_size,
+             schedule: {min_value, max_value, schedule_type,
+                        schedule_config: {require_steps, seq_per_step}}}
+    """
+
+    def __init__(self, config: Dict):
+        self.model_layer_num = int(config["total_layer_num"])
+        self.random_ltd_layer_num = int(config["random_ltd_layer_num"])
+        self.global_batch_size = int(config.get("global_batch_size", 1))
+        sched = config["schedule"]
+        self.min_value = int(sched["min_value"])
+        self.max_value = int(sched["max_value"])
+        self.schedule_type = sched.get("schedule_type", "fixed_linear")
+        sc = sched.get("schedule_config", {})
+        self.require_steps = int(sc["require_steps"])
+        self.seq_per_step = int(sc.get("seq_per_step", 8))
+        self.current_value = self.min_value
+        self.consumed_layer_tokens = 0
+        self._last_step = -1
+
+    def get_value(self, global_steps: int) -> int:
+        if self.schedule_type != "fixed_linear":
+            raise RuntimeError("Unsupported random LTD schedule type")
+        nxt = math.floor((float(global_steps) / self.require_steps)
+                         * (self.max_value - self.min_value) + self.min_value)
+        nxt -= nxt % self.seq_per_step
+        return max(self.min_value, min(nxt, self.max_value))
+
+    def update_seq(self, global_steps: int) -> int:
+        if global_steps != self._last_step:
+            self.current_value = self.get_value(global_steps)
+            self.consumed_layer_tokens += self.global_batch_size * (
+                self.current_value * self.random_ltd_layer_num
+                + self.max_value * (self.model_layer_num - self.random_ltd_layer_num))
+            self._last_step = global_steps
+        return self.current_value
+
+    def get_current_seq(self) -> int:
+        return self.current_value
+
+    def get_random_ltd_layer_num(self) -> int:
+        return self.random_ltd_layer_num
+
+    def get_total_layer_tokens(self, train_iters: int) -> int:
+        for step in range(train_iters):
+            self.update_seq(step)
+        return self.consumed_layer_tokens
+
+    def state_dict(self) -> Dict:
+        return {"current_value": self.current_value,
+                "consumed_layer_tokens": self.consumed_layer_tokens}
+
+    def load_state_dict(self, sd: Dict):
+        self.current_value = int(sd["current_value"])
+        self.consumed_layer_tokens = int(sd["consumed_layer_tokens"])
+
+
+def random_ltd_sample(rng, seq_len: int, kept: int, batch: int):
+    """Per-sequence random token indices to KEEP, sorted (reference
+    basic_layer.py's token_sort semantics keep relative order)."""
+    def one(key):
+        perm = jax.random.permutation(key, seq_len)[:kept]
+        return jnp.sort(perm)
+
+    return jax.vmap(one)(jax.random.split(rng, batch))      # (B, kept)
+
+
+def random_ltd_gather(x, idx):
+    """(B, T, D) + (B, kept) → (B, kept, D): the csrc/random_ltd
+    gather_tokens kernel as a jnp op."""
+    return jnp.take_along_axis(x, idx[..., None], axis=1)
+
+
+def random_ltd_scatter(x_small, idx, x_full):
+    """Scatter processed kept tokens back over the full sequence (dropped
+    positions keep the residual input) — csrc/random_ltd scatter_tokens."""
+    return x_full.at[jnp.arange(x_full.shape[0])[:, None], idx].set(x_small)

@@ -1,0 +1,72 @@
+"""Curriculum data sampling — applying difficulty to batches.
+
+Counterpart of the reference's ``data_pipeline/data_sampling`` package and the
+Megatron-side seqlen truncation/reshape its curriculum tutorial prescribes:
+for the ``seqlen`` metric, a difficulty d means "train on the first d tokens".
+Host-side (numpy) so the truncation happens BEFORE device placement — each
+distinct difficulty compiles one program, bounded by ``difficulty_step``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+def apply_seqlen_curriculum(batch: Any, difficulty: int,
+                            truncate_keys=("input_ids", "labels", "loss_mask",
+                                           "attention_mask", "position_ids")) -> Any:
+    """Truncate the token dim of a batch to ``difficulty`` tokens.
+
+    dict batches: every known sequence-shaped key is cut; bare arrays are cut
+    on dim 1 when 2-D+. Reference parity: the curriculum tutorial's
+    ``seq_length`` reshape (truncation variant, the recommended one).
+    """
+    def cut(x):
+        x = np.asarray(x)
+        if x.ndim >= 2 and x.shape[1] > difficulty:
+            return x[:, :difficulty]
+        return x
+
+    if isinstance(batch, dict):
+        return {k: (cut(v) if k in truncate_keys else v) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        # only elements sharing the FIRST element's token dim are sequences;
+        # e.g. (input_ids (B,T), class_targets (B,C)) must not cut targets
+        first = np.asarray(batch[0])
+        seq_len = first.shape[1] if first.ndim >= 2 else None
+        return type(batch)(
+            cut(v) if seq_len is not None and np.asarray(v).ndim >= 2
+            and np.asarray(v).shape[1] == seq_len else v
+            for v in batch)
+    return cut(batch)
+
+
+def curriculum_config_from_ds(pd: Dict) -> Dict:
+    """Extract curriculum config from either the legacy top-level
+    ``curriculum_learning`` block or the ``data_efficiency.data_sampling.
+    curriculum_learning`` block (reference config.py supports both)."""
+    legacy = pd.get("curriculum_learning", {})
+    if legacy.get("enabled"):
+        return legacy
+    de = pd.get("data_efficiency", {})
+    ds = de.get("data_sampling", {})
+    cl = ds.get("curriculum_learning", {})
+    if de.get("enabled", True) and ds.get("enabled", True) and cl.get("enabled"):
+        # newer data_efficiency format nests per-metric configs; the seqlen
+        # metric block carries the schedule (reference data_efficiency docs)
+        metrics = cl.get("curriculum_metrics", {})
+        if "seqlen" in metrics:
+            m = dict(metrics["seqlen"])
+            m.setdefault("curriculum_type", "seqlen")
+            return {**m, "enabled": True}
+        if metrics:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(f"curriculum metrics {sorted(metrics)} unsupported "
+                           "on this build (only 'seqlen'); curriculum disabled")
+            return {}
+        if "min_difficulty" in cl:      # flat (non-metric) schedule block
+            return cl
+    return {}

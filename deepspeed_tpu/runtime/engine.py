@@ -237,6 +237,7 @@ class DeepSpeedEngine:
         self._compiled_fwd_bwd = None
         self._compiled_apply = None
         self._compiled_eval = None
+        self._compiled_loss_grads = {}
         self._grad_buffer = None
         self._last_metrics: Optional[StepMetrics] = None
         self.micro_steps = 0
@@ -272,6 +273,20 @@ class DeepSpeedEngine:
             from deepspeed_tpu.compression.compress import init_compression
 
             init_compression(self, {"compression_training": self._config.compression_config})
+
+        # curriculum learning (reference engine.py:336 legacy block +
+        # data_efficiency.data_sampling.curriculum_learning): seqlen
+        # difficulty is applied host-side per train_batch
+        self.curriculum_scheduler = None
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import \
+            curriculum_config_from_ds
+
+        cl_cfg = curriculum_config_from_ds(self._config._param_dict)
+        if cl_cfg.get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import \
+                CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
 
         log_dist(f"engine ready: dtype={jnp.dtype(self.train_dtype).name}, zero={self.zero_stage}, "
                  f"dp={self.dp_world_size}, tp={self.mp_world_size}, "
@@ -382,6 +397,19 @@ class DeepSpeedEngine:
             rng=repl,
             skipped_steps=repl)
         return state, shardings
+
+    def invalidate_compiled(self):
+        """Drop every cached jitted program. Anything that changes traced
+        behavior outside the TrainState (arming compression, swapping the
+        loss fn) must call this or stale programs keep the old semantics."""
+        self._compiled_train_batch = {}
+        self._compiled_fwd_bwd = None
+        self._compiled_apply = None
+        self._compiled_eval = None
+        self._compiled_accum = None
+        self._compiled_loss_grads = {}
+        if hasattr(self, "_gen_compiled"):      # hybrid engine generation
+            self._gen_compiled = {}
 
     # -------------------------------------------------------- compute pieces
     def _dev_kind(self, shardings):
@@ -700,6 +728,13 @@ class DeepSpeedEngine:
             assert data_iter is not None, "train_batch needs a batch or data_iter"
             batch = next(data_iter)
         gas = self._config.gradient_accumulation_steps
+        if self.curriculum_scheduler is not None:
+            from deepspeed_tpu.runtime.data_pipeline.data_sampling import \
+                apply_seqlen_curriculum
+
+            difficulty = self.curriculum_scheduler.update_difficulty(
+                getattr(self, "_host_step", 0) + 1)
+            batch = apply_seqlen_curriculum(batch, difficulty)
         batch = self._shard_batch(batch)
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
@@ -891,6 +926,22 @@ class DeepSpeedEngine:
                                        ("Train/Samples/lr", float(metrics.lr), self.global_samples)])
 
     # ------------------------------------------------------------ accessors
+    def curriculum_learning_enabled(self) -> bool:
+        return self.curriculum_scheduler is not None
+
+    def curriculum_enabled_legacy(self) -> bool:
+        """reference engine.py:509 name parity."""
+        return self.curriculum_learning_enabled()
+
+    def set_custom_curriculum_learning_schedule(self, schedule_func_dict):
+        """reference engine.py:425: install a custom difficulty function
+        ({'get_difficulty': fn(step)->int})."""
+        assert self.curriculum_scheduler is not None, \
+            "curriculum learning is not enabled in this config"
+        fn = schedule_func_dict["get_difficulty"] \
+            if isinstance(schedule_func_dict, dict) else schedule_func_dict
+        self.curriculum_scheduler.set_custom_get_difficulty(fn)
+
     def train_batch_size(self) -> int:
         return self._config.train_batch_size
 

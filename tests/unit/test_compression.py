@@ -127,7 +127,7 @@ class TestTransform:
         np.testing.assert_allclose(loss_3call, loss_eval, rtol=1e-5)
         # and both differ from the uncompressed loss
         engine._compression = None
-        engine._compiled_eval = None
+        engine.invalidate_compiled()
         loss_raw = float(engine.eval_batch((x, y)))
         assert abs(loss_raw - loss_eval) > 1e-6
 

@@ -1,0 +1,158 @@
+"""Data pipeline tests — reference tests/unit/runtime/test_data_efficiency
+role: curriculum schedules, seqlen application during training, random-LTD
+scheduler math + gather/scatter ops."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                 RandomLTDScheduler,
+                                                 apply_seqlen_curriculum,
+                                                 random_ltd_gather,
+                                                 random_ltd_scatter)
+from deepspeed_tpu.runtime.data_pipeline.data_routing import random_ltd_sample
+
+
+class TestCurriculumScheduler:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({"curriculum_type": "seqlen",
+                                 "min_difficulty": 8, "max_difficulty": 64,
+                                 "schedule_type": "fixed_linear",
+                                 "schedule_config": {"total_curriculum_step": 100,
+                                                     "difficulty_step": 8}})
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(100) == 64
+        mid = s.get_difficulty(50)
+        assert 8 < mid < 64 and mid % 8 == 0
+        # monotone
+        vals = [s.get_difficulty(t) for t in range(0, 120, 10)]
+        assert vals == sorted(vals)
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                                 "schedule_type": "fixed_root",
+                                 "schedule_config": {"total_curriculum_step": 100,
+                                                     "difficulty_step": 8,
+                                                     "root_degree": 2}})
+        # sqrt schedule front-loads difficulty vs linear
+        lin = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                                   "schedule_type": "fixed_linear",
+                                   "schedule_config": {"total_curriculum_step": 100,
+                                                       "difficulty_step": 8}})
+        assert s.get_difficulty(25) >= lin.get_difficulty(25)
+        assert s.get_difficulty(200) == 64
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({"min_difficulty": 2, "max_difficulty": 6,
+                                 "schedule_type": "fixed_discrete",
+                                 "schedule_config": {"difficulty": [2, 4, 6],
+                                                     "max_step": [5, 10]}})
+        assert s.get_difficulty(3) == 2
+        assert s.get_difficulty(7) == 4
+        assert s.get_difficulty(50) == 6
+
+    def test_custom(self):
+        s = CurriculumScheduler({"min_difficulty": 1, "max_difficulty": 10,
+                                 "schedule_type": "custom"})
+        s.set_custom_get_difficulty(lambda t: min(10, 1 + t))
+        assert s.get_difficulty(3) == 4
+
+    def test_state_roundtrip(self):
+        s = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                                 "schedule_type": "fixed_linear",
+                                 "schedule_config": {"total_curriculum_step": 100,
+                                                     "difficulty_step": 8}})
+        s.update_difficulty(50)
+        sd = s.state_dict()
+        s2 = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                                  "schedule_type": "fixed_linear",
+                                  "schedule_config": {"total_curriculum_step": 100,
+                                                      "difficulty_step": 8}})
+        s2.load_state_dict(sd)
+        assert s2.get_current_difficulty() == s.get_current_difficulty()
+
+
+class TestApplySeqlen:
+    def test_dict_batch(self):
+        b = {"input_ids": np.zeros((4, 32), np.int32),
+             "labels": np.zeros((4, 32), np.int32),
+             "meta": np.zeros((4,))}
+        out = apply_seqlen_curriculum(b, 16)
+        assert out["input_ids"].shape == (4, 16)
+        assert out["labels"].shape == (4, 16)
+        assert out["meta"].shape == (4,)
+
+    def test_engine_applies_curriculum(self):
+        cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32, n_layer=2,
+                         n_head=2, remat=False, use_flash_attention=False)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=GPT2Model(cfg),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "curriculum_learning": {
+                        "enabled": True, "curriculum_type": "seqlen",
+                        "min_difficulty": 8, "max_difficulty": 32,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": 4,
+                                            "difficulty_step": 8}},
+                    "steps_per_print": 0})
+        assert engine.curriculum_learning_enabled()
+        rng = np.random.RandomState(0)
+        batch = {"input_ids": rng.randint(0, 256, size=(8, 32)).astype(np.int32)}
+        difficulties = []
+        for _ in range(5):
+            loss = float(engine.train_batch(batch))
+            difficulties.append(engine.curriculum_scheduler.get_current_difficulty())
+        assert np.isfinite(loss)
+        assert difficulties[0] == 8
+        assert difficulties[-1] == 32
+        assert difficulties == sorted(difficulties)
+
+
+class TestRandomLTD:
+    def _sched(self):
+        return RandomLTDScheduler({
+            "total_layer_num": 12, "random_ltd_layer_num": 8,
+            "global_batch_size": 4,
+            "schedule": {"min_value": 16, "max_value": 64,
+                         "schedule_type": "fixed_linear",
+                         "schedule_config": {"require_steps": 10,
+                                             "seq_per_step": 16}}})
+
+    def test_schedule_ramp(self):
+        s = self._sched()
+        assert s.get_value(0) == 16
+        assert s.get_value(10) == 64
+        assert s.update_seq(5) in range(16, 65, 16)
+        assert s.consumed_layer_tokens > 0
+
+    def test_token_accounting(self):
+        s = self._sched()
+        total = s.get_total_layer_tokens(3)
+        # per step: B * (kept*ltd_layers + full*other_layers)
+        assert total > 0
+
+    def test_gather_scatter_roundtrip(self):
+        rng = jax.random.PRNGKey(0)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 4).astype(np.float32))
+        idx = random_ltd_sample(rng, 16, 8, 2)
+        assert idx.shape == (2, 8)
+        small = random_ltd_gather(x, idx)
+        assert small.shape == (2, 8, 4)
+        # scatter the gathered tokens back -> identical where kept
+        back = random_ltd_scatter(small * 2.0, idx, x)
+        picked = np.take_along_axis(np.asarray(back), np.asarray(idx)[..., None], axis=1)
+        np.testing.assert_allclose(picked, np.asarray(small) * 2.0)
+
+    def test_state_roundtrip(self):
+        s = self._sched()
+        s.update_seq(5)
+        sd = s.state_dict()
+        s2 = self._sched()
+        s2.load_state_dict(sd)
+        assert s2.get_current_seq() == s.get_current_seq()

@@ -1,0 +1,4 @@
+from deepspeed_tpu.autotuning.autotuner import (Autotuner, AutotuningConfig,
+                                                Experiment)
+
+__all__ = ["Autotuner", "AutotuningConfig", "Experiment"]

@@ -1,0 +1,252 @@
+"""Autotuner — config-space search over short measured training runs.
+
+Counterpart of the reference's ``autotuning/autotuner.py`` (Autotuner :404,
+~3k LoC with ``runner.py`` :449 as the CLI entry): enumerate candidate
+ds_configs (ZeRO stage × micro-batch × ...), run each briefly, measure the
+chosen metric, prune what cannot work, and emit the best config. The
+reference launches each experiment as a separate multi-GPU job via the
+launcher and scrapes metrics from logs; on TPU's single-controller runtime
+the experiments run IN-PROCESS — a config that doesn't fit fails at XLA
+compile time with a catchable ResourceExhausted, so OOM pruning is exact
+rather than log-scraped, and there is no scheduler/job machinery to port.
+
+Tuner strategies (reference tuner/ package): grid search, random, and a
+model-based ordering that ranks candidates by a simple memory/throughput
+prior and stops after ``early_stopping`` non-improving experiments.
+
+ds_config surface (reference constants.py "autotuning" block): enabled,
+metric (throughput|latency|flops), start_profile_step/end_profile_step,
+tuner_type, tuner_early_stopping, tuner_num_trials, results_dir, exps_dir,
+max_train_micro_batch_size_per_gpu, mbs_list, zero_stage_list (TPU extra:
+remat_list).
+"""
+
+from __future__ import annotations
+
+import gc
+import itertools
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+METRIC_THROUGHPUT = "throughput"
+METRIC_LATENCY = "latency"
+METRIC_FLOPS = "flops"
+
+
+@dataclass
+class AutotuningConfig:
+    enabled: bool = False
+    metric: str = METRIC_THROUGHPUT
+    start_profile_step: int = 2
+    end_profile_step: int = 6
+    tuner_type: str = "model_based"          # gridsearch | random | model_based
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    fast: bool = True
+    mbs_list: Optional[List[int]] = None
+    zero_stage_list: Optional[List[int]] = None
+    remat_list: Optional[List[str]] = None   # TPU extra: none|full|dots|attn
+
+    @classmethod
+    def from_ds_config(cls, pd: Dict) -> "AutotuningConfig":
+        block = dict(pd.get("autotuning", {}))
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in block.items() if k in known})
+
+
+@dataclass
+class Experiment:
+    """One measured candidate (reference exps json schema role)."""
+    exp_id: int
+    ds_config: Dict[str, Any]
+    status: str = "pending"                  # pending | ok | oom | error
+    metric_val: float = 0.0
+    tok_per_sec: float = 0.0
+    step_time_s: float = 0.0
+    error: str = ""
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+    def record(self) -> Dict[str, Any]:
+        return {"exp_id": self.exp_id, "status": self.status,
+                "metric_val": self.metric_val, "tok_per_sec": self.tok_per_sec,
+                "step_time_s": self.step_time_s, "error": self.error,
+                "ds_config": self.ds_config, **self.extras}
+
+
+class Autotuner:
+    """Search the candidate space with real short runs.
+
+    ``model_factory() -> model`` builds a fresh model per experiment (param
+    memory must be released between candidates); ``batch_factory(batch_size)
+    -> batch`` supplies data. ``base_config`` is the user's ds_config; tuned
+    keys override it per candidate.
+    """
+
+    def __init__(self, model_factory, batch_factory, base_config: Dict,
+                 tuning: Optional[AutotuningConfig] = None,
+                 seq_len: Optional[int] = None):
+        self.model_factory = model_factory
+        self.batch_factory = batch_factory
+        self.base_config = dict(base_config)
+        self.tuning = tuning or AutotuningConfig.from_ds_config(self.base_config)
+        self.seq_len = seq_len
+        self.experiments: List[Experiment] = []
+
+    # -------------------------------------------------------------- space
+    def candidate_space(self) -> List[Dict[str, Any]]:
+        import jax
+
+        n_dev = len(jax.devices())
+        t = self.tuning
+        mbs_list = t.mbs_list or [4, 8, 16, 32]
+        zero_list = t.zero_stage_list if t.zero_stage_list is not None else \
+            ([1] if n_dev == 1 else [1, 2, 3])
+        remat_list = t.remat_list or ["attn", "full"]
+        out = []
+        for mbs, stage, remat in itertools.product(mbs_list, zero_list, remat_list):
+            cfg = json.loads(json.dumps(self.base_config))   # deep copy
+            cfg["train_batch_size"] = mbs * n_dev * \
+                cfg.get("gradient_accumulation_steps", 1)
+            cfg["train_micro_batch_size_per_gpu"] = mbs
+            cfg.setdefault("zero_optimization", {})["stage"] = stage
+            cfg["_tune"] = {"remat": remat, "micro_batch": mbs, "zero": stage}
+            out.append(cfg)
+        return out
+
+    def _order(self, cands: List[Dict]) -> List[Dict]:
+        t = self.tuning
+        if t.tuner_type == "random":
+            cands = list(cands)
+            random.Random(0).shuffle(cands)
+            return cands[: t.tuner_num_trials]
+        if t.tuner_type == "model_based":
+            # prior: bigger micro-batches first (better MXU util) but cheaper
+            # remat later (more memory) — order by (mbs desc, remat memory asc)
+            memory_rank = {"full": 0, "attn": 1, "dots": 2, "none": 3}
+            cands = sorted(cands, key=lambda c: (-c["_tune"]["micro_batch"],
+                                                 memory_rank.get(c["_tune"]["remat"], 9)))
+            return cands[: t.tuner_num_trials]
+        return list(cands)[: t.tuner_num_trials]   # gridsearch
+
+    # --------------------------------------------------------------- running
+    def _run_one(self, exp: Experiment):
+        import deepspeed_tpu
+
+        t = self.tuning
+        cfg = {k: v for k, v in exp.ds_config.items() if k != "_tune"}
+        tune = exp.ds_config.get("_tune", {})
+        refs = {}   # explicit slot so `finally` can drop device buffers
+        try:
+            model = self.model_factory(**({"remat": tune["remat"]} if "remat" in tune else {}))
+            refs["model"] = model
+            engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+            refs["engine"] = engine
+            batch = self.batch_factory(engine.train_batch_size())
+            refs["batch"] = batch
+            warm = max(1, t.start_profile_step)
+            for _ in range(warm):
+                loss = engine.train_batch(batch)
+            float(loss)
+            steps = max(1, t.end_profile_step - t.start_profile_step)
+            t0 = time.time()
+            for _ in range(steps):
+                loss = engine.train_batch(batch)
+            float(loss)
+            dt = (time.time() - t0) / steps
+            tokens = self._batch_tokens(batch)
+            exp.step_time_s = dt
+            exp.tok_per_sec = tokens / dt
+            exp.status = "ok"
+            if t.metric == METRIC_LATENCY:
+                exp.metric_val = -dt
+            elif t.metric == METRIC_FLOPS and hasattr(model, "config") and \
+                    hasattr(model.config, "flops_per_token"):
+                exp.metric_val = exp.tok_per_sec * model.config.flops_per_token(
+                    self.seq_len)
+            else:
+                exp.metric_val = exp.tok_per_sec
+        except Exception as e:  # compile OOM / invalid config — prune exactly
+            msg = str(e)
+            exp.status = "oom" if ("RESOURCE_EXHAUSTED" in msg
+                                   or "out of memory" in msg.lower()) else "error"
+            exp.error = msg[:500]
+        finally:
+            # release THIS candidate's device memory before the next compile:
+            # drop the engine/state refs, drop jit caches holding compiled
+            # programs (their constants pin buffers), then collect
+            eng = refs.get("engine")
+            if eng is not None:
+                eng.state = None
+                if hasattr(eng, "invalidate_compiled"):
+                    eng.invalidate_compiled()
+            refs.clear()
+            try:
+                import jax
+
+                jax.clear_caches()
+            except Exception:
+                pass
+            gc.collect()
+
+    @staticmethod
+    def _batch_tokens(batch) -> int:
+        import numpy as np
+
+        if isinstance(batch, dict):
+            x = next(iter(batch.values()))
+        elif isinstance(batch, (tuple, list)):
+            x = batch[0]
+        else:
+            x = batch
+        x = np.asarray(x)
+        return int(x.shape[0] * (x.shape[1] if x.ndim > 1 else 1))
+
+    def tune(self) -> Optional[Dict[str, Any]]:
+        """Run the search; returns the best ds_config (without _tune keys)."""
+        t = self.tuning
+        os.makedirs(t.exps_dir, exist_ok=True)
+        os.makedirs(t.results_dir, exist_ok=True)
+        cands = self._order(self.candidate_space())
+        logger.info(f"autotuner: {len(cands)} candidates "
+                    f"({t.tuner_type}, metric={t.metric})")
+        best: Optional[Experiment] = None
+        since_improved = 0
+        for i, cfg in enumerate(cands):
+            exp = Experiment(exp_id=i, ds_config=cfg)
+            self.experiments.append(exp)
+            self._run_one(exp)
+            with open(os.path.join(t.exps_dir, f"exp_{i}.json"), "w") as f:
+                json.dump(exp.record(), f, indent=2)
+            logger.info(f"autotuner exp {i}: {exp.status} "
+                        f"tune={cfg.get('_tune')} tok/s={exp.tok_per_sec:.0f}")
+            if exp.status == "ok" and (best is None or exp.metric_val > best.metric_val):
+                best = exp
+                since_improved = 0
+            else:
+                since_improved += 1
+                if t.tuner_early_stopping and since_improved >= t.tuner_early_stopping:
+                    logger.info("autotuner: early stopping")
+                    break
+        summary = {"num_experiments": len(self.experiments),
+                   "best_exp_id": best.exp_id if best else None,
+                   "metric": t.metric,
+                   "best_metric_val": best.metric_val if best else None,
+                   "experiments": [e.record() for e in self.experiments]}
+        with open(os.path.join(t.results_dir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        if best is None:
+            logger.warning("autotuner: no candidate succeeded")
+            return None
+        best_cfg = {k: v for k, v in best.ds_config.items() if k != "_tune"}
+        best_cfg["_tuned"] = best.ds_config.get("_tune", {})
+        with open(os.path.join(t.results_dir, "ds_config_optimal.json"), "w") as f:
+            json.dump(best_cfg, f, indent=2)
+        return best_cfg

@@ -86,14 +86,19 @@ class CompressionTransform:
                 period = int(gp.quantization_period or shared.quantization_period)
                 # staged bit annealing (reference basic_layer bit reduction):
                 # start_bits at schedule_offset, one bit fewer every
-                # quantization_period steps until target_bits. Later (coarser)
-                # stages override earlier ones in transform()'s sequential
-                # where-chain.
+                # quantization_period steps until target_bits. Each stage is
+                # WINDOWED [offset, next_offset) so exactly one bit width
+                # quantizes the raw weights at any step (the last stage has
+                # no upper bound).
                 start = int(gp.start_bits)
                 target = int(gp.target_bits)
                 plan = []
-                for i, bits in enumerate(range(start, target - 1, -1)):
-                    plan.append((shared.schedule_offset + i * period,
+                stages = list(range(start, target - 1, -1))
+                for i, bits in enumerate(stages):
+                    off = shared.schedule_offset + i * period
+                    end = (shared.schedule_offset + (i + 1) * period
+                           if i + 1 < len(stages) else None)
+                    plan.append((off, end,
                                  lambda w, b=bits: basic_ops.fake_quantize(
                                      w, b, groups, sym, sto)))
                 return plan
@@ -114,12 +119,12 @@ class CompressionTransform:
                 gp = PruneGroupParams(**group.params)
                 if fn_name == "head_prune":
                     nh = int(gp.num_heads or 1)
-                    plans.append((shared.schedule_offset,
+                    plans.append((shared.schedule_offset, None,
                                   lambda w, nh=nh, r=gp.dense_ratio:
                                   basic_ops.head_prune(w, nh, r)))
                 else:
                     fn = getattr(basic_ops, fn_name)
-                    plans.append((shared.schedule_offset,
+                    plans.append((shared.schedule_offset, None,
                                   lambda w, fn=fn, r=gp.dense_ratio,
                                   m=shared.method: fn(w, r, m)))
                 break
@@ -127,25 +132,30 @@ class CompressionTransform:
 
     # ------------------------------------------------------------- applying
     def transform(self, params: Any, step) -> Any:
-        """Jit-traceable: apply each armed technique once its offset passes."""
+        """Jit-traceable: apply each armed technique inside its step window
+        [offset, end) — end None = open-ended."""
         leaves = jax.tree_util.tree_leaves(params)
         out = []
         for leaf, plan in zip(leaves, self._plans):
             w = leaf
-            for offset, fn in plan:
-                w = jnp.where(step >= offset, fn(w), w)
+            for offset, end, fn in plan:
+                active = step >= offset if end is None else \
+                    (step >= offset) & (step < end)
+                w = jnp.where(active, fn(w), w)
             out.append(w)
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
     def finalize(self, params: Any) -> Any:
-        """Make compression permanent (reference redundancy_clean): apply all
-        armed techniques unconditionally to concrete params."""
+        """Make compression permanent (reference redundancy_clean): apply the
+        terminal stage of every armed technique to concrete params (windowed
+        annealing stages before the last are transitional, not final)."""
         leaves = jax.tree_util.tree_leaves(params)
         out = []
         for leaf, plan in zip(leaves, self._plans):
             w = leaf
-            for _, fn in plan:
-                w = fn(w)
+            for _, end, fn in plan:
+                if end is None:
+                    w = fn(w)
             out.append(w)
         return jax.tree_util.tree_unflatten(self._treedef, out)
 

@@ -8,6 +8,8 @@ than torch-elastic rendezvous.
 """
 
 from deepspeed_tpu.elasticity.config import ElasticityConfig, ElasticityError  # noqa: F401
+from deepspeed_tpu.elasticity.elastic_agent import (  # noqa: F401
+    DSElasticAgent, PreemptionSignal)
 from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
     compute_elastic_config, elasticity_enabled, get_candidate_batch_sizes,
     get_compatible_chip_counts, validate_elastic_config_from_script_args)

@@ -1,0 +1,139 @@
+"""Elastic agent — preemption detection, failure recovery, cross-mesh resume.
+
+Counterpart of the reference's ``elasticity/elastic_agent.py`` (DSElasticAgent
+:28 — a torchelastic LocalElasticAgent that monitors worker processes and
+restarts the job through a new rendezvous when membership changes). The TPU
+setting differs structurally: there are no per-GPU worker processes to
+babysit — a slice is a single SPMD program — and the failure modes are (a)
+host preemption (Cloud TPU sends SIGTERM well before reclaim) and (b) step
+failures. So the agent is a supervision loop around the training engine:
+
+* **preemption watch** — SIGTERM/SIGINT handlers set a flag; the step loop
+  checkpoints and exits cleanly at the next boundary (the reference's
+  scale-down signal).
+* **periodic + exit checkpoints** — through the engine's checkpoint engine
+  (orbax, ``latest`` tag), whose reshard-on-load already handles a DIFFERENT
+  mesh shape at resume — the TPU analogue of a new rendezvous world size.
+* **failure retry** — a failing step triggers save-state-free restart from
+  the last checkpoint via a fresh ``engine_factory()`` (which may build a
+  different mesh — elasticity.compute_elastic_config gives the batch
+  re-solve), up to ``max_restarts`` (reference agent's restart budget).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class PreemptionSignal(Exception):
+    """Raised inside the step loop when a preemption flag is set."""
+
+
+class DSElasticAgent:
+    def __init__(self,
+                 engine_factory: Callable[[], Any],
+                 save_dir: str,
+                 checkpoint_interval: int = 100,
+                 max_restarts: int = 3,
+                 install_signal_handlers: bool = True,
+                 tag: Optional[str] = None):
+        self.engine_factory = engine_factory
+        self.save_dir = save_dir
+        self.checkpoint_interval = int(checkpoint_interval)
+        self.max_restarts = int(max_restarts)
+        self.tag = tag
+        self._preempted = False
+        self.restart_count = 0
+        self.engine = None
+        if install_signal_handlers:
+            self._install_handlers()
+
+    # ------------------------------------------------------------- signals
+    def _install_handlers(self):
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, self._on_preempt)
+            except ValueError:      # not in main thread
+                logger.warning("elastic agent: cannot install signal handlers "
+                               "outside the main thread")
+                return
+
+    def _on_preempt(self, signum, frame):
+        logger.warning(f"elastic agent: received signal {signum} — will "
+                       "checkpoint and stop at the next step boundary")
+        self._preempted = True
+
+    def preempt(self):
+        """Programmatic preemption (tests / external watchers)."""
+        self._preempted = True
+
+    # ---------------------------------------------------------- lifecycle
+    def _bring_up(self, resume: bool) -> Any:
+        self.engine = self.engine_factory()
+        if resume and self._has_checkpoint():
+            self.engine.load_checkpoint(self.save_dir, tag=self.tag)
+            log_dist(f"elastic agent: resumed at step "
+                     f"{int(self.engine.state.step)} on "
+                     f"{self.engine.mesh.shape}", ranks=[0])
+        return self.engine
+
+    def _has_checkpoint(self) -> bool:
+        return os.path.isdir(self.save_dir) and bool(os.listdir(self.save_dir))
+
+    def _checkpoint(self):
+        self.engine.save_checkpoint(self.save_dir, tag=self.tag)
+
+    # --------------------------------------------------------------- run
+    def run(self, batches: Iterable, num_steps: int,
+            step_callback: Optional[Callable[[int, float], None]] = None) -> dict:
+        """Supervised training: up to ``num_steps`` engine steps with
+        periodic checkpoints, preemption-safe exit, and restart-on-failure.
+
+        ``batches``: an iterable yielding one global batch per step (it is
+        re-created per restart attempt via iter()). Returns a status dict.
+        """
+        batches_factory = batches if callable(batches) else (lambda: iter(batches))
+        resume = self._has_checkpoint()
+        while True:
+            try:
+                engine = self._bring_up(resume)
+                it = batches_factory() if callable(batches_factory) else iter(batches)
+                start_step = int(engine.state.step)
+                for local_i, batch in enumerate(it):
+                    step = start_step + local_i
+                    if step >= num_steps:
+                        break
+                    if self._preempted:
+                        raise PreemptionSignal()
+                    loss = engine.train_batch(batch)
+                    if step_callback is not None:
+                        step_callback(step, loss)
+                    done = step + 1
+                    if self.checkpoint_interval and \
+                            done % self.checkpoint_interval == 0:
+                        self._checkpoint()
+                self._checkpoint()
+                return {"status": "complete",
+                        "final_step": int(engine.state.step),
+                        "restarts": self.restart_count}
+            except PreemptionSignal:
+                self._checkpoint()
+                log_dist("elastic agent: preemption checkpoint written; "
+                         "exiting cleanly", ranks=[0])
+                return {"status": "preempted",
+                        "final_step": int(self.engine.state.step),
+                        "restarts": self.restart_count}
+            except Exception as e:
+                self.restart_count += 1
+                logger.warning(f"elastic agent: step failure ({e}); "
+                               f"restart {self.restart_count}/{self.max_restarts}")
+                if self.restart_count > self.max_restarts:
+                    raise
+                resume = True
+                self.engine = None
+                time.sleep(0.1)

@@ -131,7 +131,10 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     # resume it from the restored device step, or a resumed run would replay
     # the whole curriculum ramp from min difficulty
     engine._host_step = int(engine.state.step)
-    if getattr(engine, "curriculum_scheduler", None) is not None:
-        engine.curriculum_scheduler.update_difficulty(engine._host_step + 1)
+    sched = getattr(engine, "curriculum_scheduler", None)
+    if sched is not None and getattr(sched, "schedule_type", None) != "custom":
+        # custom schedules need the user's fn installed first; train_batch
+        # recomputes difficulty from _host_step on the next step anyway
+        sched.update_difficulty(engine._host_step + 1)
     log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
     return path, meta.get("client_state", {})

@@ -36,10 +36,12 @@ def apply_seqlen_curriculum(batch: Any, difficulty: int,
         # e.g. (input_ids (B,T), class_targets (B,C)) must not cut targets
         first = np.asarray(batch[0])
         seq_len = first.shape[1] if first.ndim >= 2 else None
-        return type(batch)(
-            cut(v) if seq_len is not None and np.asarray(v).ndim >= 2
-            and np.asarray(v).shape[1] == seq_len else v
-            for v in batch)
+        elems = [cut(v) if seq_len is not None and np.asarray(v).ndim >= 2
+                 and np.asarray(v).shape[1] == seq_len else v
+                 for v in batch]
+        if hasattr(batch, "_fields"):          # namedtuple
+            return type(batch)(*elems)
+        return type(batch)(elems)
     return cut(batch)
 
 

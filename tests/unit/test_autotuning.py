@@ -1,0 +1,83 @@
+"""Autotuner tests — reference tests/unit/autotuning role: candidate space,
+tuner ordering, real measured experiments, OOM/error pruning, result files."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner, AutotuningConfig
+from deepspeed_tpu.models.simple import SimpleModel
+
+HIDDEN = 16
+
+
+def _model_factory(remat=None):
+    return SimpleModel(hidden_dim=HIDDEN, nlayers=2)
+
+
+def _batch_factory(batch_size):
+    rng = np.random.RandomState(0)
+    return (rng.randn(batch_size, HIDDEN).astype(np.float32),
+            rng.randn(batch_size, HIDDEN).astype(np.float32))
+
+
+BASE = {"optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0}
+
+
+def _tuning(tmp_path, **kw):
+    return AutotuningConfig(enabled=True, start_profile_step=1, end_profile_step=2,
+                            results_dir=str(tmp_path / "results"),
+                            exps_dir=str(tmp_path / "exps"),
+                            mbs_list=[1, 2], zero_stage_list=[0, 1],
+                            remat_list=["none"], **kw)
+
+
+class TestAutotuner:
+    def test_candidate_space(self, tmp_path):
+        at = Autotuner(_model_factory, _batch_factory, BASE, _tuning(tmp_path))
+        cands = at.candidate_space()
+        assert len(cands) == 4  # 2 mbs x 2 stages x 1 remat
+        assert all("_tune" in c for c in cands)
+
+    def test_tune_finds_best_and_writes_results(self, tmp_path):
+        at = Autotuner(_model_factory, _batch_factory, BASE, _tuning(tmp_path))
+        best = at.tune()
+        assert best is not None
+        assert "_tuned" in best
+        ok = [e for e in at.experiments if e.status == "ok"]
+        assert len(ok) >= 1
+        # best really is the max-metric experiment
+        assert max(e.metric_val for e in ok) == \
+            max(e.metric_val for e in at.experiments)
+        summary = json.load(open(os.path.join(at.tuning.results_dir, "summary.json")))
+        assert summary["num_experiments"] == len(at.experiments)
+        assert os.path.isfile(os.path.join(at.tuning.results_dir,
+                                           "ds_config_optimal.json"))
+
+    def test_bad_candidate_is_pruned_not_fatal(self, tmp_path):
+        # train_batch_size 3*8 with mbs 3: fine; mbs 5 against dp=8 divides
+        # train_batch 40... make an invalid one via a bogus optimizer instead
+        bad_base = {"optimizer": {"type": "NoSuchOpt", "params": {}},
+                    "steps_per_print": 0}
+        at = Autotuner(_model_factory, _batch_factory, bad_base,
+                       _tuning(tmp_path, tuner_early_stopping=0))
+        best = at.tune()
+        assert best is None
+        assert all(e.status in ("error", "oom") for e in at.experiments)
+
+    def test_model_based_ordering_prefers_big_batches(self, tmp_path):
+        at = Autotuner(_model_factory, _batch_factory, BASE, _tuning(tmp_path))
+        ordered = at._order(at.candidate_space())
+        mbs = [c["_tune"]["micro_batch"] for c in ordered]
+        assert mbs[0] == max(mbs)
+
+    def test_latency_metric(self, tmp_path):
+        at = Autotuner(_model_factory, _batch_factory, BASE,
+                       _tuning(tmp_path, metric="latency"))
+        best = at.tune()
+        assert best is not None
+        ok = [e for e in at.experiments if e.status == "ok"]
+        assert all(e.metric_val <= 0 for e in ok)   # latency metric = -step_time

@@ -25,12 +25,16 @@ from deepspeed_tpu.runtime.pipe.engine import pipelined_loss_fn
 class PipelinedGPT2(GPT2Model):
     """Model-protocol implementation whose loss is the in-jit pipeline."""
 
-    def __init__(self, config: GPT2Config, num_stages: int, num_micro: int):
+    def __init__(self, config: GPT2Config, num_stages: int, num_micro: int,
+                 schedule: str = "1f1b"):
         super().__init__(config)
         if config.n_layer % num_stages:
             raise ValueError(f"n_layer {config.n_layer} not divisible by stages {num_stages}")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"schedule {schedule!r} not in ('1f1b', 'gpipe')")
         self.num_stages = num_stages
         self.num_micro = num_micro
+        self.schedule = schedule
         self._pipe_loss = None
 
     # ---------------------------------------------------------------- params
@@ -89,8 +93,11 @@ class PipelinedGPT2(GPT2Model):
     def loss(self, params, batch, rng=None):
         if self._pipe_loss is None:
             from deepspeed_tpu.comm import comm
+            from deepspeed_tpu.runtime.pipe.engine import pipelined_loss_fn_1f1b
 
-            self._pipe_loss = pipelined_loss_fn(
+            builder = pipelined_loss_fn_1f1b if self.schedule == "1f1b" \
+                else pipelined_loss_fn
+            self._pipe_loss = builder(
                 stage_fn=self._stage_fn,
                 first_stage_fn=self._first_stage_fn,
                 last_stage_loss_fn=self._last_stage_loss_fn,

@@ -19,10 +19,14 @@ The pipeline is manual over 'pipe' only (shard_map axis_names={'pipe'}): data/
 tensor/expert axes stay in GSPMD "auto" mode, so ZeRO sharding and Megatron TP
 compose with pipelining without any code here knowing about them.
 
-Schedule: fill-drain (GPipe) order with loss fused into the last stage's tick
-via lax.cond — bubble fraction (S-1)/(M+S-1); the memory-motivated 1F1B
-variant is round-2 work (XLA's scheduler already interleaves fwd/bwd of
-adjacent microbatches within the fused program).
+Two executors:
+
+* ``pipelined_loss_fn`` — fill-drain (GPipe) order, backward = jax.grad
+  THROUGH the scan (AD stacks one carry per tick → activation memory O(M));
+  bubble fraction (S-1)/(M+S-1). Cheapest for gradient-free evaluation.
+* ``pipelined_loss_fn_1f1b`` — 1F1B clock with a HAND-WRITTEN backward
+  (per-tick jax.vjp + a 2S-slot activation ring buffer → memory O(S)), the
+  reference TrainSchedule (schedule.py:189) executed in-jit.
 """
 
 from __future__ import annotations
@@ -124,6 +128,187 @@ def pipelined_loss_fn(stage_fn: Callable,
         return sm(params["stages"], params["shared"], mbs)
 
     return loss
+
+
+def pipelined_loss_fn_1f1b(stage_fn: Callable,
+                           first_stage_fn: Callable,
+                           last_stage_loss_fn: Callable,
+                           num_micro: int,
+                           mesh,
+                           remat_stage: bool = True) -> Callable:
+    """1F1B pipeline with a HAND-WRITTEN backward — bounded activation memory.
+
+    The GPipe path above differentiates THROUGH the fill-drain scan, so AD
+    stacks one saved carry per tick: in-flight activation memory grows O(M)
+    with the microbatch count. This executor instead walks the 1F1B clock of
+    the tested ``TrainSchedule`` (schedule.py:149 — stage s runs fwd of
+    microbatch ``t - s`` and bwd of microbatch ``t - (2S-2-s)`` at tick t,
+    matching its fwd/bwd interleave and send/recv alignment) and computes
+    each microbatch's backward EXPLICITLY with ``jax.vjp`` inside the tick:
+
+    * stage inputs are kept in a ring buffer of ``2S`` slots (a microbatch's
+      bwd trails its fwd by at most ``2(S-1)`` ticks) — O(S) memory,
+      independent of M, the entire point of 1F1B (reference pipe/engine.py
+      1F1B memory argument);
+    * the loss-head vjp runs only on the last stage and the embedding vjp
+      only on stage 0 (``lax.cond``), reproducing ReduceTiedGrads as a
+      masked psum of shared-param grads over the pipe axis;
+    * grads ride a ``custom_vjp``: the primal pass already produced them, so
+      ``jax.grad`` of this loss costs nothing extra and NEVER differentiates
+      the scan (eval-only calls do pay the backward — use the GPipe builder
+      for inference-style loss evaluation).
+
+    Same args/params-layout contract as ``pipelined_loss_fn``.
+    """
+    S = mesh.shape[PIPE_AXIS]
+    B = 2 * S                         # ring slots ≥ max fwd→bwd lag + 1
+    T_TICKS = num_micro + 2 * S - 2
+
+    def _f32(tree):
+        return jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), tree)
+
+    def fwd_impl(params, batch, rng):
+        def split_mb(x):
+            return x.reshape((num_micro, x.shape[0] // num_micro) + x.shape[1:])
+
+        mbs = jax.tree.map(split_mb, batch)
+
+        def inner(stage_params, shared, mbs):
+            my_stage = jax.tree.map(lambda t: t[0], stage_params)
+            s = jax.lax.axis_index(PIPE_AXIS)
+
+            run_stage = stage_fn
+            if remat_stage:
+                run_stage = jax.checkpoint(
+                    stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+            def pick_mb(i):
+                return jax.tree.map(
+                    lambda x: jax.lax.dynamic_index_in_dim(
+                        x, jnp.clip(i, 0, num_micro - 1), axis=0, keepdims=False),
+                    mbs)
+
+            first0 = first_stage_fn(shared, pick_mb(0), rng)
+            zeros_x = jnp.zeros_like(first0)
+            buf0 = jnp.zeros((B,) + first0.shape, first0.dtype)
+
+            def tick(carry, t):
+                x_recv, g_recv, buf, g_stage, g_shared, loss_acc = carry
+
+                # ---------------- forward: microbatch f = t - s ------------
+                f = t - s
+                f_valid = (f >= 0) & (f < num_micro)
+                mb_f = pick_mb(f)
+                x_in = jnp.where(s == 0, first_stage_fn(shared, mb_f, rng), x_recv)
+                out = run_stage(my_stage, x_in, rng)
+                slot_f = jnp.mod(jnp.mod(f, B) + B, B)
+                old = jax.lax.dynamic_index_in_dim(buf, slot_f, 0, keepdims=False)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(f_valid, x_in, old), slot_f, 0)
+                x_send = p2p.send_forward(jnp.where(f_valid, out, zeros_x),
+                                          PIPE_AXIS)
+
+                # ---------------- backward: microbatch b = t-(2S-2-s) ------
+                b = t - (2 * S - 2 - s)
+                b_valid = (b >= 0) & (b < num_micro)
+                slot_b = jnp.mod(jnp.mod(b, B) + B, B)
+                x_saved = jax.lax.dynamic_index_in_dim(buf, slot_b, 0,
+                                                       keepdims=False)
+                mb_b = pick_mb(b)
+                is_last = (s == S - 1)
+
+                # every stage runs the SAME bwd computation with masked
+                # cotangents instead of lax.cond branches: the loss-head and
+                # embedding vjps contain GSPMD auto-axis collectives (e.g.
+                # the vocab-sharded embedding-scatter grad), and a collective
+                # inside a branch whose predicate varies across pipe shards
+                # deadlocks the mesh (observed: collective-permute rendezvous
+                # timeout on pp=4 x tp=2). Masking costs redundant head/embed
+                # flops on non-boundary stages; uniformity buys correctness.
+                def local_fn(ms, sh, x_):
+                    out_ = run_stage(ms, x_, rng)
+                    l_ = last_stage_loss_fn(sh, out_, mb_b)
+                    return out_, l_
+
+                (out_b, l_b), pull = jax.vjp(local_fn, my_stage, shared, x_saved)
+                cot_out = jnp.where(is_last, jnp.zeros_like(out_b),
+                                    g_recv.astype(out_b.dtype))
+                cot_l = jnp.where(is_last, jnp.ones_like(l_b),
+                                  jnp.zeros_like(l_b))
+                g_ms, g_sh, g_x = pull((cot_out, cot_l))
+
+                # stage-0 embedding backward (tied/shared first-stage params):
+                # zero cotangent off stage 0 → zero grads, but the collective
+                # topology is identical on every shard
+                _, pull_emb = jax.vjp(
+                    lambda sh_: first_stage_fn(sh_, mb_b, rng), shared)
+                (g_sh_emb,) = pull_emb(
+                    jnp.where(s == 0, g_x, jnp.zeros_like(g_x)).astype(first0.dtype))
+
+                bm = b_valid.astype(jnp.float32)
+                lm = bm * is_last.astype(jnp.float32)
+                g_stage = jax.tree.map(
+                    lambda a, g: a + bm * g.astype(jnp.float32), g_stage, g_ms)
+                g_shared = jax.tree.map(
+                    lambda a, g1, g2: a + bm * (lm * g1.astype(jnp.float32)
+                                                + g2.astype(jnp.float32)),
+                    g_shared, g_sh, g_sh_emb)
+                loss_acc = loss_acc + lm * l_b
+                g_send = p2p.send_backward(
+                    jnp.where(b_valid, g_x, jnp.zeros_like(g_x)), PIPE_AXIS)
+
+                return (x_send, g_send, buf, g_stage, g_shared, loss_acc), None
+
+            # g_recv rides in the ACTIVATION dtype (bf16 models send bf16
+            # cotangents) — a float32 init would break the scan carry contract
+            carry0 = (zeros_x, jnp.zeros_like(first0),
+                      buf0, _f32(my_stage), _f32(shared), jnp.float32(0.0))
+            (_, _, _, g_stage, g_shared, loss_sum), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(T_TICKS))
+
+            loss = jax.lax.psum(loss_sum, PIPE_AXIS) / num_micro
+            # shared grads live on stages 0 and S-1 only: psum = tied reduce
+            g_shared = jax.tree.map(
+                lambda g: jax.lax.psum(g, PIPE_AXIS) / num_micro, g_shared)
+            g_stage = jax.tree.map(lambda g: g[None] / num_micro, g_stage)
+            return loss, g_stage, g_shared
+
+        sm = jax.shard_map(inner, mesh=mesh,
+                           in_specs=(P(PIPE_AXIS), P(), P()),
+                           out_specs=(P(), P(PIPE_AXIS), P()),
+                           axis_names={PIPE_AXIS},
+                           check_vma=False)
+        loss, g_stages, g_shared = sm(params["stages"], params["shared"], mbs)
+        return loss, {"stages": g_stages, "shared": g_shared}
+
+    def _zero_cotangent(x):
+        if x is None:
+            return None
+        return jax.tree.map(
+            lambda v: jnp.zeros_like(v) if jnp.issubdtype(v.dtype, jnp.inexact)
+            else np.zeros(v.shape, jax.dtypes.float0), x)
+
+    # gradient-free evaluation takes the cheap forward-only fill-drain
+    # pipeline; only differentiation (custom_vjp fwd rule) pays for the
+    # 1F1B pass that also produces the grads
+    eval_loss = pipelined_loss_fn(stage_fn, first_stage_fn, last_stage_loss_fn,
+                                  num_micro, mesh, remat_stage=False)
+
+    @jax.custom_vjp
+    def loss_fn(params, batch, rng=None):
+        return eval_loss(params, batch, rng)
+
+    def loss_fwd(params, batch, rng=None):
+        loss, grads = fwd_impl(params, batch, rng)
+        return loss, (grads, batch, rng)
+
+    def loss_bwd(res, ct):
+        grads, batch, rng = res
+        g = jax.tree.map(lambda x: (x * ct).astype(x.dtype), grads)
+        return (g, _zero_cotangent(batch), _zero_cotangent(rng))
+
+    loss_fn.defvjp(loss_fwd, loss_bwd)
+    return loss_fn
 
 
 class PipelineEngineMixin:

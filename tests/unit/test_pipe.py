@@ -133,3 +133,89 @@ def test_pipeline_4stages_with_tp():
     # stage params sharded over pipe axis
     qkv = piped.state.params["stages"]["qkv_w"]
     assert qkv.shape[0] == 4
+
+
+# ---------------------------------------------------------------- 1F1B
+def test_1f1b_matches_gpipe_and_plain():
+    """pp=4 1F1B: loss AND training trajectory match GPipe and the plain
+    model (hand-written backward must equal AD's)."""
+    batch = synthetic_lm_batch(8, 32, TINY.vocab_size, seed=7)
+    plain = _mk_engine(GPT2Model(TINY), pp=1)
+    gpipe = _mk_engine(PipelinedGPT2(TINY, num_stages=4, num_micro=8,
+                                     schedule="gpipe"), pp=4)
+    f1b = _mk_engine(PipelinedGPT2(TINY, num_stages=4, num_micro=8,
+                                   schedule="1f1b"), pp=4)
+    l_plain = [float(plain.train_batch(batch)) for _ in range(4)]
+    l_gpipe = [float(gpipe.train_batch(batch)) for _ in range(4)]
+    l_f1b = [float(f1b.train_batch(batch)) for _ in range(4)]
+    np.testing.assert_allclose(l_f1b, l_gpipe, rtol=5e-4, atol=5e-5)
+    np.testing.assert_allclose(l_f1b, l_plain, rtol=5e-4, atol=5e-5)
+
+
+def test_1f1b_bounded_activation_memory():
+    """The point of 1F1B: temp memory stays O(stages), not O(microbatches).
+    Compare compiled temp sizes of the grad programs at M=16 vs M=4: GPipe
+    grows roughly linearly with M; 1F1B must grow far slower."""
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.runtime.pipe.engine import (pipelined_loss_fn,
+                                                   pipelined_loss_fn_1f1b)
+    from deepspeed_tpu.parallel.topology import build_mesh
+
+    comm.cdb = None
+    mesh = build_mesh(axis_dims={"pipe": 4, "data": 2, "expert": 1,
+                                 "seq": 1, "tensor": 1})
+    comm.init_distributed(mesh=mesh, verbose=False)
+
+    model = PipelinedGPT2(TINY, num_stages=4, num_micro=4)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    def temp_bytes(builder, M, batch_rows):
+        m = PipelinedGPT2(TINY, num_stages=4, num_micro=M)
+        loss = builder(stage_fn=m._stage_fn, first_stage_fn=m._first_stage_fn,
+                       last_stage_loss_fn=m._last_stage_loss_fn,
+                       num_micro=M, mesh=mesh, remat_stage=True)
+        batch = synthetic_lm_batch(batch_rows, 32, TINY.vocab_size)
+        ids = jnp.asarray(batch["input_ids"])
+        with mesh:
+            g = jax.jit(jax.grad(lambda p, b: loss(p, b, None)))
+            compiled = g.lower(params, ids).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    # per-microbatch size constant (rows = 2*M), so more microbatches =
+    # same global tokens per microbatch count difference isolated
+    gp_small = temp_bytes(pipelined_loss_fn, 4, 16)
+    gp_big = temp_bytes(pipelined_loss_fn, 16, 64)
+    f_small = temp_bytes(pipelined_loss_fn_1f1b, 4, 16)
+    f_big = temp_bytes(pipelined_loss_fn_1f1b, 16, 64)
+    gp_growth = gp_big / gp_small
+    f_growth = f_big / f_small
+    # GPipe stacks per-tick carries: ~4x when M goes 4->16. 1F1B holds a
+    # fixed ring buffer: growth must be decisively smaller.
+    assert f_growth < 0.6 * gp_growth, (gp_growth, f_growth)
+
+
+def test_1f1b_with_tp_and_zero():
+    """1F1B composes with tensor parallelism + ZeRO-1 (auto axes)."""
+    batch = synthetic_lm_batch(8, 32, TINY.vocab_size, seed=9)
+    engine = _mk_engine(PipelinedGPT2(TINY, num_stages=2, num_micro=4),
+                        pp=2, extra={"tpu": {"pipe": 2, "tensor": 2},
+                                     "zero_optimization": {"stage": 1}})
+    losses = [float(engine.train_batch(batch)) for _ in range(5)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_1f1b_bf16_default_dtype():
+    """The default GPT2Config dtype is bfloat16 — the 1F1B carry must ride
+    the activation dtype (regression: fp32 g_recv init broke the scan)."""
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32, n_layer=4,
+                     n_head=2, remat=False, use_flash_attention=False)
+    batch = synthetic_lm_batch(8, 32, cfg.vocab_size, seed=11)
+    engine = _mk_engine(PipelinedGPT2(cfg, num_stages=4, num_micro=4), pp=4,
+                        extra={"bf16": {"enabled": True}})
+    losses = [float(engine.train_batch(batch)) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+    # eval path (forward-only primal) agrees with training loss scale
+    ev = float(engine.eval_batch(batch))
+    assert np.isfinite(ev)

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
-from deepspeed_tpu.runtime.pipe.engine import pipelined_loss_fn
+from deepspeed_tpu.runtime.pipe.engine import (pipelined_loss_fn,
+                                               pipelined_loss_fn_1f1b)
 
 
 class PipelinedGPT2(GPT2Model):
@@ -93,7 +94,6 @@ class PipelinedGPT2(GPT2Model):
     def loss(self, params, batch, rng=None):
         if self._pipe_loss is None:
             from deepspeed_tpu.comm import comm
-            from deepspeed_tpu.runtime.pipe.engine import pipelined_loss_fn_1f1b
 
             builder = pipelined_loss_fn_1f1b if self.schedule == "1f1b" \
                 else pipelined_loss_fn

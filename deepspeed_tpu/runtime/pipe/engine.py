@@ -150,13 +150,14 @@ def pipelined_loss_fn_1f1b(stage_fn: Callable,
       bwd trails its fwd by at most ``2(S-1)`` ticks) — O(S) memory,
       independent of M, the entire point of 1F1B (reference pipe/engine.py
       1F1B memory argument);
-    * the loss-head vjp runs only on the last stage and the embedding vjp
-      only on stage 0 (``lax.cond``), reproducing ReduceTiedGrads as a
-      masked psum of shared-param grads over the pipe axis;
-    * grads ride a ``custom_vjp``: the primal pass already produced them, so
-      ``jax.grad`` of this loss costs nothing extra and NEVER differentiates
-      the scan (eval-only calls do pay the backward — use the GPipe builder
-      for inference-style loss evaluation).
+    * the loss-head and embedding vjps run UNIFORMLY on every stage with
+      masked cotangents (a lax.cond whose predicate varies across pipe
+      shards deadlocks the mesh when GSPMD auto-axis collectives sit inside
+      a branch — see the inline comment); the masked psum of shared-param
+      grads over the pipe axis reproduces ReduceTiedGrads;
+    * grads ride a ``custom_vjp``: the fwd rule produces them during the
+      1F1B pass, so ``jax.grad`` never differentiates the scan, and
+      gradient-free calls take the cheap forward-only GPipe primal.
 
     Same args/params-layout contract as ``pipelined_loss_fn``.
     """
@@ -201,7 +202,7 @@ def pipelined_loss_fn_1f1b(stage_fn: Callable,
                 mb_f = pick_mb(f)
                 x_in = jnp.where(s == 0, first_stage_fn(shared, mb_f, rng), x_recv)
                 out = run_stage(my_stage, x_in, rng)
-                slot_f = jnp.mod(jnp.mod(f, B) + B, B)
+                slot_f = jnp.mod(f, B)
                 old = jax.lax.dynamic_index_in_dim(buf, slot_f, 0, keepdims=False)
                 buf = jax.lax.dynamic_update_index_in_dim(
                     buf, jnp.where(f_valid, x_in, old), slot_f, 0)
@@ -211,7 +212,7 @@ def pipelined_loss_fn_1f1b(stage_fn: Callable,
                 # ---------------- backward: microbatch b = t-(2S-2-s) ------
                 b = t - (2 * S - 2 - s)
                 b_valid = (b >= 0) & (b < num_micro)
-                slot_b = jnp.mod(jnp.mod(b, B) + B, B)
+                slot_b = jnp.mod(b, B)
                 x_saved = jax.lax.dynamic_index_in_dim(buf, slot_b, 0,
                                                        keepdims=False)
                 mb_b = pick_mb(b)

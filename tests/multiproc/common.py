@@ -1,0 +1,70 @@
+"""Multi-process test harness — the SURVEY §4 ``DistributedTest`` analogue.
+
+The reference's ``tests/unit/common.py:86`` forks ``world_size`` CUDA worker
+processes per test and joins them. Here each worker is a fresh Python process
+that runs ``jax.distributed.initialize`` against a shared localhost
+coordinator with the CPU platform (2 virtual devices per process), so
+cross-process collectives, ``make_array_from_process_local_data``, and
+multihost checkpointing run the REAL multi-controller code paths that the
+in-process 8-device mesh cannot reach.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_workers(worker: str, nproc: int = 2, timeout: int = 300,
+                devices_per_proc: int = 2,
+                extra_env: Optional[Dict[str, str]] = None,
+                args: Optional[List[str]] = None):
+    """Spawn ``nproc`` workers running ``tests.multiproc.workers:<worker>``.
+
+    Returns a list of (returncode, stdout+stderr) per rank; asserts nothing —
+    callers check for their own markers.
+    """
+    port = free_port()
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_proc}",
+            "DSTPU_MP_WORKER": worker,
+            "DSTPU_MP_RANK": str(rank),
+            "DSTPU_MP_NPROC": str(nproc),
+            "DSTPU_MP_PORT": str(port),
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tests.multiproc.workers"] + (args or []),
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    out = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            stdout = (p.communicate()[0] or "") + "\n<TIMEOUT>"
+        out.append((p.returncode, stdout))
+    return out
+
+
+def assert_all_ok(results, nproc: int):
+    for rank, (rc, log) in enumerate(results):
+        assert rc == 0, f"rank {rank} rc={rc}\n{log[-3000:]}"
+        assert f"WORKER_OK {rank}" in log, f"rank {rank} missing OK\n{log[-3000:]}"

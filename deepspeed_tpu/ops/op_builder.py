@@ -80,7 +80,7 @@ class InferenceBuilder(PallasKernelBuilder):
 
 class SparseAttnBuilder(PallasKernelBuilder):
     NAME = "sparse_attn"
-    MODULE = "deepspeed_tpu.ops.pallas.block_sparse_attention"
+    MODULE = "deepspeed_tpu.ops.sparse_attention"
 
 
 class AsyncIOBuilder(OpBuilder):

@@ -527,6 +527,328 @@ def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
     return o.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
 
 
+# ------------------------------------------------------------ block-sparse
+def _sparse_pairs(layout: np.ndarray, causal: bool):
+    """(row-major pairs, col-major pairs) with first/last flags per run.
+
+    ``layout``: (n, n) bool block map. Causal drops above-diagonal pairs.
+    Every query row must keep at least one pair (its diagonal/local block),
+    or that row's output would never be written."""
+    lay = np.asarray(layout, dtype=bool).copy()
+    n = lay.shape[0]
+    if causal:
+        lay &= np.tril(np.ones((n, n), dtype=bool))
+    if not lay.any(axis=1).all():
+        empty = np.where(~lay.any(axis=1))[0]
+        raise ValueError(f"sparse layout leaves query blocks {empty.tolist()} "
+                         "with no key blocks (add a local/diagonal pattern)")
+
+    def runs(primary):                # enumerate grouped by `primary` index
+        qi, ki, first, last, valid = [], [], [], [], []
+        for p in range(n):
+            idx = np.where(lay[p] if primary == "row" else lay[:, p])[0]
+            if len(idx) == 0:
+                # a key block nobody attends still needs its dk/dv output
+                # written (as zeros): emit one no-compute dummy pair
+                qi.append(0)
+                ki.append(p)
+                first.append(1)
+                last.append(1)
+                valid.append(0)
+                continue
+            for j, o in enumerate(idx):
+                a, b = (p, o) if primary == "row" else (o, p)
+                qi.append(a)
+                ki.append(b)
+                first.append(1 if j == 0 else 0)
+                last.append(1 if j == len(idx) - 1 else 0)
+                valid.append(1)
+        return (np.asarray(qi, np.int32), np.asarray(ki, np.int32),
+                np.asarray(first, np.int32), np.asarray(last, np.int32),
+                np.asarray(valid, np.int32))
+
+    return runs("row"), runs("col")
+
+
+def _sparse_fwd_kernel(qi_arr, ki_arr, first_arr, last_arr, valid_arr,
+                       q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       acc_sc, m_sc, l_sc, *, scale, block, causal):
+    f = pl.program_id(1)
+    qi, ki = qi_arr[f], ki_arr[f]
+    ok = valid_arr[f] == 1
+
+    @pl.when(first_arr[f] == 1)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    if causal:
+        @pl.when(ok & (qi == ki))
+        def _diag():
+            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
+                                  acc_sc, m_sc, l_sc, scale,
+                                  mask_rc=_block_iotas(block, block, qi, ki))
+
+        @pl.when(ok & (qi != ki))
+        def _off():
+            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
+                                  acc_sc, m_sc, l_sc, scale)
+    else:
+        @pl.when(ok)
+        def _all():
+            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
+                                  acc_sc, m_sc, l_sc, scale)
+
+    @pl.when(last_arr[f] == 1)
+    def _finalize():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_sc[:, :1] + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _sparse_bwd_dq_kernel(qi_arr, ki_arr, first_arr, last_arr, valid_arr,
+                          q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, dq_sc, *, scale, block, causal):
+    f = pl.program_id(1)
+    qi, ki = qi_arr[f], ki_arr[f]
+    ok = valid_arr[f] == 1
+
+    @pl.when(first_arr[f] == 1)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    def _acc(mask_rc):
+        _, ds = _bwd_p_ds(q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0],
+                          delta_ref[0], scale, mask_rc)
+        dq_sc[:] += jax.lax.dot_general(ds, k_ref[0], (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ok & (qi == ki))
+        def _diag():
+            _acc(_block_iotas(block, block, qi, ki))
+
+        @pl.when(ok & (qi != ki))
+        def _off():
+            _acc(None)
+    else:
+        @pl.when(ok)
+        def _all():
+            _acc(None)
+
+    @pl.when(last_arr[f] == 1)
+    def _finalize():
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _sparse_bwd_dkv_kernel(qi_arr, ki_arr, first_arr, last_arr, valid_arr,
+                           q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_sc, dv_sc, *, scale, block, causal):
+    f = pl.program_id(1)
+    qi, ki = qi_arr[f], ki_arr[f]
+    ok = valid_arr[f] == 1
+
+    @pl.when(first_arr[f] == 1)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    def _acc(mask_rc):
+        p, ds = _bwd_p_ds(q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0],
+                          delta_ref[0], scale, mask_rc)
+        dv_sc[:] += jax.lax.dot_general(p.astype(do_ref.dtype), do_ref[0],
+                                        (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        dk_sc[:] += jax.lax.dot_general(ds, q_ref[0], (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ok & (qi == ki))
+        def _diag():
+            _acc(_block_iotas(block, block, qi, ki))
+
+        @pl.when(ok & (qi != ki))
+        def _off():
+            _acc(None)
+    else:
+        @pl.when(ok)
+        def _all():
+            _acc(None)
+
+    @pl.when(last_arr[f] == 1)
+    def _finalize():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _sparse_forward(q, k, v, scale, causal, layout):
+    bh, t, d = q.shape
+    n = layout.shape[0]
+    block = t // n
+    row_pairs, _ = _sparse_pairs(layout, causal)
+    pf = [jnp.asarray(x) for x in row_pairs]
+    o, lse = pl.pallas_call(
+        functools.partial(_sparse_fwd_kernel, scale=scale, block=block,
+                          causal=causal),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(bh, len(pf[0])),
+            in_specs=[
+                pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, qa[f], 0)),
+                pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, ka[f], 0)),
+                pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, ka[f], 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, qa[f], 0)),
+                pl.BlockSpec((1, block, 1), lambda b, f, qa, ka, fa, la, va: (b, qa[f], 0)),
+            ),
+            scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
+                            pltpu.VMEM((block, 128), jnp.float32),
+                            pltpu.VMEM((block, 128), jnp.float32)],
+        ),
+        out_shape=(jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, t, 1), jnp.float32)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(*pf, q, k, v)
+    return o, lse
+
+
+def _sparse_backward(res, g, scale, causal, layout):
+    q, k, v, o, lse = res
+    bh, t, d = q.shape
+    n = layout.shape[0]
+    block = t // n
+    row_pairs, col_pairs = _sparse_pairs(layout, causal)
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+
+    in_specs = [
+        pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, qa[f], 0)),
+        pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, ka[f], 0)),
+        pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, ka[f], 0)),
+        pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, qa[f], 0)),
+        pl.BlockSpec((1, block, 1), lambda b, f, qa, ka, fa, la, va: (b, qa[f], 0)),
+        pl.BlockSpec((1, block, 1), lambda b, f, qa, ka, fa, la, va: (b, qa[f], 0)),
+    ]
+    pf_row = [jnp.asarray(x) for x in row_pairs]
+    dq = pl.pallas_call(
+        functools.partial(_sparse_bwd_dq_kernel, scale=scale, block=block,
+                          causal=causal),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(bh, len(pf_row[0])),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, block, d),
+                                   lambda b, f, qa, ka, fa, la, va: (b, qa[f], 0)),
+            scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(*pf_row, q, k, v, do, lse, delta)
+
+    pf_col = [jnp.asarray(x) for x in col_pairs]
+    dk, dv = pl.pallas_call(
+        functools.partial(_sparse_bwd_dkv_kernel, scale=scale, block=block,
+                          causal=causal),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(bh, len(pf_col[0])),
+            in_specs=in_specs,
+            out_specs=(
+                pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, ka[f], 0)),
+                pl.BlockSpec((1, block, d), lambda b, f, qa, ka, fa, la, va: (b, ka[f], 0)),
+            ),
+            scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
+                            pltpu.VMEM((block, d), jnp.float32)],
+        ),
+        out_shape=(jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(*pf_col, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+class _HashableLayout:
+    """numpy layout wrapped hashable so it can ride custom_vjp nondiff args."""
+
+    def __init__(self, arr: np.ndarray):
+        self.arr = np.asarray(arr, dtype=bool)
+        self._key = self.arr.tobytes(), self.arr.shape
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _HashableLayout) and self._key == other._key
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _sparse_bhtd(q, k, v, scale, causal, hlayout):
+    o, _ = _sparse_forward(q, k, v, scale, causal, hlayout.arr)
+    return o
+
+
+def _sparse_bhtd_fwd(q, k, v, scale, causal, hlayout):
+    o, lse = _sparse_forward(q, k, v, scale, causal, hlayout.arr)
+    return o, (q, k, v, o, lse)
+
+
+def _sparse_bhtd_bwd(scale, causal, hlayout, res, g):
+    return _sparse_backward(res, g, scale, causal, hlayout.arr)
+
+
+_sparse_bhtd.defvjp(_sparse_bhtd_fwd, _sparse_bhtd_bwd)
+
+
+def flash_attention_sparse(q, k, v, layout, causal: bool = True,
+                           scale: Optional[float] = None):
+    """Block-sparse flash attention: q,k,v (B, T, H, D), ``layout`` an
+    (n, n) 0/1 block map with block size T//n (reference ops/sparse_attention
+    matmul.py:196 block-sparse sdd/dsd role + softmax.py, fused).
+
+    The kernel tile size IS the layout block size: use layout blocks of
+    ≥128 (ideally 256-512) on real TPUs — tiles smaller than the 128-wide
+    MXU/VPU waste most of the hardware and multiply grid overhead. The
+    reference's Triton default of block=16 is a GPU-warp granularity that
+    does not transfer."""
+    b, t, h, d = q.shape
+    n = np.asarray(layout).shape[0]
+    if t % n:
+        raise ValueError(f"seq {t} not divisible by layout blocks {n}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    q = q * jnp.asarray(scale, q.dtype)
+    to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    o = _sparse_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), 1.0, bool(causal),
+                     _HashableLayout(layout))
+    return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def sparse_mha_reference(q, k, v, layout, causal: bool = True,
+                         scale: Optional[float] = None):
+    """Dense attention with the token-level expansion of a block layout —
+    the numerics oracle for flash_attention_sparse."""
+    b, t, h, d = q.shape
+    n = np.asarray(layout).shape[0]
+    block = t // n
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    mask = np.kron(np.asarray(layout, dtype=bool),
+                   np.ones((block, block), dtype=bool))
+    if causal:
+        mask &= np.tril(np.ones((t, t), dtype=bool))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(jnp.asarray(mask)[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 def mha_reference(q, k, v, causal: bool = True, scale: Optional[float] = None):
     """Plain einsum attention, for numerics tests."""
     d = q.shape[-1]

@@ -1,0 +1,37 @@
+"""Block-sparse attention (reference ops/sparse_attention/ package role).
+
+The Triton sdd/dsd matmuls + fused softmax become ONE Pallas kernel
+(flash_attention_sparse) that enumerates a layout's nonzero block pairs via
+scalar-prefetch index maps. Sparsity configs are layout builders."""
+
+from deepspeed_tpu.ops.pallas.flash_attention import (flash_attention_sparse,
+                                                      sparse_mha_reference)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparsityConfig, VariableSparsityConfig)
+
+
+class SparseSelfAttention:
+    """reference sparse_self_attention.py:21 surface: config-driven
+    block-sparse attention callable on (B, T, H, D) tensors."""
+
+    def __init__(self, sparsity_config: SparsityConfig,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul"):
+        self.sparsity_config = sparsity_config
+        self._layouts = {}
+
+    def get_layout(self, seq_len: int):
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, q, k, v, causal: bool = True):
+        layout = self.get_layout(q.shape[1])
+        return flash_attention_sparse(q, k, v, layout, causal=causal)
+
+
+__all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+           "VariableSparsityConfig", "BigBirdSparsityConfig",
+           "BSLongformerSparsityConfig", "SparseSelfAttention",
+           "flash_attention_sparse", "sparse_mha_reference"]

@@ -1,0 +1,176 @@
+"""Block-sparsity configurations — layouts for block-sparse attention.
+
+Counterpart of the reference's ``ops/sparse_attention/sparsity_config.py``
+(SparsityConfig :10 and the Dense/Fixed/Variable/BigBird/BSLongformer
+subclasses): each config builds a (num_blocks, num_blocks) 0/1 layout over
+``block``-sized tiles of the sequence. The reference feeds these layouts to
+Triton block-sparse matmuls; here they feed the Pallas scalar-prefetch
+flash kernel (``ops/pallas/flash_attention.flash_attention_sparse``), which
+simply enumerates the nonzero block pairs — the TPU-native equivalent of a
+block-sparse kernel launch grid.
+
+Layouts are shared across heads (``different_layout_per_head`` accepted for
+API parity; per-head layouts would force per-head kernel launches on TPU, so
+it is intentionally collapsed — documented deviation)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SparsityConfig:
+    """Base: dense layout (reference :10)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block != 0:
+            raise ValueError(f"seq_len {seq_len} not divisible by block {self.block}")
+        n = seq_len // self.block
+        return np.zeros((n, n), dtype=bool)
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[:] = True
+        return layout
+
+
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks attend everywhere (reference :63) — the base layout."""
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Local windows + periodic global blocks (reference :95).
+
+    Each query block attends to its local window of ``num_local_blocks`` and
+    to the last ``num_global_blocks`` of every ``num_local_blocks`` stride
+    (the reference's attention='unidirectional' horizontal/vertical global
+    slices, collapsed across heads)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_local_blocks=4, num_global_blocks=1,
+                 attention="bidirectional", horizontal_global_attention=False,
+                 num_different_global_patterns=1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[0]
+        L, G = self.num_local_blocks, self.num_global_blocks
+        for i in range(n):
+            w = (i // L) * L
+            layout[i, w:min(w + L, n)] = True          # local window
+        # global columns: last G blocks of each local window
+        for w in range(0, n, L):
+            g0 = max(0, min(w + L, n) - G)
+            layout[:, g0:min(w + L, n)] = True
+            if self.horizontal_global_attention:
+                layout[g0:min(w + L, n), :] = True
+        return layout
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """Custom local window sizes + explicit global block indices (reference
+    :239, simplified to the layout-affecting parameters)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks=0, local_window_blocks=None,
+                 global_block_indices=None, global_block_end_indices=None,
+                 attention="bidirectional", horizontal_global_attention=False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = local_window_blocks or [4]
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+        self.horizontal_global_attention = horizontal_global_attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[0]
+        # variable-size local windows, cycling through the list
+        i = 0
+        widx = 0
+        while i < n:
+            w = self.local_window_blocks[min(widx, len(self.local_window_blocks) - 1)]
+            layout[i:i + w, i:min(i + w, n)] = True
+            i += w
+            widx += 1
+        for i, g in enumerate(self.global_block_indices):
+            if g >= n:
+                continue
+            end = g + 1
+            if self.global_block_end_indices:
+                end = min(self.global_block_end_indices[i], n)
+            layout[:, g:end] = True
+            if self.horizontal_global_attention:
+                layout[g:end, :] = True
+        if self.num_random_blocks:
+            rng = np.random.RandomState(0)
+            for i in range(n):
+                cols = rng.choice(n, size=min(self.num_random_blocks, n),
+                                  replace=False)
+                layout[i, cols] = True
+        return layout
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """random + sliding window + global blocks (reference :411)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks=1, num_sliding_window_blocks=3,
+                 num_global_blocks=1, attention="bidirectional"):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[0]
+        w = self.num_sliding_window_blocks // 2
+        for i in range(n):
+            layout[i, max(0, i - w):min(n, i + w + 1)] = True   # window
+        g = self.num_global_blocks
+        layout[:, :g] = True                                    # global cols
+        layout[:g, :] = True                                    # global rows
+        rng = np.random.RandomState(0)
+        for i in range(n):
+            cols = rng.choice(n, size=min(self.num_random_blocks, n), replace=False)
+            layout[i, cols] = True                              # random
+        return layout
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """sliding window + selected global blocks (reference :546)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_sliding_window_blocks=3, global_block_indices=None,
+                 global_block_end_indices=None, attention="bidirectional"):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[0]
+        w = self.num_sliding_window_blocks // 2
+        for i in range(n):
+            layout[i, max(0, i - w):min(n, i + w + 1)] = True
+        for i, g in enumerate(self.global_block_indices):
+            if g >= n:
+                continue
+            end = g + 1
+            if self.global_block_end_indices:
+                end = min(self.global_block_end_indices[i], n)
+            layout[:, g:end] = True
+            layout[g:end, :] = True
+        return layout

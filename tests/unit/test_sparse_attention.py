@@ -1,0 +1,156 @@
+"""Block-sparse attention tests (reference tests/unit/ops/sparse_attention
+role): layout builders + sparse flash kernel numerics vs the dense-masked
+oracle, fwd and bwd, causal and not."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu.ops.pallas.flash_attention as fa
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                SparseSelfAttention,
+                                                VariableSparsityConfig,
+                                                flash_attention_sparse,
+                                                sparse_mha_reference)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    if jax.default_backend() != "tpu":
+        from jax.experimental import pallas as pl
+
+        monkeypatch.setattr(fa.pl, "pallas_call",
+                            functools.partial(pl.pallas_call, interpret=True))
+    yield
+
+
+class TestLayouts:
+    def test_dense(self):
+        lay = DenseSparsityConfig(num_heads=4, block=16).make_layout(64)
+        assert lay.shape == (4, 4) and lay.all()
+
+    def test_fixed_local_plus_global(self):
+        cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2,
+                                  num_global_blocks=1)
+        lay = cfg.make_layout(128)   # 8 blocks
+        assert lay.shape == (8, 8)
+        assert lay[0, 0] and lay[0, 1]        # local window
+        assert not lay[0, 2]                  # outside window, not global
+        assert lay[:, 1].all()                # global col (last of window 0)
+
+    def test_bigbird_window_global_random(self):
+        cfg = BigBirdSparsityConfig(num_heads=4, block=16,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1, num_random_blocks=1)
+        lay = cfg.make_layout(128)
+        n = lay.shape[0]
+        assert all(lay[i, i] for i in range(n))     # window includes self
+        assert lay[:, 0].all() and lay[0, :].all()  # global
+
+    def test_longformer(self):
+        cfg = BSLongformerSparsityConfig(num_heads=4, block=16,
+                                         num_sliding_window_blocks=3,
+                                         global_block_indices=[0])
+        lay = cfg.make_layout(128)
+        assert lay[:, 0].all() and lay[0, :].all()
+        assert lay[4, 3] and lay[4, 5] and not lay[4, 6]
+
+    def test_variable(self):
+        cfg = VariableSparsityConfig(num_heads=4, block=16,
+                                     local_window_blocks=[2, 3],
+                                     global_block_indices=[0])
+        lay = cfg.make_layout(160)
+        assert lay[:, 0].all()
+
+    def test_indivisible_seq_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            FixedSparsityConfig(num_heads=2, block=16).make_layout(100)
+
+
+def _qkv(B=1, T=128, H=2, D=64, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return [jax.random.normal(k, (B, T, H, D), jnp.float32) for k in ks]
+
+
+class TestSparseKernel:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense_oracle(self, causal):
+        q, k, v = _qkv()
+        cfg = BigBirdSparsityConfig(num_heads=2, block=32,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1, num_random_blocks=1)
+        lay = cfg.make_layout(128)
+        out = flash_attention_sparse(q, k, v, lay, causal=causal)
+        ref = sparse_mha_reference(q, k, v, lay, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_dense_layout_equals_flash(self):
+        q, k, v = _qkv(seed=1)
+        lay = DenseSparsityConfig(num_heads=2, block=32).make_layout(128)
+        out = flash_attention_sparse(q, k, v, lay, causal=True)
+        ref = fa.mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gradients_match_oracle(self, causal):
+        q, k, v = _qkv(T=64, seed=2)
+        cfg = BSLongformerSparsityConfig(num_heads=2, block=32,
+                                         num_sliding_window_blocks=1,
+                                         global_block_indices=[0])
+        lay = cfg.make_layout(64)
+
+        def loss_sparse(q, k, v):
+            return (flash_attention_sparse(q, k, v, lay, causal=causal)
+                    .astype(jnp.float32) * jnp.arange(64)).sum()
+
+        def loss_ref(q, k, v):
+            return (sparse_mha_reference(q, k, v, lay, causal=causal)
+                    .astype(jnp.float32) * jnp.arange(64)).sum()
+
+        g1 = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-2, rtol=5e-2)
+
+    def test_empty_key_column_grads_are_zero(self):
+        """Key blocks nobody attends must get exactly-zero dk/dv (dummy-pair
+        finalization), not garbage."""
+        q, k, v = _qkv(T=64, seed=3)
+        lay = np.zeros((2, 2), dtype=bool)
+        lay[0, 0] = lay[1, 0] = True            # both rows attend col 0 ONLY
+        # → key column 1 is attended by nobody: its dk/dv must be exact zeros
+        gk, gv = jax.grad(
+            lambda k_, v_: flash_attention_sparse(q, k_, v_, lay, causal=True)
+            .astype(jnp.float32).sum(), argnums=(0, 1))(k, v)
+        gk, gv = np.asarray(gk), np.asarray(gv)
+        assert np.isfinite(gk).all() and np.isfinite(gv).all()
+        np.testing.assert_array_equal(gk[:, 32:], 0.0)
+        np.testing.assert_array_equal(gv[:, 32:], 0.0)
+        assert np.abs(gv[:, :32]).max() > 0
+
+    def test_sparse_self_attention_module(self):
+        q, k, v = _qkv(T=128, seed=4)
+        mod = SparseSelfAttention(FixedSparsityConfig(num_heads=2, block=32,
+                                                      num_local_blocks=2))
+        out = mod(q, k, v, causal=True)
+        assert out.shape == q.shape
+        ref = sparse_mha_reference(q, k, v, mod.get_layout(128), causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_empty_query_row_raises(self):
+        q, k, v = _qkv(T=64, seed=5)
+        lay = np.zeros((2, 2), dtype=bool)
+        lay[0, 0] = True                        # row 1 attends to nothing
+        with pytest.raises(ValueError, match="no key blocks"):
+            flash_attention_sparse(q, k, v, lay, causal=True)

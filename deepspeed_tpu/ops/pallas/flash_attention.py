@@ -570,6 +570,25 @@ def _sparse_pairs(layout: np.ndarray, causal: bool):
     return runs("row"), runs("col")
 
 
+
+def _sparse_dispatch(ok, causal, qi, ki, block, compute):
+    """Shared causal/valid pl.when dispatch for the sparse kernels: valid
+    diagonal blocks get the iota mask, valid off-diagonal blocks run
+    mask-free, non-causal valid blocks always run mask-free."""
+    if causal:
+        @pl.when(ok & (qi == ki))
+        def _diag():
+            compute(_block_iotas(block, block, qi, ki))
+
+        @pl.when(ok & (qi != ki))
+        def _off():
+            compute(None)
+    else:
+        @pl.when(ok)
+        def _all():
+            compute(None)
+
+
 def _sparse_fwd_kernel(qi_arr, ki_arr, first_arr, last_arr, valid_arr,
                        q_ref, k_ref, v_ref, o_ref, lse_ref,
                        acc_sc, m_sc, l_sc, *, scale, block, causal):
@@ -583,22 +602,10 @@ def _sparse_fwd_kernel(qi_arr, ki_arr, first_arr, last_arr, valid_arr,
         m_sc[:] = jnp.full_like(m_sc, NEG_INF)
         l_sc[:] = jnp.zeros_like(l_sc)
 
-    if causal:
-        @pl.when(ok & (qi == ki))
-        def _diag():
-            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
-                                  acc_sc, m_sc, l_sc, scale,
-                                  mask_rc=_block_iotas(block, block, qi, ki))
-
-        @pl.when(ok & (qi != ki))
-        def _off():
-            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
-                                  acc_sc, m_sc, l_sc, scale)
-    else:
-        @pl.when(ok)
-        def _all():
-            _online_softmax_block(q_ref[0], k_ref[0], v_ref[0],
-                                  acc_sc, m_sc, l_sc, scale)
+    _sparse_dispatch(ok, causal, qi, ki, block,
+                     lambda mask_rc: _online_softmax_block(
+                         q_ref[0], k_ref[0], v_ref[0],
+                         acc_sc, m_sc, l_sc, scale, mask_rc=mask_rc))
 
     @pl.when(last_arr[f] == 1)
     def _finalize():
@@ -625,18 +632,7 @@ def _sparse_bwd_dq_kernel(qi_arr, ki_arr, first_arr, last_arr, valid_arr,
         dq_sc[:] += jax.lax.dot_general(ds, k_ref[0], (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(ok & (qi == ki))
-        def _diag():
-            _acc(_block_iotas(block, block, qi, ki))
-
-        @pl.when(ok & (qi != ki))
-        def _off():
-            _acc(None)
-    else:
-        @pl.when(ok)
-        def _all():
-            _acc(None)
+    _sparse_dispatch(ok, causal, qi, ki, block, _acc)
 
     @pl.when(last_arr[f] == 1)
     def _finalize():
@@ -664,18 +660,7 @@ def _sparse_bwd_dkv_kernel(qi_arr, ki_arr, first_arr, last_arr, valid_arr,
         dk_sc[:] += jax.lax.dot_general(ds, q_ref[0], (((0,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(ok & (qi == ki))
-        def _diag():
-            _acc(_block_iotas(block, block, qi, ki))
-
-        @pl.when(ok & (qi != ki))
-        def _off():
-            _acc(None)
-    else:
-        @pl.when(ok)
-        def _all():
-            _acc(None)
+    _sparse_dispatch(ok, causal, qi, ki, block, _acc)
 
     @pl.when(last_arr[f] == 1)
     def _finalize():

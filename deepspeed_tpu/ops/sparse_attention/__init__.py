@@ -13,12 +13,18 @@ from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
 
 class SparseSelfAttention:
     """reference sparse_self_attention.py:21 surface: config-driven
-    block-sparse attention callable on (B, T, H, D) tensors."""
+    block-sparse attention callable on (B, T, H, D) tensors.
+
+    ``key_padding_mask`` (B, T) routes through a dense masked fallback —
+    padding changes the valid-key set per ROW, which block layouts cannot
+    express; the fused kernel covers the mask-free fast path."""
 
     def __init__(self, sparsity_config: SparsityConfig,
                  key_padding_mask_mode: str = "add",
                  attn_mask_mode: str = "mul"):
         self.sparsity_config = sparsity_config
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
         self._layouts = {}
 
     def get_layout(self, seq_len: int):
@@ -26,9 +32,34 @@ class SparseSelfAttention:
             self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
         return self._layouts[seq_len]
 
-    def __call__(self, q, k, v, causal: bool = True):
+    def __call__(self, q, k, v, causal: bool = True, key_padding_mask=None,
+                 attn_mask=None):
         layout = self.get_layout(q.shape[1])
-        return flash_attention_sparse(q, k, v, layout, causal=causal)
+        if key_padding_mask is None and attn_mask is None:
+            return flash_attention_sparse(q, k, v, layout, causal=causal)
+        import math
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        t = q.shape[1]
+        block = t // layout.shape[0]
+        mask = np.kron(np.asarray(layout, dtype=bool),
+                       np.ones((block, block), dtype=bool))
+        if causal:
+            mask &= np.tril(np.ones((t, t), dtype=bool))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) \
+            * (1.0 / math.sqrt(q.shape[-1]))
+        logits = jnp.where(jnp.asarray(mask)[None, None], logits, -1e30)
+        if key_padding_mask is not None:
+            kp = jnp.asarray(key_padding_mask).astype(jnp.bool_)  # (B, T) True=keep
+            logits = jnp.where(kp[:, None, None, :], logits, -1e30)
+        if attn_mask is not None:
+            am = jnp.asarray(attn_mask).astype(jnp.bool_)         # (T, T) True=keep
+            logits = jnp.where(am[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
 __all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",

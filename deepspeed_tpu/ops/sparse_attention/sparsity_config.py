@@ -60,6 +60,10 @@ class FixedSparsityConfig(SparsityConfig):
         self.num_global_blocks = num_global_blocks
         self.attention = attention
         self.horizontal_global_attention = horizontal_global_attention
+        # like different_layout_per_head, multiple global patterns collapse
+        # to one shared layout on TPU (per-pattern layouts would force
+        # per-head kernel launches) — accepted for config compatibility
+        self.num_different_global_patterns = num_different_global_patterns
 
     def make_layout(self, seq_len: int) -> np.ndarray:
         layout = self.setup_layout(seq_len)
@@ -90,6 +94,11 @@ class VariableSparsityConfig(SparsityConfig):
         self.local_window_blocks = local_window_blocks or [4]
         self.global_block_indices = global_block_indices or [0]
         self.global_block_end_indices = global_block_end_indices
+        if global_block_end_indices is not None and \
+                len(global_block_end_indices) != len(self.global_block_indices):
+            raise ValueError("global_block_end_indices must match "
+                             "global_block_indices in length (reference "
+                             "sparsity_config.py validation)")
         self.horizontal_global_attention = horizontal_global_attention
 
     def make_layout(self, seq_len: int) -> np.ndarray:
@@ -158,6 +167,11 @@ class BSLongformerSparsityConfig(SparsityConfig):
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.global_block_indices = global_block_indices or [0]
         self.global_block_end_indices = global_block_end_indices
+        if global_block_end_indices is not None and \
+                len(global_block_end_indices) != len(self.global_block_indices):
+            raise ValueError("global_block_end_indices must match "
+                             "global_block_indices in length (reference "
+                             "sparsity_config.py validation)")
 
     def make_layout(self, seq_len: int) -> np.ndarray:
         layout = self.setup_layout(seq_len)

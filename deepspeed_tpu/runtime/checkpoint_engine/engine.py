@@ -52,6 +52,34 @@ def _unflatten_like(state, flat: dict):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+_async_checkpointer = None
+
+
+def _get_async_checkpointer():
+    """Process-wide orbax AsyncCheckpointer (reference nebula/async-tiered
+    checkpointing role): device→host copy happens synchronously, the write
+    itself in a background thread. Orbax commits via atomic rename, so a
+    crash mid-write never leaves a readable-but-corrupt checkpoint."""
+    global _async_checkpointer
+    if _async_checkpointer is None:
+        import orbax.checkpoint as ocp
+
+        _async_checkpointer = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _async_checkpointer
+
+
+_pending_latest_threads: list = []
+
+
+def wait_for_pending_saves():
+    """Block until any in-flight async checkpoint write commits (and its
+    'latest' pointer advance lands)."""
+    if _async_checkpointer is not None:
+        _async_checkpointer.wait_until_finished()
+    while _pending_latest_threads:
+        _pending_latest_threads.pop().join()
+
+
 def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                            client_state: Optional[dict] = None, save_latest: bool = True) -> bool:
     import orbax.checkpoint as ocp
@@ -60,8 +88,14 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     path = _ckpt_dir(save_dir, tag)
     state = engine.state
 
-    with ocp.PyTreeCheckpointer() as ckptr:
+    use_async = bool(getattr(engine._config.checkpoint_config, "async_save", False))
+    if use_async:
+        ckptr = _get_async_checkpointer()
+        ckptr.wait_until_finished()           # one in-flight save at a time
         ckptr.save(os.path.join(path, "state"), _flatten_state(state), force=True)
+    else:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(os.path.join(path, "state"), _flatten_state(state), force=True)
 
     if jax.process_index() == 0:
         meta = {
@@ -77,9 +111,25 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         }
         with open(os.path.join(path, "client_state.json"), "w") as f:
             json.dump(meta, f, default=str)
-        if save_latest:
+
+        def _advance_latest():
             with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
                 f.write(tag)
+
+        if save_latest and use_async:
+            # the 'latest' pointer must only move AFTER the background write
+            # commits (orbax's atomic rename): otherwise a crash mid-write
+            # strands a restart on a tag whose state/ never materialized
+            import threading
+
+            t = threading.Thread(
+                target=lambda: (_get_async_checkpointer().wait_until_finished(),
+                                _advance_latest()),
+                daemon=True)
+            t.start()
+            _pending_latest_threads.append(t)
+        elif save_latest:
+            _advance_latest()
     log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
     return True
 
@@ -87,6 +137,7 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                            load_optimizer_states: bool = True,
                            load_module_only: bool = False):
+    wait_for_pending_saves()              # an async save may still be writing
     import orbax.checkpoint as ocp
 
     if tag is None:

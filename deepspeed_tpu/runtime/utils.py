@@ -1,0 +1,147 @@
+"""Runtime utilities — the conveniences client scripts ported from DeepSpeed
+reach for (reference ``deepspeed/runtime/utils.py``: see_memory_usage :40,
+clip_grad_norm_ :379, get_global_norm :858, DummyOptim :37,
+partition_uniform/balanced, memory_status)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class DummyOptim:
+    """Placeholder optimizer (reference utils.py:37): clients that manage
+    their own update step pass this so the engine skips optimizer setup."""
+
+    def __init__(self, params=None):
+        self.params = params
+
+    def init(self, params):
+        return ()
+
+    def update(self, grads, state, params=None):
+        return jax.tree.map(jnp.zeros_like, grads), state
+
+
+def see_memory_usage(message: str, force: bool = False):
+    """Log device + host memory (reference see_memory_usage :40: torch.cuda
+    allocated/reserved → TPU live-buffer bytes per device + psutil RSS)."""
+    if not force:
+        return
+    lines = [message]
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            used = stats.get("bytes_in_use", 0)
+            limit = stats.get("bytes_limit", 0)
+            lines.append(f"  {d}: {used / 2**30:.2f}GB in use"
+                         + (f" / {limit / 2**30:.2f}GB" if limit else ""))
+    except Exception:
+        lines.append("  (device memory stats unavailable on this backend)")
+    try:
+        import psutil
+
+        vm = psutil.virtual_memory()
+        lines.append(f"  host: {(vm.total - vm.available) / 2**30:.2f}GB used "
+                     f"/ {vm.total / 2**30:.2f}GB ({vm.percent}%)")
+    except ImportError:
+        pass
+    log_dist("\n".join(lines), ranks=[0])
+
+
+def get_global_norm(norm_list: List[float]) -> float:
+    """sqrt of the sum of squares (reference get_global_norm :858)."""
+    return float(np.sqrt(sum(float(n) ** 2 for n in norm_list)))
+
+
+def get_grad_norm(grads: Any, norm_type: float = 2.0) -> jnp.ndarray:
+    """Global gradient norm over a pytree (jit-safe; reference
+    get_grad_norm :816)."""
+    leaves = [g.astype(jnp.float32) for g in jax.tree.leaves(grads)]
+    if norm_type == np.inf:
+        return jnp.max(jnp.asarray([jnp.max(jnp.abs(g)) for g in leaves]))
+    total = sum(jnp.sum(jnp.abs(g) ** norm_type) for g in leaves)
+    return total ** (1.0 / norm_type)
+
+
+def clip_grad_norm_(grads: Any, max_norm: float, norm_type: float = 2.0):
+    """Scale grads so the global norm is ≤ max_norm; returns (clipped_grads,
+    total_norm) — the functional form of reference clip_grad_norm_ :379
+    (no in-place mutation on immutable jax arrays)."""
+    total_norm = get_grad_norm(grads, norm_type)
+    coef = jnp.minimum(1.0, max_norm / (total_norm + 1e-6))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * coef).astype(g.dtype),
+                        grads), total_norm
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Boundaries for a uniform split (reference partition_uniform :584)."""
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    residual = num_items % num_parts
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < residual else 0)
+    return parts
+
+
+def partition_balanced(weights: List[float], num_parts: int) -> List[int]:
+    """Weight-balanced contiguous partition (reference partition_balanced
+    :607 role, greedy prefix-sum split)."""
+    total = sum(weights)
+    target = total / num_parts
+    bounds = [0]
+    acc = 0.0
+    for i, w in enumerate(weights):
+        acc += w
+        if acc >= target * len(bounds) and len(bounds) < num_parts:
+            bounds.append(i + 1)
+    while len(bounds) < num_parts:
+        bounds.append(len(weights))
+    bounds.append(len(weights))
+    return bounds
+
+
+class PartitionedTensor:
+    """A tensor logically split across a mesh axis (reference
+    PartitionedTensor :914: flatten → shard → reassemble). On TPU the
+    runtime equivalent is a NamedSharding; this wrapper keeps the
+    to_meta/from_meta API shape for ported client code."""
+
+    def __init__(self, tensor, mesh, axis: str = "data"):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.orig_shape = tuple(tensor.shape)
+        self.mesh = mesh
+        self.axis = axis
+        flat = jnp.ravel(tensor)
+        pad = (-flat.shape[0]) % mesh.shape[axis]
+        self._data = jax.device_put(
+            jnp.pad(flat, (0, pad)),
+            NamedSharding(mesh, P(axis)))
+
+    def full(self):
+        n = int(np.prod(self.orig_shape))
+        return self._data[:n].reshape(self.orig_shape)
+
+    def to_meta(self):
+        return {"orig_shape": self.orig_shape, "axis": self.axis}
+
+    @property
+    def data(self):
+        return self._data
+
+
+def memory_status(msg: str = "", reset_max: bool = False):
+    """reference memory_status parity shim → see_memory_usage."""
+    see_memory_usage(msg or "memory_status", force=True)
+
+
+def empty_cache():
+    """reference torch.cuda.empty_cache() shim: drop jit caches so XLA
+    releases compiled-program constants."""
+    jax.clear_caches()

@@ -1,1 +1,10 @@
+"""ZeRO — declarative sharding plans, sharded construction, tiling."""
 
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.init import Init, materialize
+from deepspeed_tpu.runtime.zero.partition import (ShardingPlan, partition_report,
+                                                  plan_sharding)
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear, tiled_matmul
+
+__all__ = ["DeepSpeedZeroConfig", "Init", "materialize", "ShardingPlan",
+           "plan_sharding", "partition_report", "TiledLinear", "tiled_matmul"]

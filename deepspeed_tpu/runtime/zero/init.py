@@ -1,0 +1,108 @@
+"""zero.Init — sharded-by-construction parameter initialization.
+
+Counterpart of the reference's ``zero/partition_parameters.py:603`` ``Init``
+context manager: under torch, entering the context monkey-patches
+``nn.Module.__init__`` so every parameter is partitioned the moment it is
+allocated — a multi-hundred-GB model never materializes replicated. The
+functional-JAX equivalent needs no patching: ``Init`` wraps an ``init_fn``
+in a jit whose ``out_shardings`` come from the ZeRO-3 plan, so XLA ALLOCATES
+each parameter directly in its dp-sharded layout (the engine does the same
+internally at ``runtime/engine.py`` init; this is the public client-facing
+API for models built outside ``deepspeed_tpu.initialize`` — e.g. HF trees).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.partition import plan_sharding
+from deepspeed_tpu.utils.logging import log_dist
+
+_ACTIVE: list = []
+
+
+class Init(contextlib.AbstractContextManager):
+    """``with zero.Init(mesh=mesh, config=ds_config): params = init()``.
+
+    Inside the context, ``zero.Init.materialize(init_fn, *args)`` (or the
+    module-level :func:`materialize`) runs ``init_fn`` jitted with ZeRO-3
+    out_shardings. The context-manager form keeps the reference's API shape;
+    ``materialize`` may also be called on an Init instance directly.
+    """
+
+    def __init__(self, module=None, mesh=None, config=None,
+                 config_dict_or_path=None, remote_device: Optional[str] = None,
+                 pin_memory: bool = False, dtype=None, enabled: bool = True,
+                 mpu=None, tp_specs: Any = None):
+        cfg = config if config is not None else config_dict_or_path
+        if isinstance(cfg, dict):
+            zero_block = cfg.get("zero_optimization", cfg)
+            self.zero_config = DeepSpeedZeroConfig(**zero_block)
+        elif isinstance(cfg, DeepSpeedZeroConfig):
+            self.zero_config = cfg
+        else:
+            self.zero_config = DeepSpeedZeroConfig(stage=3)
+        if mesh is None:
+            from deepspeed_tpu.comm import comm as dist
+
+            if not dist.is_initialized():
+                dist.init_distributed(verbose=False)
+            mesh = dist.get_mesh()
+        self.mesh = mesh
+        self.enabled = enabled
+        self.dtype = dtype
+        self.tp_specs = tp_specs
+
+    def __enter__(self):
+        if self.enabled:
+            _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled and _ACTIVE and _ACTIVE[-1] is self:
+            _ACTIVE.pop()
+        return False
+
+    # ------------------------------------------------------------ the work
+    def materialize(self, init_fn: Callable, *args, **kwargs):
+        """Run ``init_fn(*args)`` with every output leaf allocated directly
+        in its ZeRO-3 dp-sharded placement — nothing ever replicates."""
+        if not self.enabled:
+            return init_fn(*args, **kwargs)
+        shapes = jax.eval_shape(init_fn, *args, **kwargs)
+        plan = plan_sharding(shapes, self.mesh, zero_config=self.zero_config,
+                             tp_specs=self.tp_specs)
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                                 plan.param_specs,
+                                 is_leaf=lambda x: isinstance(x, PartitionSpec))
+        fn = init_fn
+        if self.dtype is not None:
+            import jax.numpy as jnp
+
+            dt = self.dtype
+
+            def fn(*a, **k):
+                return jax.tree.map(
+                    lambda x: x.astype(dt)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    init_fn(*a, **k))
+        with self.mesh:
+            out = jax.jit(fn, out_shardings=shardings)(*args, **kwargs)
+        n = sum(int(x.size) for x in jax.tree.leaves(out))
+        log_dist(f"zero.Init: materialized {n/1e6:.1f}M params sharded over "
+                 f"{plan.dp_axes}", ranks=[0])
+        return out
+
+
+def materialize(init_fn: Callable, *args, **kwargs):
+    """Module-level helper: uses the innermost active ``with zero.Init(...)``
+    context (raises outside one)."""
+    if not _ACTIVE:
+        raise RuntimeError("zero.materialize() requires an active "
+                           "`with zero.Init(...)` context")
+    return _ACTIVE[-1].materialize(init_fn, *args, **kwargs)

@@ -1,0 +1,116 @@
+"""Runtime utils / zero.Init / TiledLinear / async-checkpoint tests
+(reference tests/unit/runtime/test_runtime_utils.py + zero Init/tiling tests)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models.simple import SimpleModel
+from deepspeed_tpu.runtime import utils as ds_utils
+from deepspeed_tpu.runtime.zero import Init, TiledLinear, materialize, tiled_matmul
+
+
+class TestUtils:
+    def test_clip_grad_norm(self):
+        grads = {"a": jnp.full((4,), 3.0), "b": jnp.full((4,), 4.0)}
+        clipped, norm = ds_utils.clip_grad_norm_(grads, max_norm=1.0)
+        assert float(norm) == pytest.approx(10.0)
+        new_norm = float(ds_utils.get_grad_norm(clipped))
+        assert new_norm == pytest.approx(1.0, rel=1e-4)
+        # under the limit: untouched
+        same, _ = ds_utils.clip_grad_norm_(grads, max_norm=100.0)
+        np.testing.assert_allclose(np.asarray(same["a"]), 3.0, rtol=1e-6)
+
+    def test_get_global_norm(self):
+        assert ds_utils.get_global_norm([3.0, 4.0]) == pytest.approx(5.0)
+
+    def test_partition_uniform(self):
+        assert ds_utils.partition_uniform(10, 3) == [0, 4, 7, 10]
+
+    def test_partition_balanced(self):
+        bounds = ds_utils.partition_balanced([1, 1, 1, 10, 1, 1], 2)
+        assert bounds[0] == 0 and bounds[-1] == 6
+        assert len(bounds) == 3
+
+    def test_see_memory_usage_runs(self):
+        ds_utils.see_memory_usage("test", force=True)
+
+    def test_dummy_optim(self):
+        opt = ds_utils.DummyOptim()
+        g = {"w": jnp.ones((2,))}
+        upd, _ = opt.update(g, opt.init(g))
+        np.testing.assert_allclose(np.asarray(upd["w"]), 0.0)
+
+
+class TestZeroInit:
+    def test_materialize_shards_params(self):
+        comm.cdb = None
+        comm.init_distributed(verbose=False)
+        mesh = comm.get_mesh()
+        model = SimpleModel(hidden_dim=64, nlayers=2)
+        with Init(mesh=mesh, config={"zero_optimization": {
+                "stage": 3, "stage3_param_persistence_threshold": 0}}) as zi:
+            params = materialize(model.init_params, jax.random.PRNGKey(0))
+        big = params["layers"][0]["w"]
+        assert big.shape == (64, 64)
+        # sharded over the data axis, not replicated
+        assert not big.sharding.is_fully_replicated
+
+    def test_disabled_passthrough(self):
+        model = SimpleModel(hidden_dim=8, nlayers=1)
+        with Init(enabled=False) as zi:
+            params = zi.materialize(model.init_params, jax.random.PRNGKey(0))
+        assert params["layers"][0]["w"].shape == (8, 8)
+
+    def test_materialize_outside_context_raises(self):
+        with pytest.raises(RuntimeError, match="active"):
+            materialize(lambda: {})
+
+
+class TestTiledLinear:
+    def test_matches_dense(self):
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (4, 32), jnp.float32)
+        lin = TiledLinear(32, 48, in_splits=4, out_splits=3)
+        p = lin.init_params(jax.random.PRNGKey(1))
+        y = lin.apply(p, x)
+        ref = x @ p["w"] + p["b"]
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_tiled_matmul_gradients(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (4, 16), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(3), (16, 8), jnp.float32)
+        g1 = jax.grad(lambda w: tiled_matmul(x, w, 2, 2).sum())(w)
+        g2 = jax.grad(lambda w: (x @ w).sum())(w)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_then_load(self, tmp_path):
+        comm.cdb = None
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16, nlayers=2),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "checkpoint": {"async_save": True},
+                    "steps_per_print": 0})
+        rng = np.random.RandomState(0)
+        batch = (rng.randn(8, 16).astype(np.float32),
+                 rng.randn(8, 16).astype(np.float32))
+        engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path), tag="async1")
+        step_saved = int(engine.state.step)
+        engine.train_batch(batch)
+        # load waits for the pending async write, then restores
+        engine.load_checkpoint(str(tmp_path), tag="async1")
+        assert int(engine.state.step) == step_saved

@@ -54,6 +54,11 @@ def main():
         "gradient_clipping": 1.0,
         "steps_per_print": 0,
     }
+    if gas > 1:
+        # bf16 accumulator: gas>1 must not add a resident fp32 grad tree on
+        # top of the full optimizer state (16G HBM budget)
+        ds_config["data_types"] = {"grad_accum_dtype": os.environ.get(
+            "BENCH_ACC_DTYPE", "bf16")}
 
     model = GPT2Model(config)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)

@@ -547,19 +547,33 @@ class DeepSpeedEngine:
 
         mbs = jax.tree.map(split, batch)
 
+        # grad-accumulation dtype (reference data_types.grad_accum_dtype):
+        # fp32 is exact; bf16 halves the resident accumulator — the knob that
+        # makes gas>1 fit next to a full optimizer state on a 16G chip
+        cfg_dt = getattr(self._config.data_types_config, "grad_accum_dtype", None)
+        acc_map = {None: jnp.float32, "fp32": jnp.float32, "float32": jnp.float32,
+                   "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                   "fp16": jnp.float16, "float16": jnp.float16}
+        if cfg_dt not in acc_map:
+            raise ValueError(f"data_types.grad_accum_dtype={cfg_dt!r} not in "
+                             f"{sorted(k for k in acc_map if k)} (reference "
+                             "config raises on unsupported values too)")
+        acc_dtype = acc_map[cfg_dt]
+
         def body(carry, mb):
             acc, i = carry
             rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step), i)
             loss, grads = self._micro_loss_and_grads(params_c, mb, rng, scale)
             grads = jax.lax.with_sharding_constraint(grads, plan.grad_specs)
-            acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+            acc = jax.tree.map(lambda a, g: a + g.astype(acc_dtype), acc, grads)
             return (acc, i + 1), loss
 
-        zero_acc = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32),
+        zero_acc = jax.tree.map(lambda s: jnp.zeros(s.shape, acc_dtype),
                                 jax.eval_shape(lambda: params_c))
         zero_acc = jax.lax.with_sharding_constraint(zero_acc, plan.grad_specs)
         (acc, _), losses = jax.lax.scan(body, (zero_acc, jnp.int32(0)), mbs)
-        return jnp.mean(losses), jax.tree.map(lambda g: g / gas, acc)
+        return jnp.mean(losses), jax.tree.map(
+            lambda g: (g.astype(jnp.float32) / gas).astype(g.dtype), acc)
 
     def _build_train_batch_fn(self, gas: int):
         """Fused train step: scan over gradient-accumulation microbatches."""

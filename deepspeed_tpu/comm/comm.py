@@ -121,15 +121,50 @@ def init_distributed(dist_backend: str = "xccl",
     """
     global cdb
     if cdb is not None and mesh is None:
+        # same-process topology change: a different mesh_config rebuilds the
+        # backend (engine construction passes mesh_config; driver scripts
+        # must not need to reach into module internals)
+        if mesh_config is not None:
+            candidate = build_mesh(mesh_config=mesh_config)
+            if dict(candidate.shape) != dict(cdb.mesh.shape):
+                cdb = XCCLBackend(candidate)
         return cdb
 
-    if jax.process_count() == 1 and (os.environ.get("DSTPU_NUM_PROCESSES") or
-                                     os.environ.get("COORDINATOR_ADDRESS") or
-                                     os.environ.get("JAX_COORDINATOR_ADDRESS")):
+    # IMPORTANT: decide on multihost bring-up from ENV ONLY — even
+    # jax.process_count() initializes the XLA backend, after which
+    # jax.distributed.initialize refuses to run. Whether the distributed
+    # client already exists is read from jax's own state, not the backend.
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        _dist_client_up = getattr(_jax_distributed.global_state, "client",
+                                  None) is not None
+    except ImportError:    # private module moved: fall back to trying anyway
+        _dist_client_up = False
+    if not _dist_client_up and (os.environ.get("DSTPU_NUM_PROCESSES") or
+                                os.environ.get("COORDINATOR_ADDRESS") or
+                                os.environ.get("JAX_COORDINATOR_ADDRESS")):
         coord = (os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
                  or f"{os.environ.get('MASTER_ADDR', 'localhost')}:{distributed_port}")
-        nproc = int(os.environ.get("DSTPU_NUM_PROCESSES", world_size if world_size > 0 else 1))
-        pid = int(os.environ.get("DSTPU_PROCESS_ID", rank if rank >= 0 else 0))
+
+        # process count/id: explicit args win, then the launcher's env
+        # contract (launcher/launch.py build_env: JAX_NUM_PROCESSES/
+        # JAX_PROCESS_ID + reference-compatible WORLD_SIZE/RANK); empty or
+        # non-numeric env values are treated as unset
+        def _env_int(*names):
+            for n in names:
+                v = os.environ.get(n)
+                if v:
+                    try:
+                        return int(v)
+                    except ValueError:
+                        logger.warning(f"ignoring non-numeric {n}={v!r}")
+            return None
+
+        nproc = world_size if world_size > 0 else \
+            (_env_int("DSTPU_NUM_PROCESSES", "JAX_NUM_PROCESSES", "WORLD_SIZE") or 1)
+        pid = rank if rank >= 0 else \
+            (_env_int("DSTPU_PROCESS_ID", "JAX_PROCESS_ID", "RANK") or 0)
         try:
             jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
             if verbose:

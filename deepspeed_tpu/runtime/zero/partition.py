@@ -170,8 +170,21 @@ def plan_sharding(param_shapes: Any,
     if zc.shard_axes:
         dp_axes = tuple(zc.shard_axes)
     elif zc.mics_shard_size and zc.mics_shard_size > 0:
-        # MiCS: restrict sharding to a sub-group. We approximate by sharding
-        # over the data axis only when its size equals mics_shard_size.
+        # MiCS (ref zero/mics.py:31): shard state within groups of
+        # mics_shard_size, replicate across groups. On a named mesh that is
+        # "shard over the data axis" when the group IS the data axis; a
+        # strict sub-group would need the data axis factored into
+        # (replica, shard) mesh axes at build time — reject loudly rather
+        # than silently shard wider than the user asked.
+        data_size = mesh.shape.get(DATA_AXIS, 1)
+        if int(zc.mics_shard_size) != data_size:
+            raise ValueError(
+                f"mics_shard_size={zc.mics_shard_size} != data-axis size "
+                f"{data_size}: sub-data-axis MiCS groups need a mesh whose "
+                "data axis is factored into (replica, shard) — build the "
+                "mesh with tpu={'data': <shard_size>, ...} and scale the "
+                "remaining replication onto another axis, or use "
+                "zero_optimization.shard_axes to pick the axes explicitly")
         dp_axes = (DATA_AXIS,)
     dp_axes = tuple(a for a in dp_axes if mesh.shape.get(a, 1) > 1)
 

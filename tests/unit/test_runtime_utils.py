@@ -114,3 +114,38 @@ class TestAsyncCheckpoint:
         # load waits for the pending async write, then restores
         engine.load_checkpoint(str(tmp_path), tag="async1")
         assert int(engine.state.step) == step_saved
+
+
+class TestMiCS:
+    def test_mics_shard_size_matching_data_axis(self):
+        import jax
+        from deepspeed_tpu.parallel.topology import build_mesh
+        from deepspeed_tpu.runtime.zero import plan_sharding
+        from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+        comm.cdb = None
+        mesh = build_mesh(axis_dims={"pipe": 1, "data": 8, "expert": 1,
+                                     "seq": 1, "tensor": 1})
+        shapes = jax.eval_shape(
+            lambda: {"w": jnp.zeros((64, 64), jnp.float32)})
+        plan = plan_sharding(shapes, mesh,
+                             zero_config=DeepSpeedZeroConfig(
+                                 stage=3, mics_shard_size=8,
+                                 stage3_param_persistence_threshold=0))
+        assert "data" in str(plan.param_specs["w"])
+
+    def test_mics_sub_group_rejected_with_guidance(self):
+        import jax
+        from deepspeed_tpu.parallel.topology import build_mesh
+        from deepspeed_tpu.runtime.zero import plan_sharding
+        from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+        comm.cdb = None
+        mesh = build_mesh(axis_dims={"pipe": 1, "data": 8, "expert": 1,
+                                     "seq": 1, "tensor": 1})
+        shapes = jax.eval_shape(
+            lambda: {"w": jnp.zeros((64, 64), jnp.float32)})
+        with pytest.raises(ValueError, match="mics_shard_size"):
+            plan_sharding(shapes, mesh,
+                          zero_config=DeepSpeedZeroConfig(stage=3,
+                                                          mics_shard_size=4))

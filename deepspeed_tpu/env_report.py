@@ -88,7 +88,7 @@ def kernel_report():
     except Exception:
         rows.append(("flash_attention kernel", RED_NO))
     try:
-        from deepspeed_tpu.ops.aio import AsyncIOBuilder
+        from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
 
         rows.append(("async_io (C++)", GREEN_OK if AsyncIOBuilder().is_compatible() else RED_NO))
     except Exception:

@@ -61,7 +61,13 @@ def top1gating(logits: jnp.ndarray,
     """
     T, E = logits.shape
     if capacity is None:
-        capacity = _capacity(T, E, capacity_factor, min_capacity)
+        # drop_tokens=False must hold EVERY routed token. The reference grows
+        # capacity to the observed max expert load (dynamic shape); under jit
+        # shapes are static, so the worst case (all tokens on one expert) is
+        # the only drop-free capacity. Costs memory ∝ T·E·T — use only where
+        # the reference would (eval / small expert counts).
+        capacity = _capacity(T, E, capacity_factor, min_capacity) \
+            if drop_tokens else T
 
     gates = jax.nn.softmax(logits, axis=1)
     if noisy_gate_policy == "RSample" and rng is not None:
@@ -102,7 +108,12 @@ def top2gating(logits: jnp.ndarray,
     probability ∝ its gate (second_policy='random'), capacity doubled."""
     T, E = logits.shape
     if capacity is None:
-        capacity = _capacity(T, E, 2 * capacity_factor, min_capacity)
+        # see top1gating: static worst case when nothing may drop. T is
+        # tight: a token's two choices are always DIFFERENT experts (argmax
+        # over gates with the first choice masked), so per-expert occupancy
+        # never exceeds T.
+        capacity = _capacity(T, E, 2 * capacity_factor, min_capacity) \
+            if drop_tokens else T
 
     gates = jax.nn.softmax(logits, axis=1)
     indices1 = jnp.argmax(gates, axis=1)

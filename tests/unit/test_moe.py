@@ -108,3 +108,26 @@ def test_moe_gpt2_trains_with_expert_parallel():
     wi = engine.state.params["moe"]["experts"]["wi"]  # (n_moe, E, D, H)
     shard = wi.addressable_shards[0].data.shape
     assert shard[1] == wi.shape[1] // 4
+
+
+def test_top1_no_drop_keeps_all_tokens():
+    """drop_tokens=False: capacity grows to fit every routed token
+    (reference top1gating drop_tokens=False branch)."""
+    # adversarial logits: every token wants expert 0
+    logits = jnp.concatenate([jnp.full((32, 1), 5.0), jnp.zeros((32, 3))], axis=1)
+    l_aux, combine, dispatch, _ = top1gating(logits, capacity_factor=1.0,
+                                             min_capacity=1, drop_tokens=False)
+    # all 32 tokens dispatched (nothing dropped despite capacity_factor=1)
+    assert float(dispatch.sum()) == 32.0
+
+
+def test_top1_capacity_factor_scales_drops():
+    """Bigger capacity_factor keeps more overflow tokens."""
+    logits = jnp.concatenate([jnp.full((32, 1), 5.0), jnp.zeros((32, 3))], axis=1)
+    kept = {}
+    for cf in (1.0, 2.0, 4.0):
+        _, _, dispatch, _ = top1gating(logits, capacity_factor=cf,
+                                       min_capacity=1, drop_tokens=True)
+        kept[cf] = float(dispatch.sum())
+    assert kept[1.0] < kept[2.0] < kept[4.0]
+    assert kept[4.0] <= 32.0

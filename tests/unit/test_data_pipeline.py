@@ -156,3 +156,61 @@ class TestRandomLTD:
         s2 = self._sched()
         s2.load_state_dict(sd)
         assert s2.get_current_seq() == s.get_current_seq()
+
+
+class TestRandomLTDIntegration:
+    def test_random_ltd_training_loop(self):
+        """End-to-end random-LTD pattern (reference basic_layer
+        RandomLayerTokenDrop role): middle 'layers' of a toy net train on a
+        scheduled token subset; kept-count ramps and the loss still falls."""
+        import optax
+
+        sched = RandomLTDScheduler({
+            "total_layer_num": 4, "random_ltd_layer_num": 2,
+            "global_batch_size": 4,
+            "schedule": {"min_value": 8, "max_value": 16,
+                         "schedule_type": "fixed_linear",
+                         "schedule_config": {"require_steps": 6,
+                                             "seq_per_step": 8}}})
+        D, T, B = 8, 16, 4
+        key = jax.random.PRNGKey(0)
+        params = {"w_in": jax.random.normal(key, (D, D)) * 0.3,
+                  "w_mid": jax.random.normal(jax.random.fold_in(key, 1), (D, D)) * 0.3,
+                  "w_out": jax.random.normal(jax.random.fold_in(key, 2), (D, D)) * 0.3}
+
+        def loss_fn(params, x, y, kept, rng):
+            h = jnp.tanh(x @ params["w_in"])
+            # random-LTD "middle layer": process only `kept` tokens, scatter back
+            idx = random_ltd_sample(rng, T, kept, B)
+            small = random_ltd_gather(h, idx)
+            small = jnp.tanh(small @ params["w_mid"])
+            h = random_ltd_scatter(small, idx, h)
+            out = h @ params["w_out"]
+            return jnp.mean((out - y) ** 2)
+
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+        rng_np = np.random.RandomState(0)
+        x = jnp.asarray(rng_np.randn(B, T, D).astype(np.float32))
+        y = jnp.asarray(rng_np.randn(B, T, D).astype(np.float32))
+
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(2,))
+        def step(params, opt_state, kept_static, rng):
+            # kept is static per compiled program (schedule granularity bounds
+            # recompiles, like curriculum seqlen)
+            g = jax.grad(loss_fn)(params, x, y, kept_static, rng)
+            upd, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(params, upd), opt_state
+
+        losses, kept_seen = [], []
+        for it in range(8):
+            kept = sched.update_seq(it)
+            kept_seen.append(kept)
+            params, opt_state = step(params, opt_state, kept,
+                                     jax.random.fold_in(key, 100 + it))
+            losses.append(float(loss_fn(params, x, y, kept,
+                                        jax.random.fold_in(key, 100 + it))))
+        assert kept_seen[0] == 8 and kept_seen[-1] == 16   # ramp happened
+        assert losses[-1] < losses[0]

@@ -140,11 +140,14 @@ def pipelined_loss_fn_1f1b(stage_fn: Callable,
 
     The GPipe path above differentiates THROUGH the fill-drain scan, so AD
     stacks one saved carry per tick: in-flight activation memory grows O(M)
-    with the microbatch count. This executor instead walks the 1F1B clock of
-    the tested ``TrainSchedule`` (schedule.py:149 — stage s runs fwd of
-    microbatch ``t - s`` and bwd of microbatch ``t - (2S-2-s)`` at tick t,
-    matching its fwd/bwd interleave and send/recv alignment) and computes
-    each microbatch's backward EXPLICITLY with ``jax.vjp`` inside the tick:
+    with the microbatch count. This executor runs an EAGER 1F1B clock —
+    stage s forwards microbatch ``t - s`` and backwards ``t - (2S-2-s)`` at
+    tick t — an SPMD-uniform variant of the tested ``TrainSchedule``
+    (schedule.py:142) with the same dependency structure (every send aligns
+    with the consumer's tick, every bwd follows its fwd by a bounded lag;
+    cross-validated in tests/unit/test_pipe.py) and the same O(S) in-flight
+    bound. Each microbatch's backward is computed EXPLICITLY with
+    ``jax.vjp`` inside the tick:
 
     * stage inputs are kept in a ring buffer of ``2S`` slots (a microbatch's
       bwd trails its fwd by at most ``2(S-1)`` ticks) — O(S) memory,

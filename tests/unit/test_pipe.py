@@ -219,3 +219,43 @@ def test_1f1b_bf16_default_dtype():
     # eval path (forward-only primal) agrees with training loss scale
     ev = float(engine.eval_batch(batch))
     assert np.isfinite(ev)
+
+
+def test_1f1b_clock_satisfies_schedule_invariants():
+    """The in-jit eager 1F1B clock must satisfy the same dependency
+    invariants as the tested TrainSchedule: every microbatch forwarded
+    exactly once and backwarded exactly once per stage, bwd after fwd,
+    producer tick + 1 = consumer tick for activations AND grads, and
+    in-flight activations bounded by O(S) independent of M."""
+    for S, M in ((2, 4), (4, 8), (4, 32)):
+        T = M + 2 * S - 2
+        for s in range(S):
+            fwd_ticks = {}
+            bwd_ticks = {}
+            in_flight, peak = 0, 0
+            for t in range(T):
+                f = t - s
+                if 0 <= f < M:
+                    fwd_ticks[f] = t
+                    in_flight += 1
+                b = t - (2 * S - 2 - s)
+                if 0 <= b < M:
+                    bwd_ticks[b] = t
+                    in_flight -= 1
+                peak = max(peak, in_flight)
+            assert sorted(fwd_ticks) == list(range(M))
+            assert sorted(bwd_ticks) == list(range(M))
+            for m in range(M):
+                assert bwd_ticks[m] >= fwd_ticks[m]          # bwd after fwd
+            # activation alignment: stage s produces fwd m at fwd_ticks[m];
+            # stage s+1 consumes it at its own fwd tick = m + (s+1)
+            if s + 1 < S:
+                for m in range(M):
+                    assert fwd_ticks[m] + 1 == m + (s + 1)
+            # grad alignment: stage s emits grad of m at bwd tick; stage s-1
+            # consumes at m + (2S-2-(s-1))
+            if s > 0:
+                for m in range(M):
+                    assert bwd_ticks[m] + 1 == m + (2 * S - 2 - (s - 1))
+            # 1F1B memory bound: independent of M, matches the ring buffer
+            assert peak <= 2 * (S - 1 - s) + 1 <= 2 * S

@@ -41,7 +41,7 @@ def main():
     config = dataclasses.replace(config, remat=remat if remat != "none" else False)
     seq = int(os.environ.get("BENCH_SEQ", min(1024, config.n_positions)))
     per_chip_bs = int(os.environ.get("BENCH_BS", 12 if on_tpu else 2))
-    steps = int(os.environ.get("BENCH_STEPS", 16 if on_tpu else 3))
+    steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
     gas = int(os.environ.get("BENCH_GAS", 1))
     batch_size = per_chip_bs * n_dev * gas
 

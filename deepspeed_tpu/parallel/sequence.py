@@ -119,7 +119,14 @@ def ring_attention(q, k, v, mesh, causal: bool = True, scale: Optional[float] = 
                 mode = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
             else:
                 mode = jnp.int32(0)
-            o_b, m_b, l_b = _block_attn(q, k_cur, v_cur, scale, mode, q_off, k_off)
+            # remat the block: AD otherwise stores the (B,H,Tq,Tk) score
+            # tensor of EVERY ring step — O(S·T²) residuals, precisely the
+            # memory blow-up ring attention exists to avoid (Liu et al.'s
+            # blockwise recompute)
+            o_b, m_b, l_b = jax.checkpoint(
+                _block_attn,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )(q, k_cur, v_cur, scale, mode, q_off, k_off)
             # streaming-softmax merge
             m_new = jnp.maximum(m_run, m_b)
             c_run = jnp.exp(m_run - m_new)

@@ -40,12 +40,37 @@ class PipelinedGPT2(GPT2Model):
 
     # ---------------------------------------------------------------- params
     def init_params(self, rng) -> Dict[str, Any]:
-        flat = super().init_params(rng)
-        S = self.num_stages
-        Lp = self.config.n_layer // S
-        stages = jax.tree.map(lambda x: x.reshape((S, Lp) + x.shape[1:]), flat["blocks"])
-        shared = {k: v for k, v in flat.items() if k != "blocks"}
+        return self.flat_to_pipe(super().init_params(rng), self.num_stages)
+
+    @staticmethod
+    def flat_to_pipe(flat_params: Dict[str, Any], num_stages: int) -> Dict[str, Any]:
+        """Non-pipelined GPT2Model param tree → pipelined layout.
+
+        The universal-checkpoint bridge across PIPELINE degree (reference
+        universal_checkpoint.py role for pp changes): a checkpoint trained at
+        pp=1 (or any pp, via ``pipe_to_flat``) loads into a pp=S engine by
+        structure conversion; mesh resharding is then the checkpoint
+        engine's normal reshard-on-load."""
+        blocks = flat_params["blocks"]
+        L = int(next(iter(jax.tree.leaves(blocks))).shape[0])
+        if L % num_stages:
+            raise ValueError(f"n_layer {L} not divisible by stages {num_stages}")
+        Lp = L // num_stages
+        stages = jax.tree.map(
+            lambda x: x.reshape((num_stages, Lp) + tuple(x.shape[1:])), blocks)
+        shared = {k: v for k, v in flat_params.items() if k != "blocks"}
         return {"stages": stages, "shared": shared}
+
+    @staticmethod
+    def pipe_to_flat(pipe_params: Dict[str, Any]) -> Dict[str, Any]:
+        """Inverse of ``flat_to_pipe``: (S, Lp, ...) stacks → (L, ...)."""
+        stages = pipe_params["stages"]
+        flat_blocks = jax.tree.map(
+            lambda x: x.reshape((x.shape[0] * x.shape[1],) + tuple(x.shape[2:])),
+            stages)
+        out = dict(pipe_params["shared"])
+        out["blocks"] = flat_blocks
+        return out
 
     def param_partition_specs(self) -> Dict[str, Any]:
         flat = super().param_partition_specs()

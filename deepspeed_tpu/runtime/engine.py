@@ -356,24 +356,30 @@ class DeepSpeedEngine:
             opt_state = self.optimizer.init(opt_target)
             return params, master, opt_state
 
+        # abstract pass first: opt-state STRUCTURE without touching memory,
+        # so every piece can be allocated straight into its final placement
+        # (incl. pinned_host) via out_shardings — building fp32 master +
+        # moments on-device and device_put'ing them to host afterwards needs
+        # ~7x param bytes of HBM and OOMs exactly the models offload exists
+        # for (observed: gpt2-1.3b on one 16G chip)
         with mesh:
-            params, master, opt_state = jax.jit(build)()
-
-        # opt-state shardings: match master-param placement structurally
-        opt_shapes = jax.eval_shape(lambda: opt_state)
-        master_shapes = jax.eval_shape(lambda: master if master is not None else params)
+            abstract = jax.eval_shape(build)
+        a_params, a_master, a_opt = abstract
         if self._onebit:
             opt_specs = self.optimizer.state_partition_specs()
         else:
-            opt_specs = plan.map_opt_state_specs(opt_shapes, master_shapes)
+            opt_specs = plan.map_opt_state_specs(
+                a_opt, a_master if a_master is not None else a_params)
         opt_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), opt_specs)
         if self._host_offload_opt:
             opt_sh = jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), opt_sh)
-        opt_state = jax.device_put(opt_state, opt_sh)
-        if self._host_offload_param:
-            params = jax.device_put(params, param_sh)
-        if self._host_offload_opt and master is not None:
-            master = jax.device_put(master, master_sh)
+
+        with mesh:
+            params, master, opt_state = jax.jit(
+                build,
+                out_shardings=(param_sh,
+                               master_sh if self._keep_master else None,
+                               opt_sh))()
 
         if self._nvme_optimizer is not None:
             flat, _ = jax.tree_util.tree_flatten_with_path(params)

@@ -283,8 +283,17 @@ def test_universal_checkpoint_across_pipeline_degree():
     l_next = float(pipe_engine.train_batch(batch))
     assert np.isfinite(l_next)
 
-    # round trip back to flat
-    back = PipelinedGPT2.pipe_to_flat(
-        jax.tree.map(np.asarray, pipe_params))
-    np.testing.assert_allclose(back["blocks"]["qkv_w"],
-                               flat_params["blocks"]["qkv_w"])
+    # round trip the TRAINED pipe-engine state back to flat: every leaf of
+    # the blocks subtree and the shared subtree must survive bit-exact
+    trained_pipe = jax.tree.map(np.asarray, pipe_engine.state.params)
+    back = PipelinedGPT2.pipe_to_flat(trained_pipe)
+    again = PipelinedGPT2.flat_to_pipe(back, num_stages=2)
+    flat_b, flat_t = jax.tree_util.tree_flatten_with_path(again)[0], \
+        jax.tree_util.tree_flatten_with_path(trained_pipe)[0]
+    assert [p for p, _ in flat_b] == [p for p, _ in flat_t]
+    for (path, a), (_, b) in zip(flat_b, flat_t):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(path))
+    # and the flat tree matches the original model's structure
+    assert set(back) == set(flat_params)
+    assert back["blocks"]["qkv_w"].shape == flat_params["blocks"]["qkv_w"].shape

@@ -46,32 +46,49 @@ def fused_adam(lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
         step_lr = lr_override if lr_override is not None else lr
         count = state.count + 1
         cf = count.astype(jnp.float32)
-        if weight_decay != 0.0 and not adam_w_mode:
-            # classic (L2) mode folds decay into the gradient BEFORE the
-            # moment updates (reference FusedAdam adam_w_mode=0 semantics)
-            grads = jax.tree.map(
-                lambda g, p: g.astype(jnp.float32) + weight_decay * p.astype(jnp.float32),
-                grads, params)
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, grads)
-        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), state.nu, grads)
-        if bias_correction:
-            bc1 = 1 - b1 ** cf
-            bc2 = 1 - b2 ** cf
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
+        bc1, bc2 = adam_bias_corrections(cf, b1, b2, bias_correction)
 
-        def upd(m, v, p):
-            mhat = m / bc1
-            vhat = v / bc2
-            step = mhat / (jnp.sqrt(vhat) + eps)
-            if weight_decay != 0.0 and adam_w_mode:
-                step = step + weight_decay * p.astype(jnp.float32)
-            return (-step_lr * step).astype(p.dtype)
-
-        updates = jax.tree.map(upd, mu, nu, params)
+        out = jax.tree.map(
+            lambda g, m, v, p: adam_leaf_update(
+                p, m, v, g, step_lr, b1, b2, eps, weight_decay, adam_w_mode,
+                bc1, bc2, return_update=True),
+            grads, state.mu, state.nu, params)
+        updates = jax.tree.map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        mu = jax.tree.map(lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        nu = jax.tree.map(lambda t: t[2], out, is_leaf=lambda x: isinstance(x, tuple))
         return updates, AdamState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adam_bias_corrections(cf, b1, b2, bias_correction=True):
+    if bias_correction:
+        return 1 - b1 ** cf, 1 - b2 ** cf
+    return jnp.float32(1.0), jnp.float32(1.0)
+
+
+def adam_leaf_update(p, m, v, g, lr, b1, b2, eps, weight_decay, adam_w_mode,
+                     bc1, bc2, return_update=False):
+    """One leaf of FusedAdam (reference ops/adam/fused_adam.py semantics):
+    the single source of the Adam/AdamW math, shared by the whole-tree
+    optimizer above and the engine's leaf-streamed ZeRO-Offload path.
+
+    Returns (update_or_new_master, mu_new, nu_new): with ``return_update``
+    the first element is the -lr·step delta in ``p``'s dtype (optax
+    contract); otherwise it is the updated fp32 master value ``p - lr·step``.
+    """
+    g = g.astype(jnp.float32)
+    if weight_decay != 0.0 and not adam_w_mode:
+        # classic (L2) mode folds decay into the gradient BEFORE the moments
+        g = g + weight_decay * p.astype(jnp.float32)
+    mu_n = b1 * m + (1 - b1) * g
+    nu_n = b2 * v + (1 - b2) * jnp.square(g)
+    step = (mu_n / bc1) / (jnp.sqrt(nu_n / bc2) + eps)
+    if weight_decay != 0.0 and adam_w_mode:
+        step = step + weight_decay * p.astype(jnp.float32)
+    if return_update:
+        return (-lr * step).astype(p.dtype), mu_n, nu_n
+    return p.astype(jnp.float32) - lr * step, mu_n, nu_n
 
 
 class LambState(NamedTuple):

@@ -1,0 +1,104 @@
+"""Shared model building blocks (chunked LM loss, batch parsing).
+
+The chunked vocab-projection + cross-entropy here is the memory trick the
+reference implements as fused softmax-CE CUDA kernels
+(csrc/transformer/softmax_kernels.cu): the full (B, T, V) fp32 logits tensor
+is never materialized — at V≈50k that is multiple GB per microbatch.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+# logits-buffer budget: chunk length chosen so the (B, chunk, V) fp32 buffer
+# stays around 256MB
+_CHUNK_ELEMS = 64 * 1024 * 1024
+
+NEG_INF_ATTN = -1e30
+
+_warned_flash_fallback = [False]
+
+
+def local_causal_attention(q, k, v, use_flash: bool = True):
+    """Causal self-attention on local (unsharded-sequence) q, k, v with equal
+    head counts (B, T, H, Dh): Pallas flash kernel when available, XLA einsum
+    otherwise (CPU tests, unsupported shapes)."""
+    if use_flash:
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=True)
+        except Exception as e:
+            if not _warned_flash_fallback[0]:
+                _warned_flash_fallback[0] = True
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning(f"flash attention unavailable ({e}); "
+                               "using XLA einsum attention")
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+    logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention(q, k, v, use_flash: bool = True, sequence_parallel=False):
+    """The full causal-attention dispatch shared by the model families:
+    sequence-parallel (ring / Ulysses over the 'seq' mesh axis) when enabled
+    and the mesh has a seq axis, else ``local_causal_attention``."""
+    if sequence_parallel:
+        from deepspeed_tpu.comm import comm
+        from deepspeed_tpu.parallel import sequence as seq_par
+
+        mesh = comm.get_mesh()
+        if mesh.shape.get("seq", 1) > 1:
+            if sequence_parallel == "ulysses":
+                return seq_par.ulysses_attention(
+                    lambda q, k, v: local_causal_attention(q, k, v, use_flash),
+                    q, k, v, mesh)
+            return seq_par.ring_attention(q, k, v, mesh, causal=True)
+    return local_causal_attention(q, k, v, use_flash)
+
+
+def parse_lm_batch(batch):
+    """dict with input_ids [+ labels/loss_mask] or bare (B, T) array →
+    (ids, labels, loss_mask)."""
+    if isinstance(batch, dict):
+        ids = batch["input_ids"]
+        return ids, batch.get("labels", ids), batch.get("loss_mask")
+    return batch, batch, None
+
+
+def chunked_lm_loss(x, head, targets, loss_mask=None):
+    """Mean next-token NLL with the vocab projection computed in sequence
+    chunks.
+
+    x: (B, T, D) final hidden states already shifted to align with
+    ``targets`` (B, T); ``head``: (D, V) in compute dtype; ``loss_mask``:
+    optional (B, T) weighting.
+    """
+    B, T, D = x.shape
+    vocab = head.shape[1]
+    chunk = max(1, min(T, _CHUNK_ELEMS // max(1, B * vocab)))
+    chunk = next((cc for cc in range(chunk, 0, -1) if T % cc == 0), 1)
+    xs = x.reshape(B, T // chunk, chunk, D).swapaxes(0, 1)        # (n, B, C, D)
+    ts = targets.reshape(B, T // chunk, chunk).swapaxes(0, 1)     # (n, B, C)
+
+    def chunk_nll(carry, xt):
+        xc, tc = xt
+        logits = (xc @ head).astype(jnp.float32)                  # (B, C, V)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return carry, lse - tgt
+
+    _, nll = jax.lax.scan(chunk_nll, 0.0, (xs, ts))               # (n, B, C)
+    nll = nll.swapaxes(0, 1).reshape(B, T)
+    if loss_mask is not None:
+        m = loss_mask.astype(jnp.float32)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(nll)

@@ -30,6 +30,8 @@ import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.models.common import NEG_INF_ATTN
+
 
 @dataclasses.dataclass
 class GPT2Config:
@@ -161,43 +163,19 @@ class GPT2Model:
         y = (x32 - mu) * jax.lax.rsqrt(var + eps)
         return (y * g + b).astype(x.dtype)
 
-    _warned_flash_fallback = False
-
     def _attention(self, q, k, v):
-        """q,k,v: (B, T, H, Dh). Causal self-attention."""
-        c = self.config
-        if c.sequence_parallel:
-            from deepspeed_tpu.comm import comm
-            from deepspeed_tpu.parallel import sequence as seq_par
+        """q,k,v: (B, T, H, Dh). Causal self-attention (models/common.py
+        dispatch: sequence-parallel → flash → einsum)."""
+        from deepspeed_tpu.models.common import causal_attention
 
-            mesh = comm.get_mesh()
-            if mesh.shape.get("seq", 1) > 1:
-                if c.sequence_parallel == "ulysses":
-                    return seq_par.ulysses_attention(
-                        lambda q, k, v: self._attention_local(q, k, v), q, k, v, mesh)
-                return seq_par.ring_attention(q, k, v, mesh, causal=True)
-        return self._attention_local(q, k, v)
+        c = self.config
+        return causal_attention(q, k, v, use_flash=c.use_flash_attention,
+                                sequence_parallel=c.sequence_parallel)
 
     def _attention_local(self, q, k, v):
-        c = self.config
-        if c.use_flash_attention:
-            try:
-                from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        from deepspeed_tpu.models.common import local_causal_attention
 
-                return flash_attention(q, k, v, causal=True)
-            except Exception as e:
-                if not GPT2Model._warned_flash_fallback:
-                    GPT2Model._warned_flash_fallback = True
-                    from deepspeed_tpu.utils.logging import logger
-
-                    logger.warning(f"flash attention unavailable ({e}); using XLA einsum attention")
-        scale = 1.0 / math.sqrt(c.head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-        T = q.shape[1]
-        mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
-        logits = jnp.where(mask[None, None], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return local_causal_attention(q, k, v, self.config.use_flash_attention)
 
     def _dropout(self, x, rng):
         p = self.config.dropout
@@ -268,37 +246,14 @@ class GPT2Model:
         trick as the reference's fused softmax-CE kernels, csrc/transformer/
         softmax_kernels.cu — at V≈50k this is multiple GB per microbatch).
         """
-        if isinstance(batch, dict):
-            ids = batch["input_ids"]
-            labels = batch.get("labels", ids)
-            mask = batch.get("loss_mask")
-        else:
-            ids, labels, mask = batch, batch, None
+        from deepspeed_tpu.models.common import chunked_lm_loss, parse_lm_batch
+
+        ids, labels, mask = parse_lm_batch(batch)
         c = self.config
         x = self._trunk(params, ids, rng)[:, :-1]          # (B, T-1, D)
-        targets = labels[:, 1:]
         head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
-
-        B, Tm1, D = x.shape
-        # chunk so the (B, C, V) fp32 logits buffer stays ~256MB
-        chunk = max(1, min(Tm1, (64 * 1024 * 1024) // max(1, B * c.vocab_size)))
-        chunk = next((cc for cc in range(chunk, 0, -1) if Tm1 % cc == 0), 1)
-        xs = x.reshape(B, Tm1 // chunk, chunk, D).swapaxes(0, 1)        # (n, B, C, D)
-        ts = targets.reshape(B, Tm1 // chunk, chunk).swapaxes(0, 1)     # (n, B, C)
-
-        def chunk_nll(carry, xt):
-            xc, tc = xt
-            logits = (xc @ head).astype(jnp.float32)                     # (B, C, V)
-            lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
-            return carry, lse - tgt
-
-        _, nll = jax.lax.scan(chunk_nll, 0.0, (xs, ts))                  # (n, B, C)
-        nll = nll.swapaxes(0, 1).reshape(B, Tm1)
-        if mask is not None:
-            m = mask[:, 1:].astype(jnp.float32)
-            return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
-        return jnp.mean(nll)
+        return chunked_lm_loss(x, head, labels[:, 1:],
+                               mask[:, 1:] if mask is not None else None)
 
 
     # ------------------------------------------------------------- inference
@@ -393,9 +348,6 @@ class GPT2Model:
         head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
         logits = (x[:, 0] @ head).astype(jnp.float32)
         return logits, {"k": ks, "v": vs, "pos": pos + 1}
-
-
-NEG_INF_ATTN = -1e30
 
 
 def synthetic_lm_batch(batch_size: int, seq_len: int, vocab_size: int, seed: int = 0):

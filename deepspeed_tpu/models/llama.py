@@ -1,0 +1,403 @@
+"""LLaMA family decoder — the second real model family.
+
+The reference serves LLaMA through a per-architecture injection policy
+(module_inject/containers/llama.py, replace_policy registration) over a loaded
+HF torch module. Here the architecture is implemented TPU-native with the same
+design as models/gpt2.py — layer-stacked params scanned with ``lax.scan``,
+Megatron TP as PartitionSpecs, pluggable flash attention — covering the
+LLaMA-specific pieces the GPT-2 trunk lacks:
+
+* RMSNorm (no mean subtraction, no bias) in fp32;
+* rotary position embeddings (rotate-half convention, matching HF's
+  ``apply_rotary_pos_emb`` so converted checkpoints are bit-compatible);
+* SwiGLU MLP (gate/up/down, no biases anywhere);
+* grouped-query attention: ``n_kv_head <= n_head`` KV heads, repeated to the
+  query head count at attention time — the KV cache stores only the KV heads,
+  which is the GQA inference memory win.
+
+Implements the same model protocol as GPT2Model (init_params, loss, apply,
+prefill/decode_step, partition specs), so ``initialize()``,
+``init_inference()``, ZeRO, TP, and the checkpoint engine apply unchanged.
+Weights convert from HF ``LlamaForCausalLM`` via module_inject/hf.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.ad_checkpoint import checkpoint_name
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.common import NEG_INF_ATTN
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_positions: int = 2048          # max sequence length (RoPE has no table)
+    n_embd: int = 4096
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: Optional[int] = None  # None → n_head (no GQA)
+    intermediate_size: Optional[int] = None  # None → LLaMA's 8/3·d rounded to 256
+    rope_theta: float = 10000.0
+    # None | {"rope_type": "linear", "factor": f}
+    #      | {"rope_type": "llama3", "factor", "low_freq_factor",
+    #         "high_freq_factor", "original_max_position_embeddings"}
+    # (HF config.rope_scaling semantics — llama3 is the 3.1+ long-context NTK)
+    rope_scaling: Optional[dict] = None
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False     # llama3.2-1B/3B style tied lm_head
+    dtype: Any = jnp.bfloat16
+    remat: Any = True                # False | True/'full' | 'dots' | 'attn'
+    use_flash_attention: bool = True
+    sequence_parallel: Any = False   # False | 'ring' | 'ulysses'
+
+    VALID_REMAT = (False, None, "none", True, "full", "dots", "attn")
+
+    VALID_ROPE_TYPES = ("default", "linear", "llama3")
+
+    def __post_init__(self):
+        if self.remat not in self.VALID_REMAT:
+            raise ValueError(f"remat={self.remat!r} not in {self.VALID_REMAT}")
+        if self.rope_scaling is not None:
+            kind = self.rope_scaling.get("rope_type",
+                                         self.rope_scaling.get("type", "default"))
+            if kind not in self.VALID_ROPE_TYPES:
+                raise ValueError(f"rope_scaling type {kind!r} not supported "
+                                 f"(have: {self.VALID_ROPE_TYPES})")
+        if self.n_kv_head is None:
+            self.n_kv_head = self.n_head
+        if self.n_head % self.n_kv_head:
+            raise ValueError(f"n_head={self.n_head} not divisible by "
+                             f"n_kv_head={self.n_kv_head}")
+        if self.intermediate_size is None:
+            self.intermediate_size = 256 * ((int(8 * self.n_embd / 3) + 255) // 256)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_head * self.head_dim
+
+    def num_params(self) -> int:
+        c = self
+        d, i, l, v = c.n_embd, c.intermediate_size, c.n_layer, c.vocab_size
+        per_layer = d * d + 2 * d * c.kv_dim + d * d + 3 * d * i + 2 * d
+        embeds = v * d if c.tie_embeddings else 2 * v * d
+        return embeds + l * per_layer + d
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Megatron accounting (6N + 12·l·d·s), as in GPT2Config: GQA does not
+        change the attention score/value FLOPs, only the KV projection (already
+        inside N)."""
+        s = seq_len or self.n_positions
+        return 6 * self.num_params() + 12 * self.n_layer * self.n_embd * s
+
+
+PRESETS = {
+    "llama-tiny": LlamaConfig(vocab_size=512, n_positions=128, n_embd=64,
+                              n_layer=2, n_head=4, n_kv_head=2,
+                              intermediate_size=128),
+    "llama-7b": LlamaConfig(),
+    "llama-13b": LlamaConfig(n_embd=5120, n_layer=40, n_head=40,
+                             intermediate_size=13824),
+    "llama2-7b": LlamaConfig(n_positions=4096),
+    "llama2-70b": LlamaConfig(n_embd=8192, n_layer=80, n_head=64, n_kv_head=8,
+                              n_positions=4096, intermediate_size=28672),
+    "llama3-8b": LlamaConfig(vocab_size=128256, n_positions=8192, n_embd=4096,
+                             n_layer=32, n_head=32, n_kv_head=8,
+                             intermediate_size=14336, rope_theta=500000.0),
+    "llama3.1-8b": LlamaConfig(vocab_size=128256, n_positions=131072,
+                               n_embd=4096, n_layer=32, n_head=32, n_kv_head=8,
+                               intermediate_size=14336, rope_theta=500000.0,
+                               rope_scaling={"rope_type": "llama3",
+                                             "factor": 8.0,
+                                             "low_freq_factor": 1.0,
+                                             "high_freq_factor": 4.0,
+                                             "original_max_position_embeddings": 8192}),
+}
+
+
+def _scaled_inv_freq(inv_freq, scaling: Optional[dict]):
+    """Apply HF-style rope_scaling to the frequency vector."""
+    if not scaling:
+        return inv_freq
+    kind = scaling.get("rope_type", scaling.get("type", "default"))
+    if kind == "default":
+        return inv_freq
+    factor = float(scaling["factor"])
+    if kind == "linear":
+        return inv_freq / factor
+    # "llama3" (3.1+ context extension): low-frequency components divided by
+    # `factor`, high-frequency kept, smooth interpolation in between —
+    # matching transformers' _compute_llama3_parameters
+    low = float(scaling["low_freq_factor"])
+    high = float(scaling["high_freq_factor"])
+    old_len = float(scaling["original_max_position_embeddings"])
+    wavelen = 2.0 * math.pi / inv_freq
+    smooth = (old_len / wavelen - low) / (high - low)
+    smoothed = (1.0 - smooth) / factor * inv_freq + smooth * inv_freq
+    scaled = jnp.where(wavelen > old_len / low, inv_freq / factor, inv_freq)
+    is_medium = (wavelen >= old_len / high) & (wavelen <= old_len / low)
+    return jnp.where(is_medium, smoothed, scaled)
+
+
+def _rope_cos_sin(positions, head_dim: int, theta: float,
+                  scaling: Optional[dict] = None):
+    """cos/sin tables (T, Dh) for rotate-half RoPE (HF convention: the
+    frequency vector is duplicated, not interleaved)."""
+    d2 = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(d2, dtype=jnp.float32) / d2))
+    inv_freq = _scaled_inv_freq(inv_freq, scaling)
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]   # (T, d2)
+    cos = jnp.concatenate([jnp.cos(ang)] * 2, axis=-1)
+    sin = jnp.concatenate([jnp.sin(ang)] * 2, axis=-1)
+    return cos, sin
+
+
+def apply_rope(x, cos, sin):
+    """x: (B, T, H, Dh); cos/sin: (T, Dh). Rotate-half convention."""
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    out = x32 * cos[None, :, None, :] + rotated * sin[None, :, None, :]
+    return out.astype(x.dtype)
+
+
+class LlamaModel:
+    """Functional LLaMA: params are a dict with stacked per-layer leaves."""
+
+    def __init__(self, config: LlamaConfig):
+        self.config = config
+
+    # ---------------------------------------------------------------- params
+    def init_params(self, rng) -> Dict[str, Any]:
+        c = self.config
+        d, i, l = c.n_embd, c.intermediate_size, c.n_layer
+        keys = jax.random.split(rng, 8)
+        s = 0.02
+        proj_scale = s / math.sqrt(2 * l)   # residual-scaled, as in GPT-2 init
+        norm = lambda key, shape, scale: jax.random.normal(key, shape, jnp.float32) * scale
+        params = {
+            "wte": norm(keys[0], (c.vocab_size, d), s),
+            "blocks": {
+                "attn_norm_g": jnp.ones((l, d), jnp.float32),
+                "q_w": norm(keys[1], (l, d, d), s),
+                "k_w": norm(keys[2], (l, d, c.kv_dim), s),
+                "v_w": norm(keys[3], (l, d, c.kv_dim), s),
+                "o_w": norm(keys[4], (l, d, d), proj_scale),
+                "mlp_norm_g": jnp.ones((l, d), jnp.float32),
+                "gate_w": norm(keys[5], (l, d, i), s),
+                "up_w": norm(keys[6], (l, d, i), s),
+                "down_w": norm(keys[7], (l, i, d), proj_scale),
+            },
+            "norm_g": jnp.ones((d,), jnp.float32),
+        }
+        if not c.tie_embeddings:
+            params["lm_head"] = norm(jax.random.fold_in(keys[0], 1),
+                                     (d, c.vocab_size), s)
+        return params
+
+    def param_partition_specs(self) -> Dict[str, Any]:
+        """Megatron TP over the 'tensor' mesh axis: q/k/v/gate/up column
+        parallel, o/down row parallel, vocab-sharded embedding."""
+        specs = {
+            "wte": P("tensor", None),
+            "blocks": {
+                "attn_norm_g": P(None, None),
+                "q_w": P(None, None, "tensor"),
+                "k_w": P(None, None, "tensor"),
+                "v_w": P(None, None, "tensor"),
+                "o_w": P(None, "tensor", None),
+                "mlp_norm_g": P(None, None),
+                "gate_w": P(None, None, "tensor"),
+                "up_w": P(None, None, "tensor"),
+                "down_w": P(None, "tensor", None),
+            },
+            "norm_g": P(None),
+        }
+        if not self.config.tie_embeddings:
+            specs["lm_head"] = P(None, "tensor")
+        return specs
+
+    # --------------------------------------------------------------- compute
+    def _head(self, params, dtype):
+        head = (params["wte"].T if self.config.tie_embeddings
+                else params["lm_head"])
+        return head.astype(dtype)
+
+    def _rms_norm(self, x, g):
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + self.config.rms_norm_eps) * g).astype(x.dtype)
+
+    def _repeat_kv(self, t):
+        """(B, T, KV, Dh) → (B, T, H, Dh) for the attention kernel."""
+        rep = self.config.n_head // self.config.n_kv_head
+        return t if rep == 1 else jnp.repeat(t, rep, axis=2)
+
+    def _attention(self, q, k, v):
+        """q: (B,T,H,Dh); k,v: (B,T,KV,Dh). Causal self-attention with GQA:
+        KV heads are repeated to the query head count, then the shared
+        dispatch (models/common.py: sequence-parallel → flash → einsum)."""
+        from deepspeed_tpu.models.common import causal_attention
+
+        c = self.config
+        return causal_attention(q, self._repeat_kv(k), self._repeat_kv(v),
+                                use_flash=c.use_flash_attention,
+                                sequence_parallel=c.sequence_parallel)
+
+    def _block_qkv(self, x, blk, cos, sin):
+        """One block's RoPE'd q, k, v for the current x."""
+        c = self.config
+        B, T, D = x.shape
+        h = self._rms_norm(x, blk["attn_norm_g"])
+        hd = h.astype(c.dtype)
+        q = (hd @ blk["q_w"].astype(hd.dtype)).reshape(B, T, c.n_head, c.head_dim)
+        k = (hd @ blk["k_w"].astype(hd.dtype)).reshape(B, T, c.n_kv_head, c.head_dim)
+        v = (hd @ blk["v_w"].astype(hd.dtype)).reshape(B, T, c.n_kv_head, c.head_dim)
+        return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+    def _block_finish(self, x, blk, attn):
+        c = self.config
+        B, T, D = x.shape
+        a = attn.reshape(B, T, D) @ blk["o_w"].astype(x.dtype)
+        x = x + a
+        h = self._rms_norm(x, blk["mlp_norm_g"])
+        gate = h @ blk["gate_w"].astype(h.dtype)
+        up = h @ blk["up_w"].astype(h.dtype)
+        return x + (jax.nn.silu(gate) * up) @ blk["down_w"].astype(x.dtype)
+
+    def _block(self, x, blk, cos_sin):
+        cos, sin = cos_sin
+        q, k, v = self._block_qkv(x, blk, cos, sin)
+        attn = self._attention(q, k, v)
+        attn = checkpoint_name(attn, "attn_out")
+        return self._block_finish(x, blk, attn)
+
+    def _trunk(self, params, input_ids, rng=None):
+        c = self.config
+        B, T = input_ids.shape
+        x = params["wte"].astype(c.dtype)[input_ids]
+        cos, sin = _rope_cos_sin(jnp.arange(T), c.head_dim, c.rope_theta, c.rope_scaling)
+
+        block_fn = self._block
+        if c.remat in (True, "full"):
+            block_fn = jax.checkpoint(
+                block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        elif c.remat == "dots":
+            block_fn = jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        elif c.remat == "attn":
+            block_fn = jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
+
+        def scan_body(carry, blk):
+            return block_fn(carry, blk, (cos, sin)), None
+
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        return self._rms_norm(x, params["norm_g"])
+
+    def hidden_states(self, params, input_ids, rng=None):
+        return self._trunk(params, input_ids, rng)
+
+    def apply(self, params, input_ids, rng=None):
+        """input_ids (B, T) int32 → logits (B, T, V) fp32."""
+        x = self._trunk(params, input_ids, rng)
+        return (x @ self._head(params, x.dtype)).astype(jnp.float32)
+
+    def loss(self, params, batch, rng=None):
+        """Next-token cross entropy with the chunked vocab projection
+        (models/common.py)."""
+        from deepspeed_tpu.models.common import chunked_lm_loss, parse_lm_batch
+
+        ids, labels, mask = parse_lm_batch(batch)
+        x = self._trunk(params, ids, rng)[:, :-1]
+        head = self._head(params, x.dtype)
+        return chunked_lm_loss(x, head, labels[:, 1:],
+                               mask[:, 1:] if mask is not None else None)
+
+    # ------------------------------------------------------------- inference
+    def init_cache(self, batch_size: int, max_len: int):
+        """KV cache holds only the KV heads: (L, B, max_len, KV, Dh) — the GQA
+        memory win over the reference's full-head InferenceContext workspace
+        (csrc/transformer/inference/includes/inference_context.h:287)."""
+        c = self.config
+        shape = (c.n_layer, batch_size, max_len, c.n_kv_head, c.head_dim)
+        return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    def cache_partition_specs(self):
+        return {"k": P(None, None, None, "tensor", None),
+                "v": P(None, None, None, "tensor", None),
+                "pos": P()}
+
+    def prefill(self, params, input_ids, cache):
+        """Process the prompt, fill the cache, return last-position logits."""
+        from deepspeed_tpu.models.common import local_causal_attention
+
+        c = self.config
+        B, T = input_ids.shape
+        max_len = cache["k"].shape[2]
+        x = params["wte"].astype(c.dtype)[input_ids]
+        cos, sin = _rope_cos_sin(jnp.arange(T), c.head_dim, c.rope_theta, c.rope_scaling)
+
+        def body(carry, blk):
+            x = carry
+            q, k, v = self._block_qkv(x, blk, cos, sin)
+            attn = local_causal_attention(q, self._repeat_kv(k),
+                                          self._repeat_kv(v),
+                                          c.use_flash_attention)
+            x = self._block_finish(x, blk, attn)
+            pad = lambda t: jax.lax.dynamic_update_slice(
+                jnp.zeros((B, max_len, c.n_kv_head, c.head_dim), c.dtype),
+                t, (0, 0, 0, 0))
+            return x, (pad(k), pad(v))
+
+        x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+        x = self._rms_norm(x, params["norm_g"])
+        logits = (x[:, -1] @ self._head(params, x.dtype)).astype(jnp.float32)
+        return logits, {"k": ks, "v": vs, "pos": jnp.int32(T)}
+
+    def decode_step(self, params, token, cache):
+        """One token for every sequence: (B,) → logits (B, V), cache advanced."""
+        c = self.config
+        B = token.shape[0]
+        pos = cache["pos"]
+        max_len = cache["k"].shape[2]
+        x = params["wte"].astype(c.dtype)[token][:, None]   # (B, 1, D)
+        cos, sin = _rope_cos_sin(pos[None], c.head_dim, c.rope_theta, c.rope_scaling)
+        valid = (jnp.arange(max_len) <= pos)[None, None, None, :]   # (1,1,1,T)
+        scale = 1.0 / math.sqrt(c.head_dim)
+        rep = c.n_head // c.n_kv_head
+
+        def body(carry, xs):
+            x = carry
+            blk, k_cache, v_cache = xs
+            q, k, v = self._block_qkv(x, blk, cos, sin)     # q (B,1,H,Dh)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+            # grouped q: (B, 1, KV, rep, Dh) against KV-head cache — the
+            # per-token GQA attention never materializes repeated K/V
+            qg = q.reshape(B, 1, c.n_kv_head, rep, c.head_dim)
+            logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_cache).astype(jnp.float32) * scale
+            logits = jnp.where(valid[:, :, None], logits, NEG_INF_ATTN)
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            attn = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_cache)
+            x = self._block_finish(x, blk, attn.reshape(B, 1, c.n_head, c.head_dim))
+            return x, (k_cache, v_cache)
+
+        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+        x = self._rms_norm(x, params["norm_g"])
+        logits = (x[:, 0] @ self._head(params, x.dtype)).astype(jnp.float32)
+        return logits, {"k": ks, "v": vs, "pos": pos + 1}

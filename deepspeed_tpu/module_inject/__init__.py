@@ -1,6 +1,9 @@
 from deepspeed_tpu.module_inject.auto_tp import AutoTP, ReplaceWithTensorSlicing, apply_tp
-from deepspeed_tpu.module_inject.hf import (export_gpt2, hf_state_dict, load_gpt2,
-                                            load_hf_model, state_dict_to_tree)
+from deepspeed_tpu.module_inject.hf import (export_gpt2, export_llama,
+                                            hf_state_dict, load_gpt2,
+                                            load_hf_model, load_llama,
+                                            state_dict_to_tree)
 
 __all__ = ["AutoTP", "ReplaceWithTensorSlicing", "apply_tp", "export_gpt2",
-           "hf_state_dict", "load_gpt2", "load_hf_model", "state_dict_to_tree"]
+           "export_llama", "hf_state_dict", "load_gpt2", "load_hf_model",
+           "load_llama", "state_dict_to_tree"]

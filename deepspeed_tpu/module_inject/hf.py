@@ -12,7 +12,8 @@ in-tree TPU model's layer-stacked layout, after which training
 (``init_inference(params=...)``), ZeRO, TP, and checkpointing all apply
 unchanged.
 
-Supported today: GPT-2 family (``GPT2LMHeadModel`` — the flagship).
+Supported today: GPT-2 family (``GPT2LMHeadModel`` — the flagship) and LLaMA
+(``LlamaForCausalLM``, incl. GQA / llama2 / llama3 shapes).
 Everything else still gets ``state_dict_to_tree`` + AutoTP's name-pattern
 classification (reference auto_tp.py role) for TP placement of the raw tree.
 """
@@ -35,7 +36,10 @@ def hf_state_dict(model_or_sd: Any) -> Dict[str, np.ndarray]:
     out = {}
     for k, v in sd.items():
         if hasattr(v, "detach"):        # torch tensor, no torch import needed
-            v = v.detach().cpu().numpy()
+            v = v.detach().cpu()
+            if str(v.dtype) == "torch.bfloat16":
+                v = v.float()           # numpy has no bf16; exact in fp32
+            v = v.numpy()
         out[k] = np.asarray(v)
     return out
 
@@ -162,7 +166,139 @@ def export_gpt2(params: Dict[str, Any], prefix: str = "transformer.") -> Dict[st
     return sd
 
 
-_LOADERS = {"gpt2": load_gpt2}
+# ------------------------------------------------------------------- LLaMA
+def load_llama(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``LlamaForCausalLM`` → (LlamaConfig, params) for
+    ``deepspeed_tpu.models.llama.LlamaModel``.
+
+    Pass the HF *model* (its config carries the head count, RoPE theta and
+    scaling) — a bare state dict is rejected: unlike GPT-2, LLaMA head counts
+    are not recoverable from tensor shapes (7B is head_dim 128) and a wrong
+    guess silently changes RoPE.
+
+    HF ``nn.Linear`` stores weights as (out_features, in_features); our
+    matmuls are x @ W with W (in, out), so every projection transposes.
+    Counterpart of the reference's llama policy container
+    (module_inject/containers/llama.py) which performs the same
+    qkv/o/gate/up/down tensor bookkeeping for kernel injection.
+    """
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    cfg = getattr(model_or_sd, "config", None)
+    n_head = int(getattr(cfg, "num_attention_heads", 0) or 0)
+    if not n_head:
+        raise ValueError(
+            "load_llama needs the head count: pass the HF model (its config "
+            "carries num_attention_heads), not a bare state dict")
+    rope_scaling = getattr(cfg, "rope_scaling", None)
+    if rope_scaling is not None:
+        # fail before the (possibly tens-of-GB) conversion below if the
+        # scaling variant is one the TPU model cannot reproduce
+        kind = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+        if kind not in LlamaConfig.VALID_ROPE_TYPES:
+            raise NotImplementedError(
+                f"rope_scaling type {kind!r} not supported (have: "
+                f"{LlamaConfig.VALID_ROPE_TYPES}) — converting would produce "
+                "wrong logits")
+        rope_scaling = dict(rope_scaling)
+
+    sd = hf_state_dict(model_or_sd)
+    prefix = "model." if any(k.startswith("model.") for k in sd) else ""
+    g = lambda name: sd[prefix + name].astype(dtype)
+
+    layer_ids = sorted({int(m.group(1)) for k in sd
+                        for m in [re.match(rf"{re.escape(prefix)}layers\.(\d+)\.", k)] if m})
+    n_layer = len(layer_ids)
+    assert layer_ids == list(range(n_layer)), f"non-contiguous layers {layer_ids}"
+
+    wte = g("embed_tokens.weight")
+    vocab, d = wte.shape
+    # shape probes on the raw dict — g() would astype-copy whole tensors
+    kv_dim = sd[prefix + "layers.0.self_attn.k_proj.weight"].shape[0]
+    inter = sd[prefix + "layers.0.mlp.gate_proj.weight"].shape[0]
+    head_dim = d // n_head
+    assert kv_dim % head_dim == 0, f"kv_dim {kv_dim} vs head_dim {head_dim}"
+
+    stack_t = lambda name: np.stack(
+        [g(f"layers.{i}.{name}.weight").T for i in range(n_layer)])
+    stack = lambda name: np.stack(
+        [g(f"layers.{i}.{name}.weight") for i in range(n_layer)])
+    params = {
+        "wte": wte,
+        "blocks": {
+            "attn_norm_g": stack("input_layernorm"),
+            "q_w": stack_t("self_attn.q_proj"),
+            "k_w": stack_t("self_attn.k_proj"),
+            "v_w": stack_t("self_attn.v_proj"),
+            "o_w": stack_t("self_attn.o_proj"),
+            "mlp_norm_g": stack("post_attention_layernorm"),
+            "gate_w": stack_t("mlp.gate_proj"),
+            "up_w": stack_t("mlp.up_proj"),
+            "down_w": stack_t("mlp.down_proj"),
+        },
+        "norm_g": g("norm.weight"),
+    }
+    # HF ties lm_head to embed_tokens when config.tie_word_embeddings (the
+    # llama3.2-1B/3B layout) — keep it tied so fine-tuning can't drift the
+    # two copies apart (and vocab-size optimizer state isn't doubled)
+    tied = ("lm_head.weight" not in sd
+            or np.array_equal(sd["lm_head.weight"], sd[prefix + "embed_tokens.weight"]))
+    if not tied:
+        params["lm_head"] = sd["lm_head.weight"].astype(dtype).T
+
+    import jax.numpy as jnp
+
+    config = LlamaConfig(
+        vocab_size=vocab, n_embd=d, n_layer=n_layer, n_head=n_head,
+        n_kv_head=kv_dim // head_dim, intermediate_size=inter,
+        n_positions=int(getattr(cfg, "max_position_embeddings", 2048) or 2048),
+        rope_theta=float(getattr(cfg, "rope_theta", 10000.0) or 10000.0),
+        rope_scaling=rope_scaling, tie_embeddings=tied,
+        rms_norm_eps=float(getattr(cfg, "rms_norm_eps", 1e-5) or 1e-5),
+        dtype=jnp.dtype(np.dtype(dtype)) if np.dtype(dtype) != np.float32 else jnp.float32)
+    logger.info(f"load_llama: {n_layer} layers, d={d}, vocab={vocab}, "
+                f"heads={n_head}, kv_heads={config.n_kv_head}, inter={inter}")
+    return config, params
+
+
+def export_llama(params: Dict[str, Any], prefix: str = "model.") -> Dict[str, np.ndarray]:
+    """Inverse of ``load_llama``: TPU param tree → HF-layout state dict."""
+    blocks = params["blocks"]
+    n_layer = int(np.asarray(blocks["attn_norm_g"]).shape[0])
+    sd: Dict[str, np.ndarray] = {
+        prefix + "embed_tokens.weight": np.asarray(params["wte"]),
+        prefix + "norm.weight": np.asarray(params["norm_g"]),
+        "lm_head.weight": (np.asarray(params["lm_head"]).T
+                           if "lm_head" in params
+                           else np.asarray(params["wte"])),
+    }
+    transposed = [("self_attn.q_proj", "q_w"), ("self_attn.k_proj", "k_w"),
+                  ("self_attn.v_proj", "v_w"), ("self_attn.o_proj", "o_w"),
+                  ("mlp.gate_proj", "gate_w"), ("mlp.up_proj", "up_w"),
+                  ("mlp.down_proj", "down_w")]
+    for i in range(n_layer):
+        sd[f"{prefix}layers.{i}.input_layernorm.weight"] = np.asarray(blocks["attn_norm_g"][i])
+        sd[f"{prefix}layers.{i}.post_attention_layernorm.weight"] = np.asarray(blocks["mlp_norm_g"][i])
+        for hf_name, ours in transposed:
+            sd[f"{prefix}layers.{i}.{hf_name}.weight"] = np.asarray(blocks[ours][i]).T
+    return sd
+
+
+def _gpt2_model(config):
+    from deepspeed_tpu.models.gpt2 import GPT2Model
+
+    return GPT2Model(config)
+
+
+def _llama_model(config):
+    from deepspeed_tpu.models.llama import LlamaModel
+
+    return LlamaModel(config)
+
+
+# architecture → (state-dict loader, model factory)
+_LOADERS = {"gpt2": (load_gpt2, _gpt2_model),
+            "llama": (load_llama, _llama_model)}
 
 
 def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,
@@ -174,8 +310,6 @@ def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,
     ready for ``initialize(model=..., model_parameters=...)`` or
     ``init_inference(model=..., params=...)``.
     """
-    from deepspeed_tpu.models.gpt2 import GPT2Model
-
     if architecture is None:
         cfg = getattr(model_or_sd, "config", None)
         architecture = getattr(cfg, "model_type", None)
@@ -184,5 +318,6 @@ def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,
             f"no TPU repack for architecture {architecture!r} (have: "
             f"{sorted(_LOADERS)}); use state_dict_to_tree + AutoTP.apply_tp "
             "for spec-only TP placement of the raw tree")
-    config, params = _LOADERS[architecture](model_or_sd, dtype=dtype)
-    return GPT2Model(config), params
+    loader, model_factory = _LOADERS[architecture]
+    config, params = loader(model_or_sd, dtype=dtype)
+    return model_factory(config), params

@@ -30,8 +30,6 @@ import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.common import NEG_INF_ATTN
-
 
 @dataclasses.dataclass
 class GPT2Config:
@@ -47,6 +45,10 @@ class GPT2Config:
     # right trade on TPU where HBM, not FLOPs, is the binding constraint)
     remat: Any = True
     use_flash_attention: bool = True
+    # Pallas streaming decode kernel for generate(); opt-in — wins when the
+    # KV cache is preallocated longer than the generated length (see
+    # models/common.py cached_decode_attention for measured numbers)
+    use_flash_decode: bool = False
     tie_embeddings: bool = True
     # sequence parallelism over the 'seq' mesh axis: False | 'ring' | 'ulysses'
     # (parallel/sequence.py — long-context support beyond the reference)
@@ -323,11 +325,10 @@ class GPT2Model:
         c = self.config
         B = token.shape[0]
         pos = cache["pos"]
-        max_len = cache["k"].shape[2]
         x = params["wte"].astype(c.dtype)[token][:, None]  # (B, 1, D)
         x = x + jax.lax.dynamic_slice_in_dim(params["wpe"].astype(c.dtype), pos, 1, 0)[None]
 
-        valid = (jnp.arange(max_len) <= pos)[None, None, None, :]  # (1,1,1,T)
+        from deepspeed_tpu.models.common import cached_decode_attention
 
         def body(carry, xs):
             x = carry
@@ -335,11 +336,8 @@ class GPT2Model:
             q, k, v = self._block_kv(x, blk)           # (B, 1, H, Dh)
             k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-            scale = 1.0 / math.sqrt(c.head_dim)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32) * scale
-            logits = jnp.where(valid, logits, NEG_INF_ATTN)
-            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+            attn = cached_decode_attention(q[:, 0], k_cache, v_cache, pos,
+                                           c.use_flash_decode)[:, None]
             x = self._block_finish(x, blk, attn)
             return x, (k_cache, v_cache)
 

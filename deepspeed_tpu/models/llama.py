@@ -33,8 +33,6 @@ import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.common import NEG_INF_ATTN
-
 
 @dataclasses.dataclass
 class LlamaConfig:
@@ -56,6 +54,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: Any = True                # False | True/'full' | 'dots' | 'attn'
     use_flash_attention: bool = True
+    # Pallas streaming decode kernel for generate(); opt-in — wins when the
+    # KV cache is preallocated longer than the generated length (see
+    # models/common.py cached_decode_attention for measured numbers)
+    use_flash_decode: bool = False
     sequence_parallel: Any = False   # False | 'ring' | 'ulysses'
 
     VALID_REMAT = (False, None, "none", True, "full", "dots", "attn")
@@ -374,12 +376,10 @@ class LlamaModel:
         c = self.config
         B = token.shape[0]
         pos = cache["pos"]
-        max_len = cache["k"].shape[2]
         x = params["wte"].astype(c.dtype)[token][:, None]   # (B, 1, D)
         cos, sin = _rope_cos_sin(pos[None], c.head_dim, c.rope_theta, c.rope_scaling)
-        valid = (jnp.arange(max_len) <= pos)[None, None, None, :]   # (1,1,1,T)
-        scale = 1.0 / math.sqrt(c.head_dim)
-        rep = c.n_head // c.n_kv_head
+
+        from deepspeed_tpu.models.common import cached_decode_attention
 
         def body(carry, xs):
             x = carry
@@ -387,14 +387,11 @@ class LlamaModel:
             q, k, v = self._block_qkv(x, blk, cos, sin)     # q (B,1,H,Dh)
             k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-            # grouped q: (B, 1, KV, rep, Dh) against KV-head cache — the
-            # per-token GQA attention never materializes repeated K/V
-            qg = q.reshape(B, 1, c.n_kv_head, rep, c.head_dim)
-            logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_cache).astype(jnp.float32) * scale
-            logits = jnp.where(valid[:, :, None], logits, NEG_INF_ATTN)
-            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-            attn = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_cache)
-            x = self._block_finish(x, blk, attn.reshape(B, 1, c.n_head, c.head_dim))
+            # GQA decode against the KV-head cache — repeated K/V are never
+            # materialized (grouped einsum or the Pallas streaming kernel)
+            attn = cached_decode_attention(q[:, 0], k_cache, v_cache, pos,
+                                           c.use_flash_decode)[:, None]
+            x = self._block_finish(x, blk, attn)
             return x, (k_cache, v_cache)
 
         x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))

@@ -1,0 +1,100 @@
+"""Decode-attention kernel numerics (single-token KV-cache path).
+
+Runs the Pallas TPU kernel in interpreter mode on the CPU mesh (bit-accurate
+to the kernel's math); real-TPU numerics validated on hardware — see
+.claude/skills/verify/SKILL.md.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu.ops.pallas.decode_attention as da
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    if jax.default_backend() != "tpu":
+        from jax.experimental import pallas as pl
+
+        monkeypatch.setattr(da.pl, "pallas_call",
+                            functools.partial(pl.pallas_call, interpret=True))
+    yield
+
+
+def _rand(B, S, H, KV, Dh, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(kq, (B, H, Dh), jnp.float32)
+    k = jax.random.normal(kk, (B, S, KV, Dh), jnp.float32)
+    v = jax.random.normal(kv, (B, S, KV, Dh), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("kv", [4, 2, 1])          # MHA, GQA, MQA
+@pytest.mark.parametrize("pos", [0, 63, 64, 200, 255])
+def test_matches_reference(kv, pos):
+    B, S, H, Dh = 2, 256, 4, 64
+    q, k, v = _rand(B, S, H, kv, Dh)
+    out = da.decode_attention(q, k, v, jnp.int32(pos), block_k=64)
+    ref = da.decode_reference(q, k, v, jnp.int32(pos))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_garbage_beyond_pos_ignored():
+    """Entries past ``pos`` must not affect the output (the cache holds
+    uninitialized zeros / stale tokens there)."""
+    B, S, H, KV, Dh = 1, 128, 2, 1, 64
+    q, k, v = _rand(B, S, H, KV, Dh, seed=1)
+    pos = 40
+    k_dirty = k.at[:, pos + 1:].set(1e9)
+    v_dirty = v.at[:, pos + 1:].set(-1e9)
+    out = da.decode_attention(q, k_dirty, v_dirty, jnp.int32(pos), block_k=32)
+    ref = da.decode_reference(q, k, v, jnp.int32(pos))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_nondivisible_block_falls_back_to_divisor():
+    B, S, H, KV, Dh = 1, 96, 4, 2, 32
+    q, k, v = _rand(B, S, H, KV, Dh, seed=2)
+    out = da.decode_attention(q, k, v, jnp.int32(95), block_k=64)  # 96 % 64 != 0
+    ref = da.decode_reference(q, k, v, jnp.int32(95))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_model_decode_with_kernel_matches_einsum_path():
+    """use_flash_decode=True must reproduce the default einsum decode through
+    a whole LlamaModel decode_step (GQA cache, RoPE positions)."""
+    import dataclasses
+
+    from deepspeed_tpu.models.llama import PRESETS, LlamaModel
+
+    base = dataclasses.replace(PRESETS["llama-tiny"], dtype=jnp.float32,
+                               use_flash_attention=False, remat=False)
+    m_ein = LlamaModel(base)
+    m_ker = LlamaModel(dataclasses.replace(base, use_flash_decode=True))
+    params = m_ein.init_params(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, base.vocab_size, size=(2, 8)), jnp.int32)
+    cache = m_ein.init_cache(2, 24)
+    logits, cache = m_ein.prefill(params, ids, cache)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    out_e, _ = m_ein.decode_step(params, tok, cache)
+    out_k, _ = m_ker.decode_step(params, tok, cache)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_e),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_inputs():
+    B, S, H, KV, Dh = 2, 128, 4, 2, 64
+    q, k, v = _rand(B, S, H, KV, Dh, seed=3)
+    q, k, v = q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+    out = da.decode_attention(q, k, v, jnp.int32(100))
+    ref = da.decode_reference(q, k, v, jnp.int32(100))
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2)

@@ -39,6 +39,9 @@ class GPT2Config:
     n_layer: int = 12
     n_head: int = 12
     dropout: float = 0.0
+    # MLP activation (HF naming): 'gelu_new' (tanh approx — what GPT-2 itself
+    # uses), 'gelu' (exact erf), or 'relu' (OPT)
+    activation: str = "gelu_new"
     dtype: Any = jnp.bfloat16
     # activation checkpointing: False/'none', True/'full' (recompute all),
     # or 'dots' (save matmul outputs, recompute elementwise — usually the
@@ -59,6 +62,9 @@ class GPT2Config:
     def __post_init__(self):
         if self.remat not in self.VALID_REMAT:
             raise ValueError(f"remat={self.remat!r} not in {self.VALID_REMAT}")
+        if self.activation not in ("gelu", "gelu_new", "relu"):
+            raise ValueError(f"activation {self.activation!r} not in "
+                             "('gelu', 'gelu_new', 'relu')")
 
     @property
     def head_dim(self) -> int:
@@ -290,7 +296,11 @@ class GPT2Model:
         x = x + self._dropout(a, dk(0))
         h = self._layer_norm(x, blk["ln2_g"], blk["ln2_b"])
         h = h @ blk["fc_w"].astype(h.dtype) + blk["fc_b"].astype(h.dtype)
-        h = jax.nn.gelu(h)
+        act = self.config.activation
+        if act == "relu":
+            h = jax.nn.relu(h)
+        else:
+            h = jax.nn.gelu(h, approximate=(act == "gelu_new"))
         return x + self._dropout(h @ blk["fc2_w"].astype(x.dtype) + blk["fc2_b"].astype(x.dtype), dk(1))
 
     def prefill(self, params, input_ids, cache):

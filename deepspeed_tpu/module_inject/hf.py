@@ -12,8 +12,9 @@ in-tree TPU model's layer-stacked layout, after which training
 (``init_inference(params=...)``), ZeRO, TP, and checkpointing all apply
 unchanged.
 
-Supported today: GPT-2 family (``GPT2LMHeadModel`` — the flagship) and LLaMA
-(``LlamaForCausalLM``, incl. GQA / llama2 / llama3 shapes).
+Supported today: GPT-2 family (``GPT2LMHeadModel`` — the flagship), LLaMA
+(``LlamaForCausalLM``, incl. GQA / llama2 / llama3 shapes), and OPT
+(``OPTForCausalLM`` — the DeepSpeed-Chat RLHF family).
 Everything else still gets ``state_dict_to_tree`` + AutoTP's name-pattern
 classification (reference auto_tp.py role) for TP placement of the raw tree.
 """
@@ -284,6 +285,108 @@ def export_llama(params: Dict[str, Any], prefix: str = "model.") -> Dict[str, np
     return sd
 
 
+# --------------------------------------------------------------------- OPT
+def load_opt(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``OPTForCausalLM`` → (GPT2Config, params) for GPT2Model.
+
+    OPT (the DeepSpeed-Chat RLHF model family, blogs/deepspeed-chat) is
+    architecturally a GPT-2-shaped pre-LN decoder with learned positions:
+    separate q/k/v projections concatenate into GPT-2's fused qkv, the
+    position table drops OPT's 2-row attention-mask offset, and the MLP
+    activation is ReLU (GPT2Config activation='relu'). Reference counterpart:
+    module_inject/containers/opt.py.
+
+    Unsupported (raises): OPT-350m's post-LN (``do_layer_norm_before=False``)
+    and word_embed_proj_dim != hidden_size (project_in/out).
+    """
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    cfg = getattr(model_or_sd, "config", None)
+    n_head = int(getattr(cfg, "num_attention_heads", 0) or 0)
+    if not n_head:
+        raise ValueError("load_opt needs the HF model (config carries "
+                         "num_attention_heads), not a bare state dict")
+    if getattr(cfg, "do_layer_norm_before", True) is False:
+        raise NotImplementedError("OPT-350m-style post-LN "
+                                  "(do_layer_norm_before=False) not supported")
+    act = getattr(cfg, "activation_function", "relu") or "relu"
+    if act not in ("relu", "gelu", "gelu_new"):
+        # e.g. Galactica ships model_type 'opt' with activation 'gelu';
+        # anything beyond relu/gelu would silently mis-convert
+        raise NotImplementedError(f"OPT activation_function {act!r} not "
+                                  "supported (relu, gelu, gelu_new)")
+    if getattr(cfg, "word_embed_proj_dim", None) not in (
+            None, getattr(cfg, "hidden_size", None)):
+        raise NotImplementedError("OPT word_embed_proj_dim != hidden_size "
+                                  "(project_in/out) not supported")
+
+    sd = hf_state_dict(model_or_sd)
+    prefix = next((p for p in ("model.decoder.", "decoder.", "")
+                   if p + "embed_tokens.weight" in sd), "")
+    g = lambda name: sd[prefix + name].astype(dtype)
+
+    layer_ids = sorted({int(m.group(1)) for k in sd
+                        for m in [re.match(rf"{re.escape(prefix)}layers\.(\d+)\.", k)] if m})
+    n_layer = len(layer_ids)
+    assert layer_ids == list(range(n_layer)), f"non-contiguous layers {layer_ids}"
+
+    wte = g("embed_tokens.weight")
+    vocab, d = wte.shape
+    # OPT's position table has 2 leading rows for the attention-mask offset
+    # (transformers OPTLearnedPositionalEmbedding: position i reads row i+2)
+    wpe = g("embed_positions.weight")[2:]
+
+    def qkv_w(i):
+        return np.concatenate(
+            [g(f"layers.{i}.self_attn.{p}_proj.weight").T for p in ("q", "k", "v")],
+            axis=1)
+
+    def qkv_b(i):
+        return np.concatenate(
+            [g(f"layers.{i}.self_attn.{p}_proj.bias") for p in ("q", "k", "v")])
+
+    stack_t = lambda name: np.stack(
+        [g(f"layers.{i}.{name}.weight").T for i in range(n_layer)])
+    stack_b = lambda name: np.stack(
+        [g(f"layers.{i}.{name}.bias") for i in range(n_layer)])
+    stack_w = lambda name: np.stack(
+        [g(f"layers.{i}.{name}.weight") for i in range(n_layer)])
+    params = {
+        "wte": wte,
+        "wpe": wpe,
+        "blocks": {
+            "ln1_g": stack_w("self_attn_layer_norm"),
+            "ln1_b": stack_b("self_attn_layer_norm"),
+            "qkv_w": np.stack([qkv_w(i) for i in range(n_layer)]),
+            "qkv_b": np.stack([qkv_b(i) for i in range(n_layer)]),
+            "proj_w": stack_t("self_attn.out_proj"),
+            "proj_b": stack_b("self_attn.out_proj"),
+            "ln2_g": stack_w("final_layer_norm"),
+            "ln2_b": stack_b("final_layer_norm"),
+            "fc_w": stack_t("fc1"),
+            "fc_b": stack_b("fc1"),
+            "fc2_w": stack_t("fc2"),
+            "fc2_b": stack_b("fc2"),
+        },
+        "lnf_g": g("final_layer_norm.weight"),
+        "lnf_b": g("final_layer_norm.bias"),
+    }
+    tied = ("lm_head.weight" not in sd
+            or np.array_equal(sd["lm_head.weight"], sd[prefix + "embed_tokens.weight"]))
+    if not tied:
+        params["lm_head"] = sd["lm_head.weight"].astype(dtype).T
+
+    import jax.numpy as jnp
+
+    config = GPT2Config(
+        vocab_size=vocab, n_positions=wpe.shape[0], n_embd=d, n_layer=n_layer,
+        n_head=n_head, activation=act, tie_embeddings=tied,
+        dtype=jnp.dtype(np.dtype(dtype)) if np.dtype(dtype) != np.float32 else jnp.float32)
+    logger.info(f"load_opt: {n_layer} layers, d={d}, vocab={vocab}, "
+                f"heads={n_head}, act={act}, tied={tied}")
+    return config, params
+
+
 def _gpt2_model(config):
     from deepspeed_tpu.models.gpt2 import GPT2Model
 
@@ -298,7 +401,8 @@ def _llama_model(config):
 
 # architecture → (state-dict loader, model factory)
 _LOADERS = {"gpt2": (load_gpt2, _gpt2_model),
-            "llama": (load_llama, _llama_model)}
+            "llama": (load_llama, _llama_model),
+            "opt": (load_opt, _gpt2_model)}
 
 
 def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,

@@ -56,9 +56,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
         l_sc[:] = jnp.zeros_like(l_sc)
 
     def block_update(mask_cols: bool):
-        cols = None
-        if mask_cols:
-            pass  # iota built per group below (same for all groups)
+        cols = None                         # built once, shared by all groups
         for g in range(num_kv):             # static unroll over KV groups
             q = q_ref[0, g]                 # (Rp, Dh), scale pre-folded
             k = k_ref[0, :, g]              # (block_k, Dh)
@@ -150,6 +148,10 @@ def decode_attention(q, k_cache, v_cache, pos, block_k: int = DEFAULT_BLOCK_K):
             flops=int(4 * B * H * S * Dh),
             bytes_accessed=int(k_cache.size + v_cache.size) * k_cache.dtype.itemsize,
             transcendentals=int(B * H * S)),
+        # Mosaic lowering is TPU-only, and under jit a lowering failure
+        # escapes any try/except around the call — so off-TPU the kernel
+        # interprets itself (slow but exact; CPU decode is not a perf target)
+        interpret=jax.default_backend() != "tpu",
     )(pos_arr, qg, k_cache, v_cache)
     return out[:, :, :rep].reshape(B, H, Dh)
 

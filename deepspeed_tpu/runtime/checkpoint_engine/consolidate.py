@@ -1,0 +1,116 @@
+"""Offline checkpoint consolidation — the ``zero_to_fp32.py`` analogue.
+
+The reference ships ``deepspeed/utils/zero_to_fp32.py`` (578 LoC): an offline
+tool that merges per-rank ZeRO optimizer shards into one fp32 state dict
+without needing the training cluster. On TPU the orbax OCDBT checkpoint is
+already rank-agnostic (placement is restore-time metadata), so consolidation
+is: restore the flat state on host, prefer the fp32 master copy, rebuild the
+nested param tree. No engine, no mesh, no devices required.
+
+Also exports back to the torch ecosystem: ``--arch gpt2|llama|opt`` emits an
+HF-layout state dict via module_inject's exporters.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def resolve_tag(ckpt_dir: str, tag: Optional[str] = None) -> str:
+    if tag is None:
+        latest = os.path.join(os.path.abspath(ckpt_dir), "latest")
+        if not os.path.isfile(latest):
+            raise FileNotFoundError(f"no 'latest' file in {ckpt_dir}; pass an "
+                                    "explicit tag")
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.join(os.path.abspath(ckpt_dir), tag)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint {path} not found")
+    return tag
+
+
+def _restore_flat(ckpt_dir: str, tag: str) -> Dict[str, Any]:
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(os.path.join(os.path.abspath(ckpt_dir), tag, "state"))
+
+
+def consolidated_fp32_params(ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, Any]:
+    """Checkpoint directory → nested fp32 param pytree on host memory.
+
+    Prefers the fp32 master copy (``master/...`` leaves — the authoritative
+    weights under bf16/fp16 training, reference bf16_optimizer role); falls
+    back to the compute-dtype ``params/...`` leaves upcast to fp32.
+    """
+    tag = resolve_tag(ckpt_dir, tag)
+    flat = _restore_flat(ckpt_dir, tag)
+
+    masters = {k[len("master/"):]: v for k, v in flat.items()
+               if k.startswith("master/") and v is not None}
+    params = {k[len("params/"):]: v for k, v in flat.items()
+              if k.startswith("params/")}
+    source = masters if masters and len(masters) == len(params) else params
+    if source is params and masters:
+        logger.warning(f"master tree has {len(masters)} leaves vs params "
+                       f"{len(params)}; consolidating compute-dtype params")
+
+    tree: Dict[str, Any] = {}
+    for key, val in source.items():
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.asarray(val, dtype=np.float32)
+    logger.info(f"consolidated {len(source)} fp32 tensors from {ckpt_dir}/{tag} "
+                f"({'master' if source is masters else 'params'} tree)")
+    return tree
+
+
+def checkpoint_metadata(ckpt_dir: str, tag: Optional[str] = None) -> dict:
+    tag = resolve_tag(ckpt_dir, tag)
+    meta_path = os.path.join(os.path.abspath(ckpt_dir), tag, "client_state.json")
+    if not os.path.isfile(meta_path):
+        return {}
+    with open(meta_path) as f:
+        return json.load(f)
+
+
+_EXPORTERS = {"gpt2": "export_gpt2", "llama": "export_llama"}
+
+
+def consolidate_to_file(ckpt_dir: str, output: str, tag: Optional[str] = None,
+                        arch: Optional[str] = None) -> str:
+    """Consolidate and write to ``output``:
+
+    * default: ``.npz`` with '/'-joined tree paths as keys;
+    * ``arch='gpt2'|'opt'|'llama'``: ``.npz`` in HF state-dict layout
+      (torch loads it via ``{k: torch.from_numpy(v) for k, v in np.load(f).items()}``).
+    """
+    params = consolidated_fp32_params(ckpt_dir, tag)
+    if arch is not None:
+        from deepspeed_tpu.module_inject import hf as hf_bridge
+
+        name = _EXPORTERS.get("gpt2" if arch == "opt" else arch)
+        if name is None:
+            raise ValueError(f"no exporter for arch {arch!r} "
+                             f"(have: {sorted(_EXPORTERS) + ['opt']})")
+        if arch == "opt":
+            logger.warning("arch='opt': emitting GPT-2-layout keys (the "
+                           "in-tree OPT runtime model is GPT-2-shaped); "
+                           "re-keying to OPT names is not implemented")
+        sd = getattr(hf_bridge, name)(params)
+    else:
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import _flatten_state
+
+        sd = _flatten_state(params)
+    np.savez(output, **sd)
+    logger.info(f"wrote {len(sd)} tensors to {output}")
+    return output

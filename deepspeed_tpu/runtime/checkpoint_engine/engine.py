@@ -80,6 +80,13 @@ def wait_for_pending_saves():
         _pending_latest_threads.pop().join()
 
 
+# the 'latest'-pointer advance runs on a daemon thread; a trainer that exits
+# right after save_checkpoint() must not lose it
+import atexit  # noqa: E402
+
+atexit.register(wait_for_pending_saves)
+
+
 def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                            client_state: Optional[dict] = None, save_latest: bool = True) -> bool:
     import orbax.checkpoint as ocp

@@ -1,0 +1,119 @@
+"""OPT conversion: the DeepSpeed-Chat RLHF model family on the TPU runtime.
+
+OPT maps onto GPT2Model (pre-LN decoder, learned positions, ReLU MLP);
+parity is checked against a genuine ``transformers`` OPTForCausalLM with
+random weights. Reference counterpart: module_inject/containers/opt.py and
+the DeepSpeed-Chat OPT benchmarks (blogs/deepspeed-chat/README.md:30).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Model
+from deepspeed_tpu.module_inject.hf import load_hf_model, load_opt
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+VOCAB = 128
+
+
+@pytest.fixture(scope="module")
+def hf_opt():
+    from transformers import OPTConfig, OPTForCausalLM
+
+    torch.manual_seed(0)
+    cfg = OPTConfig(vocab_size=VOCAB, hidden_size=32, ffn_dim=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=64, do_layer_norm_before=True,
+                    dropout=0.0, activation_function="relu",
+                    word_embed_proj_dim=32)
+    return OPTForCausalLM(cfg).eval()
+
+
+@pytest.fixture()
+def ids():
+    # avoid token 1 (OPT pad) so HF's mask-from-pad heuristic stays all-ones
+    rng = np.random.RandomState(0)
+    return (rng.randint(2, VOCAB - 2, size=(2, 12))).astype(np.int32)
+
+
+class TestOPTConversion:
+    def test_logits_match_torch(self, hf_opt, ids):
+        model, params = load_hf_model(hf_opt)
+        assert isinstance(model, GPT2Model)
+        assert model.config.activation == "relu"
+        model = GPT2Model(dataclasses.replace(
+            model.config, dtype=jnp.float32, use_flash_attention=False,
+            remat=False))
+        ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+        with torch.no_grad():
+            theirs = hf_opt(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+    def test_generate_matches_torch_greedy(self, hf_opt, ids):
+        model, params = load_hf_model(hf_opt)
+        model = GPT2Model(dataclasses.replace(
+            model.config, dtype=jnp.float32, use_flash_attention=False,
+            remat=False))
+        engine = deepspeed_tpu.init_inference(
+            model, config={"dtype": "fp32", "max_out_tokens": 64}, params=params)
+        out = np.asarray(engine.generate(ids, max_new_tokens=8, do_sample=False))
+        with torch.no_grad():
+            ref = hf_opt.generate(torch.tensor(ids, dtype=torch.long),
+                                  max_new_tokens=8, do_sample=False).numpy()
+        np.testing.assert_array_equal(out, ref)
+
+    def test_gelu_opt_matches_torch(self, ids):
+        """Galactica-style OPT (activation_function='gelu', exact erf) must
+        convert with the right activation, not silently ReLU."""
+        from transformers import OPTConfig, OPTForCausalLM
+
+        torch.manual_seed(1)
+        cfg = OPTConfig(vocab_size=VOCAB, hidden_size=32, ffn_dim=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=64, dropout=0.0,
+                        activation_function="gelu", word_embed_proj_dim=32)
+        hf = OPTForCausalLM(cfg).eval()
+        model, params = load_hf_model(hf)
+        assert model.config.activation == "gelu"
+        model = GPT2Model(dataclasses.replace(
+            model.config, dtype=jnp.float32, use_flash_attention=False,
+            remat=False))
+        ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+        with torch.no_grad():
+            theirs = hf(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+    def test_post_ln_rejected(self):
+        from transformers import OPTConfig, OPTForCausalLM
+
+        cfg = OPTConfig(vocab_size=VOCAB, hidden_size=32, ffn_dim=64,
+                        num_hidden_layers=1, num_attention_heads=2,
+                        max_position_embeddings=32, do_layer_norm_before=False,
+                        word_embed_proj_dim=32)
+        with pytest.raises(NotImplementedError, match="post-LN"):
+            load_opt(OPTForCausalLM(cfg))
+
+    def test_train_through_initialize(self, hf_opt):
+        model, params = load_hf_model(hf_opt)
+        model = GPT2Model(dataclasses.replace(model.config,
+                                              use_flash_attention=False))
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "bf16": {"enabled": True},
+                    "zero_optimization": {"stage": 1},
+                    "steps_per_print": 0})
+        rng = np.random.RandomState(1)
+        batch = {"input_ids": rng.randint(0, VOCAB,
+                                          size=(8, 32)).astype(np.int32)}
+        losses = [float(engine.train_batch(batch)) for _ in range(6)]
+        assert losses[-1] < losses[0], losses

@@ -22,11 +22,31 @@ NEG_INF_ATTN = -1e30
 _warned_flash_fallback = [False]
 
 
-def local_causal_attention(q, k, v, use_flash: bool = True):
+def alibi_slopes(n_head: int):
+    """ALiBi per-head slopes, matching HF ``build_alibi_tensor`` (geometric
+    sequence on the nearest power of two, interleaved extras otherwise)."""
+    cp2 = 2 ** math.floor(math.log2(n_head))
+    base = 2.0 ** (-(2.0 ** -(math.log2(cp2) - 3)))
+    slopes = [base ** (i + 1) for i in range(cp2)]
+    if cp2 != n_head:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * cp2) - 3)))
+        slopes += [extra_base ** (i + 1)
+                   for i in range(0, 2 * (n_head - cp2), 2)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None):
     """Causal self-attention on local (unsharded-sequence) q, k, v with equal
     head counts (B, T, H, Dh): Pallas flash kernel when available, XLA einsum
-    otherwise (CPU tests, unsupported shapes)."""
-    if use_flash:
+    otherwise (CPU tests, unsupported shapes).
+
+    ``alibi``: optional (H,) per-head slopes; the bias added is
+    ``slopes[h] * j`` (key position only) — equivalent to the canonical
+    ``slopes * (j - i)`` because per-row constants cancel in softmax, and
+    exactly HF BLOOM's ``build_alibi_tensor`` under a full attention mask.
+    Biased attention takes the einsum path (the flash kernel carries no bias).
+    """
+    if use_flash and alibi is None:
         try:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -41,6 +61,9 @@ def local_causal_attention(q, k, v, use_flash: bool = True):
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     T = q.shape[1]
+    if alibi is not None:
+        logits = logits + (alibi[None, :, None, None]
+                           * jnp.arange(T, dtype=jnp.float32)[None, None, None, :])
     mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
     logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
@@ -50,10 +73,12 @@ def local_causal_attention(q, k, v, use_flash: bool = True):
 _warned_decode_fallback = [False]
 
 
-def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False):
+def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False,
+                            alibi=None):
     """Single-token decode attention over a KV cache, shared by the model
     families. q: (B, H, Dh) — the new token's queries; caches (B, S, KV, Dh)
-    valid through index ``pos``; KV may divide H (GQA). → (B, H, Dh).
+    valid through index ``pos``; KV may divide H (GQA); ``alibi``: optional
+    (H,) slopes (key-position bias; einsum path only). → (B, H, Dh).
 
     ``use_flash_decode`` selects the Pallas streaming kernel
     (ops/pallas/decode_attention.py). Measured on v5e: the kernel reads only
@@ -63,7 +88,7 @@ def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False):
     on a 4-layer model: 79ms vs 113ms) but loses ~2× to XLA's fused einsum
     when the cache is exactly full — hence opt-in.
     """
-    if use_flash_decode:
+    if use_flash_decode and alibi is None:
         try:
             from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
 
@@ -80,17 +105,25 @@ def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False):
     qg = q.reshape(B, KV, H // KV, Dh)
     scale = 1.0 / math.sqrt(Dh)
     s = jnp.einsum("bgrd,bkgd->bgrk", qg, k_cache).astype(jnp.float32) * scale
+    if alibi is not None:
+        s = s + (alibi.reshape(KV, H // KV)[None, :, :, None]
+                 * jnp.arange(S, dtype=jnp.float32)[None, None, None, :])
     valid = (jnp.arange(S) <= pos)[None, None, None]
     s = jnp.where(valid, s, NEG_INF_ATTN)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bgrk,bkgd->bgrd", p, v_cache).reshape(B, H, Dh)
 
 
-def causal_attention(q, k, v, use_flash: bool = True, sequence_parallel=False):
+def causal_attention(q, k, v, use_flash: bool = True, sequence_parallel=False,
+                     alibi=None):
     """The full causal-attention dispatch shared by the model families:
     sequence-parallel (ring / Ulysses over the 'seq' mesh axis) when enabled
     and the mesh has a seq axis, else ``local_causal_attention``."""
     if sequence_parallel:
+        if alibi is not None:
+            raise NotImplementedError(
+                "ALiBi attention does not compose with ring/Ulysses sequence "
+                "parallelism (the position bias is not carried across shards)")
         from deepspeed_tpu.comm import comm
         from deepspeed_tpu.parallel import sequence as seq_par
 
@@ -101,7 +134,7 @@ def causal_attention(q, k, v, use_flash: bool = True, sequence_parallel=False):
                     lambda q, k, v: local_causal_attention(q, k, v, use_flash),
                     q, k, v, mesh)
             return seq_par.ring_attention(q, k, v, mesh, causal=True)
-    return local_causal_attention(q, k, v, use_flash)
+    return local_causal_attention(q, k, v, use_flash, alibi=alibi)
 
 
 def parse_lm_batch(batch):

@@ -53,6 +53,11 @@ class GPT2Config:
     # models/common.py cached_decode_attention for measured numbers)
     use_flash_decode: bool = False
     tie_embeddings: bool = True
+    # BLOOM-style variant switches: ALiBi replaces the learned position table
+    # (no wpe param; attention gets per-head linear position biases) and an
+    # extra layernorm follows the token embedding
+    alibi: bool = False
+    embed_layernorm: bool = False
     # sequence parallelism over the 'seq' mesh axis: False | 'ring' | 'ulysses'
     # (parallel/sequence.py — long-context support beyond the reference)
     sequence_parallel: Any = False
@@ -116,7 +121,6 @@ class GPT2Model:
         proj_scale = 0.02 / math.sqrt(2 * l)  # GPT-2 residual-scaled init
         params = {
             "wte": jax.random.normal(keys[0], (c.vocab_size, d), jnp.float32) * 0.02,
-            "wpe": jax.random.normal(keys[1], (c.n_positions, d), jnp.float32) * 0.01,
             "blocks": {
                 "ln1_g": jnp.ones((l, d), jnp.float32),
                 "ln1_b": jnp.zeros((l, d), jnp.float32),
@@ -134,6 +138,11 @@ class GPT2Model:
             "lnf_g": jnp.ones((d,), jnp.float32),
             "lnf_b": jnp.zeros((d,), jnp.float32),
         }
+        if not c.alibi:
+            params["wpe"] = jax.random.normal(keys[1], (c.n_positions, d), jnp.float32) * 0.01
+        if c.embed_layernorm:
+            params["emb_ln_g"] = jnp.ones((d,), jnp.float32)
+            params["emb_ln_b"] = jnp.zeros((d,), jnp.float32)
         if not c.tie_embeddings:
             params["lm_head"] = jax.random.normal(keys[6], (d, c.vocab_size), jnp.float32) * 0.02
         return params
@@ -141,9 +150,9 @@ class GPT2Model:
     def param_partition_specs(self) -> Dict[str, Any]:
         """Megatron TP layout over the 'tensor' mesh axis. Leading layer dim of
         stacked block params is never sharded (it's the scan axis)."""
+        c = self.config
         specs = {
             "wte": P("tensor", None),          # vocab-sharded embedding
-            "wpe": P(None, None),
             "blocks": {
                 "ln1_g": P(None, None), "ln1_b": P(None, None),
                 "qkv_w": P(None, None, "tensor"),   # column parallel
@@ -158,7 +167,12 @@ class GPT2Model:
             },
             "lnf_g": P(None), "lnf_b": P(None),
         }
-        if not self.config.tie_embeddings:
+        if not c.alibi:
+            specs["wpe"] = P(None, None)
+        if c.embed_layernorm:
+            specs["emb_ln_g"] = P(None)
+            specs["emb_ln_b"] = P(None)
+        if not c.tie_embeddings:
             specs["lm_head"] = P(None, "tensor")
         return specs
 
@@ -171,6 +185,13 @@ class GPT2Model:
         y = (x32 - mu) * jax.lax.rsqrt(var + eps)
         return (y * g + b).astype(x.dtype)
 
+    def _alibi(self):
+        if not self.config.alibi:
+            return None
+        from deepspeed_tpu.models.common import alibi_slopes
+
+        return alibi_slopes(self.config.n_head)
+
     def _attention(self, q, k, v):
         """q,k,v: (B, T, H, Dh). Causal self-attention (models/common.py
         dispatch: sequence-parallel → flash → einsum)."""
@@ -178,12 +199,26 @@ class GPT2Model:
 
         c = self.config
         return causal_attention(q, k, v, use_flash=c.use_flash_attention,
-                                sequence_parallel=c.sequence_parallel)
+                                sequence_parallel=c.sequence_parallel,
+                                alibi=self._alibi())
 
     def _attention_local(self, q, k, v):
         from deepspeed_tpu.models.common import local_causal_attention
 
-        return local_causal_attention(q, k, v, self.config.use_flash_attention)
+        return local_causal_attention(q, k, v, self.config.use_flash_attention,
+                                      alibi=self._alibi())
+
+    def _embed(self, params, input_ids):
+        """Token (+ learned position, unless ALiBi) embedding, with BLOOM's
+        optional post-embedding layernorm."""
+        c = self.config
+        T = input_ids.shape[1]
+        x = params["wte"].astype(c.dtype)[input_ids]
+        if not c.alibi:
+            x = x + params["wpe"].astype(c.dtype)[:T]
+        if c.embed_layernorm:
+            x = self._layer_norm(x, params["emb_ln_g"], params["emb_ln_b"])
+        return x
 
     def _dropout(self, x, rng):
         p = self.config.dropout
@@ -211,7 +246,7 @@ class GPT2Model:
     def _trunk(self, params, input_ids, rng=None):
         c = self.config
         B, T = input_ids.shape
-        x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[:T]
+        x = self._embed(params, input_ids)
         if rng is not None and c.dropout > 0.0:
             rng, emb_key = jax.random.split(rng)
             x = self._dropout(x, emb_key)
@@ -308,7 +343,7 @@ class GPT2Model:
         c = self.config
         B, T = input_ids.shape
         max_len = cache["k"].shape[2]
-        x = params["wte"].astype(c.dtype)[input_ids] + params["wpe"].astype(c.dtype)[:T]
+        x = self._embed(params, input_ids)
 
         def body(carry, blk):
             x = carry
@@ -336,7 +371,11 @@ class GPT2Model:
         B = token.shape[0]
         pos = cache["pos"]
         x = params["wte"].astype(c.dtype)[token][:, None]  # (B, 1, D)
-        x = x + jax.lax.dynamic_slice_in_dim(params["wpe"].astype(c.dtype), pos, 1, 0)[None]
+        if not c.alibi:
+            x = x + jax.lax.dynamic_slice_in_dim(
+                params["wpe"].astype(c.dtype), pos, 1, 0)[None]
+        if c.embed_layernorm:
+            x = self._layer_norm(x, params["emb_ln_g"], params["emb_ln_b"])
 
         from deepspeed_tpu.models.common import cached_decode_attention
 
@@ -347,7 +386,8 @@ class GPT2Model:
             k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
             attn = cached_decode_attention(q[:, 0], k_cache, v_cache, pos,
-                                           c.use_flash_decode)[:, None]
+                                           c.use_flash_decode,
+                                           alibi=self._alibi())[:, None]
             x = self._block_finish(x, blk, attn)
             return x, (k_cache, v_cache)
 

@@ -57,7 +57,7 @@ class MoEGPT2(GPT2Model):
             ids, labels = batch, batch
         c = self.config
         B, T = ids.shape
-        x = params["wte"].astype(c.dtype)[ids] + params["wpe"].astype(c.dtype)[:T]
+        x = self._embed(params, ids)
 
         # interleave dense blocks and MoE MLP blocks without python-loop
         # unrolling of the dense part: scan pairs of (dense block, moe layer)

@@ -1,9 +1,11 @@
 from deepspeed_tpu.module_inject.auto_tp import AutoTP, ReplaceWithTensorSlicing, apply_tp
-from deepspeed_tpu.module_inject.hf import (export_gpt2, export_llama,
-                                            hf_state_dict, load_gpt2,
+from deepspeed_tpu.module_inject.hf import (export_bloom, export_gpt2,
+                                            export_llama, hf_state_dict,
+                                            load_bloom, load_gpt2,
                                             load_hf_model, load_llama,
                                             load_opt, state_dict_to_tree)
 
-__all__ = ["AutoTP", "ReplaceWithTensorSlicing", "apply_tp", "export_gpt2",
-           "export_llama", "hf_state_dict", "load_gpt2", "load_hf_model",
-           "load_llama", "load_opt", "state_dict_to_tree"]
+__all__ = ["AutoTP", "ReplaceWithTensorSlicing", "apply_tp", "export_bloom",
+           "export_gpt2", "export_llama", "hf_state_dict", "load_bloom",
+           "load_gpt2", "load_hf_model", "load_llama", "load_opt",
+           "state_dict_to_tree"]

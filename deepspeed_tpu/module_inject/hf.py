@@ -14,7 +14,8 @@ unchanged.
 
 Supported today: GPT-2 family (``GPT2LMHeadModel`` — the flagship), LLaMA
 (``LlamaForCausalLM``, incl. GQA / llama2 / llama3 shapes), and OPT
-(``OPTForCausalLM`` — the DeepSpeed-Chat RLHF family).
+(``OPTForCausalLM`` — the DeepSpeed-Chat RLHF family), and BLOOM
+(``BloomForCausalLM`` — ALiBi, the reference's flagship injected model).
 Everything else still gets ``state_dict_to_tree`` + AutoTP's name-pattern
 classification (reference auto_tp.py role) for TP placement of the raw tree.
 """
@@ -57,6 +58,41 @@ def state_dict_to_tree(sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return tree
 
 
+# ------------------------------------------------ shared loader plumbing
+def _compute_dtype(dtype):
+    import jax.numpy as jnp
+
+    return jnp.dtype(np.dtype(dtype))
+
+
+def _layer_count(sd: Dict[str, np.ndarray], prefix: str, stem: str) -> int:
+    """Number of contiguous '<prefix><stem>.<i>.' layers in the state dict."""
+    ids = sorted({int(m.group(1)) for k in sd
+                  for m in [re.match(rf"{re.escape(prefix)}{stem}\.(\d+)\.", k)] if m})
+    assert ids == list(range(len(ids))), f"non-contiguous layers {ids}"
+    return len(ids)
+
+
+def _stackers(g, n_layer: int, layer_tmpl: str):
+    """(stack_w, stack_b, stack_t): stack one per-layer tensor over a leading
+    layer dim — raw weight, bias, and transposed weight (torch ``nn.Linear``
+    stores (out, in); our matmuls use (in, out))."""
+    w = lambda name: np.stack(
+        [g(layer_tmpl.format(i=i) + name + ".weight") for i in range(n_layer)])
+    b = lambda name: np.stack(
+        [g(layer_tmpl.format(i=i) + name + ".bias") for i in range(n_layer)])
+    t = lambda name: np.stack(
+        [g(layer_tmpl.format(i=i) + name + ".weight").T for i in range(n_layer)])
+    return w, b, t
+
+
+def _detect_tied(sd: Dict[str, np.ndarray], embed_key: str) -> bool:
+    """HF ties lm_head to the token embedding when the head key is absent or
+    literally equal (safetensors materializes shared storage as a copy)."""
+    return ("lm_head.weight" not in sd
+            or np.array_equal(sd["lm_head.weight"], sd[embed_key]))
+
+
 # ------------------------------------------------------------------- GPT-2
 def load_gpt2(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
     """HF ``GPT2LMHeadModel`` (or its state dict) → (GPT2Config, params) for
@@ -73,11 +109,7 @@ def load_gpt2(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
     # accept both "transformer.h.0..." (LMHead model) and "h.0..." (bare)
     prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
     g = lambda name: sd[prefix + name].astype(dtype)
-
-    layer_ids = sorted({int(m.group(1)) for k in sd
-                        for m in [re.match(rf"{re.escape(prefix)}h\.(\d+)\.", k)] if m})
-    n_layer = len(layer_ids)
-    assert layer_ids == list(range(n_layer)), f"non-contiguous layers {layer_ids}"
+    n_layer = _layer_count(sd, prefix, "h")
 
     wte = g("wte.weight")
     wpe = g("wpe.weight")
@@ -85,42 +117,36 @@ def load_gpt2(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
     qkv0 = g("h.0.attn.c_attn.weight")
     assert qkv0.shape == (d, 3 * d), f"unexpected c_attn shape {qkv0.shape}"
 
-    stack = lambda name: np.stack([g(f"h.{i}.{name}") for i in range(n_layer)])
+    # HF Conv1D already stores (in, out): stack_w for everything, no transposes
+    stack_w, stack_b, _ = _stackers(g, n_layer, "h.{i}.")
     params = {
         "wte": wte,
         "wpe": wpe,
         "blocks": {
-            "ln1_g": stack("ln_1.weight"),
-            "ln1_b": stack("ln_1.bias"),
-            "qkv_w": stack("attn.c_attn.weight"),
-            "qkv_b": stack("attn.c_attn.bias"),
-            "proj_w": stack("attn.c_proj.weight"),
-            "proj_b": stack("attn.c_proj.bias"),
-            "ln2_g": stack("ln_2.weight"),
-            "ln2_b": stack("ln_2.bias"),
-            "fc_w": stack("mlp.c_fc.weight"),
-            "fc_b": stack("mlp.c_fc.bias"),
-            "fc2_w": stack("mlp.c_proj.weight"),
-            "fc2_b": stack("mlp.c_proj.bias"),
+            "ln1_g": stack_w("ln_1"),
+            "ln1_b": stack_b("ln_1"),
+            "qkv_w": stack_w("attn.c_attn"),
+            "qkv_b": stack_b("attn.c_attn"),
+            "proj_w": stack_w("attn.c_proj"),
+            "proj_b": stack_b("attn.c_proj"),
+            "ln2_g": stack_w("ln_2"),
+            "ln2_b": stack_b("ln_2"),
+            "fc_w": stack_w("mlp.c_fc"),
+            "fc_b": stack_b("mlp.c_fc"),
+            "fc2_w": stack_w("mlp.c_proj"),
+            "fc2_b": stack_b("mlp.c_proj"),
         },
         "lnf_g": g("ln_f.weight"),
         "lnf_b": g("ln_f.bias"),
     }
-    import jax.numpy as jnp
-
-    n_head = _infer_gpt2_heads(model_or_sd, d)
-    compute_dtype = jnp.dtype(np.dtype(dtype)) if np.dtype(dtype) != np.float32 \
-        else jnp.float32
-    mk_config = lambda tied: GPT2Config(
+    tied = _detect_tied(sd, prefix + "wte.weight")
+    if not tied:
+        # an untied lm_head.weight (V, d) becomes ours (d, V)
+        params["lm_head"] = sd["lm_head.weight"].astype(dtype).T
+    config = GPT2Config(
         vocab_size=vocab, n_positions=wpe.shape[0], n_embd=d, n_layer=n_layer,
-        n_head=n_head, tie_embeddings=tied, dtype=compute_dtype)
-    config = mk_config(True)
-    # HF ties lm_head to wte; an untied lm_head.weight (V, d) becomes ours (d, V)
-    if "lm_head.weight" in sd:
-        lm = sd["lm_head.weight"].astype(dtype)
-        if not np.array_equal(lm, wte):
-            params["lm_head"] = lm.T
-            config = mk_config(False)
+        n_head=_infer_gpt2_heads(model_or_sd, d), tie_embeddings=tied,
+        dtype=_compute_dtype(dtype))
     logger.info(f"load_gpt2: {n_layer} layers, d={d}, vocab={vocab}, "
                 f"heads={config.n_head}")
     return config, params
@@ -147,10 +173,11 @@ def export_gpt2(params: Dict[str, Any], prefix: str = "transformer.") -> Dict[st
     n_layer = int(np.asarray(blocks["ln1_g"]).shape[0])
     sd: Dict[str, np.ndarray] = {
         prefix + "wte.weight": np.asarray(params["wte"]),
-        prefix + "wpe.weight": np.asarray(params["wpe"]),
         prefix + "ln_f.weight": np.asarray(params["lnf_g"]),
         prefix + "ln_f.bias": np.asarray(params["lnf_b"]),
     }
+    if "wpe" in params:                 # absent for ALiBi (BLOOM-shaped) trees
+        sd[prefix + "wpe.weight"] = np.asarray(params["wpe"])
     names = [("ln_1.weight", "ln1_g"), ("ln_1.bias", "ln1_b"),
              ("attn.c_attn.weight", "qkv_w"), ("attn.c_attn.bias", "qkv_b"),
              ("attn.c_proj.weight", "proj_w"), ("attn.c_proj.bias", "proj_b"),
@@ -207,10 +234,7 @@ def load_llama(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]
     prefix = "model." if any(k.startswith("model.") for k in sd) else ""
     g = lambda name: sd[prefix + name].astype(dtype)
 
-    layer_ids = sorted({int(m.group(1)) for k in sd
-                        for m in [re.match(rf"{re.escape(prefix)}layers\.(\d+)\.", k)] if m})
-    n_layer = len(layer_ids)
-    assert layer_ids == list(range(n_layer)), f"non-contiguous layers {layer_ids}"
+    n_layer = _layer_count(sd, prefix, "layers")
 
     wte = g("embed_tokens.weight")
     vocab, d = wte.shape
@@ -220,10 +244,7 @@ def load_llama(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]
     head_dim = d // n_head
     assert kv_dim % head_dim == 0, f"kv_dim {kv_dim} vs head_dim {head_dim}"
 
-    stack_t = lambda name: np.stack(
-        [g(f"layers.{i}.{name}.weight").T for i in range(n_layer)])
-    stack = lambda name: np.stack(
-        [g(f"layers.{i}.{name}.weight") for i in range(n_layer)])
+    stack, _, stack_t = _stackers(g, n_layer, "layers.{i}.")
     params = {
         "wte": wte,
         "blocks": {
@@ -242,12 +263,9 @@ def load_llama(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]
     # HF ties lm_head to embed_tokens when config.tie_word_embeddings (the
     # llama3.2-1B/3B layout) — keep it tied so fine-tuning can't drift the
     # two copies apart (and vocab-size optimizer state isn't doubled)
-    tied = ("lm_head.weight" not in sd
-            or np.array_equal(sd["lm_head.weight"], sd[prefix + "embed_tokens.weight"]))
+    tied = _detect_tied(sd, prefix + "embed_tokens.weight")
     if not tied:
         params["lm_head"] = sd["lm_head.weight"].astype(dtype).T
-
-    import jax.numpy as jnp
 
     config = LlamaConfig(
         vocab_size=vocab, n_embd=d, n_layer=n_layer, n_head=n_head,
@@ -256,7 +274,7 @@ def load_llama(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]
         rope_theta=float(getattr(cfg, "rope_theta", 10000.0) or 10000.0),
         rope_scaling=rope_scaling, tie_embeddings=tied,
         rms_norm_eps=float(getattr(cfg, "rms_norm_eps", 1e-5) or 1e-5),
-        dtype=jnp.dtype(np.dtype(dtype)) if np.dtype(dtype) != np.float32 else jnp.float32)
+        dtype=_compute_dtype(dtype))
     logger.info(f"load_llama: {n_layer} layers, d={d}, vocab={vocab}, "
                 f"heads={n_head}, kv_heads={config.n_kv_head}, inter={inter}")
     return config, params
@@ -282,6 +300,127 @@ def export_llama(params: Dict[str, Any], prefix: str = "model.") -> Dict[str, np
         sd[f"{prefix}layers.{i}.post_attention_layernorm.weight"] = np.asarray(blocks["mlp_norm_g"][i])
         for hf_name, ours in transposed:
             sd[f"{prefix}layers.{i}.{hf_name}.weight"] = np.asarray(blocks[ours][i]).T
+    return sd
+
+
+# ------------------------------------------------------------------- BLOOM
+def load_bloom(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``BloomForCausalLM`` → (GPT2Config, params) for GPT2Model.
+
+    BLOOM (the reference's flagship injected inference model,
+    module_inject/containers/bloom.py) is a pre-LN decoder with two deltas
+    the runtime model covers via config switches: ALiBi position biases
+    (``alibi=True``, no wpe) and a layernorm after the token embedding
+    (``embed_layernorm=True``). The fused query_key_value weight is stored
+    HEAD-INTERLEAVED ([q_h0 k_h0 v_h0, q_h1 ...]) and is reordered here to
+    GPT-2's [all-q, all-k, all-v] layout.
+    """
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    cfg = getattr(model_or_sd, "config", None)
+    n_head = int(getattr(cfg, "n_head", 0) or getattr(cfg, "num_attention_heads", 0) or 0)
+    if not n_head:
+        raise ValueError("load_bloom needs the HF model (config carries the "
+                         "head count), not a bare state dict")
+
+    sd = hf_state_dict(model_or_sd)
+    prefix = next((p for p in ("transformer.", "")
+                   if p + "word_embeddings.weight" in sd), "")
+    g = lambda name: sd[prefix + name].astype(dtype)
+
+    n_layer = _layer_count(sd, prefix, "h")
+
+    wte = g("word_embeddings.weight")
+    vocab, d = wte.shape
+    dh = d // n_head
+
+    def qkv_w(i):
+        w = g(f"h.{i}.self_attention.query_key_value.weight").T  # (D, 3D)
+        return w.reshape(d, n_head, 3, dh).transpose(0, 2, 1, 3).reshape(d, 3 * d)
+
+    def qkv_b(i):
+        b = g(f"h.{i}.self_attention.query_key_value.bias")      # (3D,)
+        return b.reshape(n_head, 3, dh).transpose(1, 0, 2).reshape(3 * d)
+
+    stack_w, stack_b, stack_t = _stackers(g, n_layer, "h.{i}.")
+    params = {
+        "wte": wte,
+        "emb_ln_g": g("word_embeddings_layernorm.weight"),
+        "emb_ln_b": g("word_embeddings_layernorm.bias"),
+        "blocks": {
+            "ln1_g": stack_w("input_layernorm"),
+            "ln1_b": stack_b("input_layernorm"),
+            "qkv_w": np.stack([qkv_w(i) for i in range(n_layer)]),
+            "qkv_b": np.stack([qkv_b(i) for i in range(n_layer)]),
+            "proj_w": stack_t("self_attention.dense"),
+            "proj_b": stack_b("self_attention.dense"),
+            "ln2_g": stack_w("post_attention_layernorm"),
+            "ln2_b": stack_b("post_attention_layernorm"),
+            "fc_w": stack_t("mlp.dense_h_to_4h"),
+            "fc_b": stack_b("mlp.dense_h_to_4h"),
+            "fc2_w": stack_t("mlp.dense_4h_to_h"),
+            "fc2_b": stack_b("mlp.dense_4h_to_h"),
+        },
+        "lnf_g": g("ln_f.weight"),
+        "lnf_b": g("ln_f.bias"),
+    }
+    tied = _detect_tied(sd, prefix + "word_embeddings.weight")
+    if not tied:
+        params["lm_head"] = sd["lm_head.weight"].astype(dtype).T
+
+    config = GPT2Config(
+        vocab_size=vocab, n_positions=int(getattr(cfg, "seq_length", 0) or 2048),
+        n_embd=d, n_layer=n_layer, n_head=n_head, activation="gelu_new",
+        alibi=True, embed_layernorm=True, tie_embeddings=tied,
+        dtype=_compute_dtype(dtype))
+    logger.info(f"load_bloom: {n_layer} layers, d={d}, vocab={vocab}, "
+                f"heads={n_head} (ALiBi), tied={tied}")
+    return config, params
+
+
+def export_bloom(params: Dict[str, Any], n_head: int,
+                 prefix: str = "transformer.") -> Dict[str, np.ndarray]:
+    """Inverse of ``load_bloom``: TPU param tree → HF BLOOM state dict.
+
+    ``n_head`` is required — the fused qkv must be reordered back to BLOOM's
+    head-interleaved layout, and the head count is not recoverable from the
+    param tree alone.
+    """
+    blocks = params["blocks"]
+    n_layer = int(np.asarray(blocks["ln1_g"]).shape[0])
+    d = int(np.asarray(blocks["ln1_g"]).shape[1])
+    dh = d // n_head
+    sd: Dict[str, np.ndarray] = {
+        prefix + "word_embeddings.weight": np.asarray(params["wte"]),
+        prefix + "word_embeddings_layernorm.weight": np.asarray(params["emb_ln_g"]),
+        prefix + "word_embeddings_layernorm.bias": np.asarray(params["emb_ln_b"]),
+        prefix + "ln_f.weight": np.asarray(params["lnf_g"]),
+        prefix + "ln_f.bias": np.asarray(params["lnf_b"]),
+        "lm_head.weight": (np.asarray(params["lm_head"]).T
+                           if "lm_head" in params
+                           else np.asarray(params["wte"])),
+    }
+    transposed = [("self_attention.dense", "proj_w"),
+                  ("mlp.dense_h_to_4h", "fc_w"), ("mlp.dense_4h_to_h", "fc2_w")]
+    biases = [("self_attention.dense", "proj_b"),
+              ("mlp.dense_h_to_4h", "fc_b"), ("mlp.dense_4h_to_h", "fc2_b")]
+    lns = [("input_layernorm", "ln1_g", "ln1_b"),
+           ("post_attention_layernorm", "ln2_g", "ln2_b")]
+    for i in range(n_layer):
+        # [all-q, all-k, all-v] cols → BLOOM's per-head [q_h k_h v_h] rows
+        w = np.asarray(blocks["qkv_w"][i])                       # (D, 3D)
+        w = w.reshape(d, 3, n_head, dh).transpose(0, 2, 1, 3).reshape(d, 3 * d)
+        sd[f"{prefix}h.{i}.self_attention.query_key_value.weight"] = w.T
+        b = np.asarray(blocks["qkv_b"][i])
+        sd[f"{prefix}h.{i}.self_attention.query_key_value.bias"] = (
+            b.reshape(3, n_head, dh).transpose(1, 0, 2).reshape(3 * d))
+        for hf_name, ours in transposed:
+            sd[f"{prefix}h.{i}.{hf_name}.weight"] = np.asarray(blocks[ours][i]).T
+        for hf_name, ours in biases:
+            sd[f"{prefix}h.{i}.{hf_name}.bias"] = np.asarray(blocks[ours][i])
+        for hf_name, g_key, b_key in lns:
+            sd[f"{prefix}h.{i}.{hf_name}.weight"] = np.asarray(blocks[g_key][i])
+            sd[f"{prefix}h.{i}.{hf_name}.bias"] = np.asarray(blocks[b_key][i])
     return sd
 
 
@@ -325,10 +464,7 @@ def load_opt(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
                    if p + "embed_tokens.weight" in sd), "")
     g = lambda name: sd[prefix + name].astype(dtype)
 
-    layer_ids = sorted({int(m.group(1)) for k in sd
-                        for m in [re.match(rf"{re.escape(prefix)}layers\.(\d+)\.", k)] if m})
-    n_layer = len(layer_ids)
-    assert layer_ids == list(range(n_layer)), f"non-contiguous layers {layer_ids}"
+    n_layer = _layer_count(sd, prefix, "layers")
 
     wte = g("embed_tokens.weight")
     vocab, d = wte.shape
@@ -345,12 +481,7 @@ def load_opt(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
         return np.concatenate(
             [g(f"layers.{i}.self_attn.{p}_proj.bias") for p in ("q", "k", "v")])
 
-    stack_t = lambda name: np.stack(
-        [g(f"layers.{i}.{name}.weight").T for i in range(n_layer)])
-    stack_b = lambda name: np.stack(
-        [g(f"layers.{i}.{name}.bias") for i in range(n_layer)])
-    stack_w = lambda name: np.stack(
-        [g(f"layers.{i}.{name}.weight") for i in range(n_layer)])
+    stack_w, stack_b, stack_t = _stackers(g, n_layer, "layers.{i}.")
     params = {
         "wte": wte,
         "wpe": wpe,
@@ -371,17 +502,14 @@ def load_opt(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
         "lnf_g": g("final_layer_norm.weight"),
         "lnf_b": g("final_layer_norm.bias"),
     }
-    tied = ("lm_head.weight" not in sd
-            or np.array_equal(sd["lm_head.weight"], sd[prefix + "embed_tokens.weight"]))
+    tied = _detect_tied(sd, prefix + "embed_tokens.weight")
     if not tied:
         params["lm_head"] = sd["lm_head.weight"].astype(dtype).T
-
-    import jax.numpy as jnp
 
     config = GPT2Config(
         vocab_size=vocab, n_positions=wpe.shape[0], n_embd=d, n_layer=n_layer,
         n_head=n_head, activation=act, tie_embeddings=tied,
-        dtype=jnp.dtype(np.dtype(dtype)) if np.dtype(dtype) != np.float32 else jnp.float32)
+        dtype=_compute_dtype(dtype))
     logger.info(f"load_opt: {n_layer} layers, d={d}, vocab={vocab}, "
                 f"heads={n_head}, act={act}, tied={tied}")
     return config, params
@@ -402,7 +530,8 @@ def _llama_model(config):
 # architecture → (state-dict loader, model factory)
 _LOADERS = {"gpt2": (load_gpt2, _gpt2_model),
             "llama": (load_llama, _llama_model),
-            "opt": (load_opt, _gpt2_model)}
+            "opt": (load_opt, _gpt2_model),
+            "bloom": (load_bloom, _gpt2_model)}
 
 
 def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,

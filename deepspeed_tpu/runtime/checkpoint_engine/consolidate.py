@@ -83,34 +83,45 @@ def checkpoint_metadata(ckpt_dir: str, tag: Optional[str] = None) -> dict:
         return json.load(f)
 
 
-_EXPORTERS = {"gpt2": "export_gpt2", "llama": "export_llama"}
+_ARCHS = ("gpt2", "llama", "opt", "bloom")
 
 
 def consolidate_to_file(ckpt_dir: str, output: str, tag: Optional[str] = None,
-                        arch: Optional[str] = None) -> str:
-    """Consolidate and write to ``output``:
+                        arch: Optional[str] = None,
+                        n_head: Optional[int] = None) -> str:
+    """Consolidate and write to ``output`` (``.npz`` appended if missing):
 
-    * default: ``.npz`` with '/'-joined tree paths as keys;
-    * ``arch='gpt2'|'opt'|'llama'``: ``.npz`` in HF state-dict layout
-      (torch loads it via ``{k: torch.from_numpy(v) for k, v in np.load(f).items()}``).
+    * default: '/'-joined tree paths as keys;
+    * ``arch='gpt2'|'opt'|'llama'|'bloom'``: HF state-dict layout (torch loads
+      it via ``{k: torch.from_numpy(v) for k, v in np.load(f).items()}``).
+      ``bloom`` additionally needs ``n_head`` (the fused-qkv reorder is not
+      recoverable from the tree). Returns the path actually written.
     """
     params = consolidated_fp32_params(ckpt_dir, tag)
     if arch is not None:
         from deepspeed_tpu.module_inject import hf as hf_bridge
 
-        name = _EXPORTERS.get("gpt2" if arch == "opt" else arch)
-        if name is None:
-            raise ValueError(f"no exporter for arch {arch!r} "
-                             f"(have: {sorted(_EXPORTERS) + ['opt']})")
-        if arch == "opt":
-            logger.warning("arch='opt': emitting GPT-2-layout keys (the "
-                           "in-tree OPT runtime model is GPT-2-shaped); "
-                           "re-keying to OPT names is not implemented")
-        sd = getattr(hf_bridge, name)(params)
+        if arch not in _ARCHS:
+            raise ValueError(f"no exporter for arch {arch!r} (have: {_ARCHS})")
+        if arch == "bloom":
+            if n_head is None:
+                raise ValueError("arch='bloom' needs n_head for the "
+                                 "head-interleaved qkv reorder")
+            sd = hf_bridge.export_bloom(params, n_head=n_head)
+        elif arch == "llama":
+            sd = hf_bridge.export_llama(params)
+        else:
+            if arch == "opt":
+                logger.warning("arch='opt': emitting GPT-2-layout keys (the "
+                               "in-tree OPT runtime model is GPT-2-shaped); "
+                               "re-keying to OPT names is not implemented")
+            sd = hf_bridge.export_gpt2(params)
     else:
         from deepspeed_tpu.runtime.checkpoint_engine.engine import _flatten_state
 
         sd = _flatten_state(params)
+    if not output.endswith(".npz"):
+        output += ".npz"            # np.savez appends it silently anyway
     np.savez(output, **sd)
     logger.info(f"wrote {len(sd)} tensors to {output}")
     return output

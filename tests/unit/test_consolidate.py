@@ -86,10 +86,16 @@ def test_hf_export_layout(saved, tmp_path):
 
 
 def test_cli(saved, tmp_path):
+    import os
+
+    repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                             "..", ".."))
     path, _ = saved
     out = str(tmp_path / "flat.npz")
-    r = subprocess.run([sys.executable, "bin/ds_to_fp32", str(path), out],
-                       capture_output=True, text=True, cwd="/root/repo",
+    r = subprocess.run([sys.executable,
+                        os.path.join(repo_root, "bin", "ds_to_fp32"),
+                        str(path), out],
+                       capture_output=True, text=True, cwd=str(tmp_path),
                        timeout=300)
     assert r.returncode == 0, r.stderr[-2000:]
     sd = np.load(out)

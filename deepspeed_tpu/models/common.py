@@ -9,6 +9,7 @@ is never materialized — at V≈50k that is multiple GB per microbatch.
 from __future__ import annotations
 
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +36,52 @@ def alibi_slopes(n_head: int):
     return jnp.asarray(slopes, jnp.float32)
 
 
+def _scaled_inv_freq(inv_freq, scaling: Optional[dict]):
+    """Apply HF-style rope_scaling to the frequency vector."""
+    if not scaling:
+        return inv_freq
+    kind = scaling.get("rope_type", scaling.get("type", "default"))
+    if kind == "default":
+        return inv_freq
+    factor = float(scaling["factor"])
+    if kind == "linear":
+        return inv_freq / factor
+    # "llama3" (3.1+ context extension): low-frequency components divided by
+    # `factor`, high-frequency kept, smooth interpolation in between —
+    # matching transformers' _compute_llama3_parameters
+    low = float(scaling["low_freq_factor"])
+    high = float(scaling["high_freq_factor"])
+    old_len = float(scaling["original_max_position_embeddings"])
+    wavelen = 2.0 * math.pi / inv_freq
+    smooth = (old_len / wavelen - low) / (high - low)
+    smoothed = (1.0 - smooth) / factor * inv_freq + smooth * inv_freq
+    scaled = jnp.where(wavelen > old_len / low, inv_freq / factor, inv_freq)
+    is_medium = (wavelen >= old_len / high) & (wavelen <= old_len / low)
+    return jnp.where(is_medium, smoothed, scaled)
+
+
+def _rope_cos_sin(positions, head_dim: int, theta: float,
+                  scaling: Optional[dict] = None):
+    """cos/sin tables (T, Dh) for rotate-half RoPE (HF convention: the
+    frequency vector is duplicated, not interleaved)."""
+    d2 = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(d2, dtype=jnp.float32) / d2))
+    inv_freq = _scaled_inv_freq(inv_freq, scaling)
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]   # (T, d2)
+    cos = jnp.concatenate([jnp.cos(ang)] * 2, axis=-1)
+    sin = jnp.concatenate([jnp.sin(ang)] * 2, axis=-1)
+    return cos, sin
+
+
+def apply_rope(x, cos, sin):
+    """x: (B, T, H, Dh); cos/sin: (T, Dh). Rotate-half convention."""
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    out = x32 * cos[None, :, None, :] + rotated * sin[None, :, None, :]
+    return out.astype(x.dtype)
+
+
 def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None):
     """Causal self-attention on local (unsharded-sequence) q, k, v with equal
     head counts (B, T, H, Dh): Pallas flash kernel when available, XLA einsum
@@ -46,7 +93,9 @@ def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None):
     exactly HF BLOOM's ``build_alibi_tensor`` under a full attention mask.
     Biased attention takes the einsum path (the flash kernel carries no bias).
     """
-    if use_flash and alibi is None:
+    # the backend gate matters: off-TPU the Mosaic kernel fails at LOWERING
+    # time (inside jit compilation), where no try/except here could catch it
+    if use_flash and alibi is None and jax.default_backend() == "tpu":
         try:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 

@@ -58,6 +58,12 @@ class GPT2Config:
     # extra layernorm follows the token embedding
     alibi: bool = False
     embed_layernorm: bool = False
+    # GPT-NeoX/Pythia-style variant switches: rotary embeddings on the first
+    # rotary_pct of each head (no wpe; rotate-half convention) and the
+    # parallel-residual block x + attn(ln1(x)) + mlp(ln2(x))
+    rotary_pct: float = 0.0          # 0 = learned positions
+    rotary_theta: float = 10000.0
+    parallel_residual: bool = False
     # sequence parallelism over the 'seq' mesh axis: False | 'ring' | 'ulysses'
     # (parallel/sequence.py — long-context support beyond the reference)
     sequence_parallel: Any = False
@@ -70,6 +76,11 @@ class GPT2Config:
         if self.activation not in ("gelu", "gelu_new", "relu"):
             raise ValueError(f"activation {self.activation!r} not in "
                              "('gelu', 'gelu_new', 'relu')")
+        if not 0.0 <= self.rotary_pct <= 1.0:
+            raise ValueError(f"rotary_pct {self.rotary_pct} not in [0, 1]")
+        if self.alibi and self.rotary_pct:
+            raise ValueError("alibi and rotary_pct are mutually exclusive "
+                             "position mechanisms")
 
     @property
     def head_dim(self) -> int:
@@ -138,7 +149,7 @@ class GPT2Model:
             "lnf_g": jnp.ones((d,), jnp.float32),
             "lnf_b": jnp.zeros((d,), jnp.float32),
         }
-        if not c.alibi:
+        if not c.alibi and not c.rotary_pct:
             params["wpe"] = jax.random.normal(keys[1], (c.n_positions, d), jnp.float32) * 0.01
         if c.embed_layernorm:
             params["emb_ln_g"] = jnp.ones((d,), jnp.float32)
@@ -167,7 +178,7 @@ class GPT2Model:
             },
             "lnf_g": P(None), "lnf_b": P(None),
         }
-        if not c.alibi:
+        if not c.alibi and not c.rotary_pct:
             specs["wpe"] = P(None, None)
         if c.embed_layernorm:
             specs["emb_ln_g"] = P(None)
@@ -214,7 +225,7 @@ class GPT2Model:
         c = self.config
         T = input_ids.shape[1]
         x = params["wte"].astype(c.dtype)[input_ids]
-        if not c.alibi:
+        if not c.alibi and not c.rotary_pct:
             x = x + params["wpe"].astype(c.dtype)[:T]
         if c.embed_layernorm:
             x = self._layer_norm(x, params["emb_ln_g"], params["emb_ln_b"])
@@ -227,8 +238,8 @@ class GPT2Model:
         keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
         return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
 
-    def _block(self, x, blk, rng):
-        q, k, v = self._block_kv(x, blk)
+    def _block(self, x, blk, rng, rope=None):
+        q, k, v = self._block_kv(x, blk, rope)
         attn = self._attention(q, k, v)
         # named so remat='attn' can save exactly this tensor (the only one
         # whose recompute re-runs the flash kernel) while rematerializing
@@ -267,10 +278,11 @@ class GPT2Model:
                 policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
 
         layer_rngs = jax.random.split(rng, c.n_layer) if (rng is not None and c.dropout > 0.0) else None
+        rope = self._rope_tables(jnp.arange(T))
 
         def scan_body(carry, xs):
             blk, lrng = xs
-            x = block_fn(carry, blk, lrng)
+            x = block_fn(carry, blk, lrng, rope)
             return x, None
 
         x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
@@ -314,7 +326,33 @@ class GPT2Model:
                 "v": P(None, None, None, "tensor", None),
                 "pos": P()}
 
-    def _block_kv(self, x, blk):
+    def _rope_tables(self, positions):
+        """cos/sin for the rotary fraction of each head, or None."""
+        c = self.config
+        if not c.rotary_pct:
+            return None
+        from deepspeed_tpu.models.common import _rope_cos_sin
+
+        rot = int(c.head_dim * c.rotary_pct)
+        rot -= rot % 2
+        return _rope_cos_sin(positions, rot, c.rotary_theta)
+
+    @staticmethod
+    def _apply_partial_rope(q, k, rope):
+        """NeoX-style partial rotary: rotate the first rotary_pct of each
+        head's dims (rotate-half convention), pass the rest through."""
+        if rope is None:
+            return q, k
+        from deepspeed_tpu.models.common import apply_rope
+
+        cos, sin = rope
+        rot = cos.shape[-1]
+        qr = apply_rope(q[..., :rot], cos, sin)
+        kr = apply_rope(k[..., :rot], cos, sin)
+        return (jnp.concatenate([qr, q[..., rot:]], axis=-1),
+                jnp.concatenate([kr, k[..., rot:]], axis=-1))
+
+    def _block_kv(self, x, blk, rope=None):
         """One block's q,k,v for the current x (no attention yet)."""
         c = self.config
         B, T, D = x.shape
@@ -322,21 +360,30 @@ class GPT2Model:
         qkv = h @ blk["qkv_w"].astype(h.dtype) + blk["qkv_b"].astype(h.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         to_heads = lambda t: t.reshape(B, T, c.n_head, c.head_dim)
-        return to_heads(q), to_heads(k), to_heads(v)
+        q, k = self._apply_partial_rope(to_heads(q), to_heads(k), rope)
+        return q, k, to_heads(v)
 
-    def _block_finish(self, x, blk, attn, rng=None):
-        B, T, D = x.shape
-        dk = (lambda i: jax.random.fold_in(rng, i)) if rng is not None else (lambda i: None)
-        a = attn.reshape(B, T, D) @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype)
-        x = x + self._dropout(a, dk(0))
-        h = self._layer_norm(x, blk["ln2_g"], blk["ln2_b"])
-        h = h @ blk["fc_w"].astype(h.dtype) + blk["fc_b"].astype(h.dtype)
+    def _mlp(self, h_in, blk):
+        h = h_in @ blk["fc_w"].astype(h_in.dtype) + blk["fc_b"].astype(h_in.dtype)
         act = self.config.activation
         if act == "relu":
             h = jax.nn.relu(h)
         else:
             h = jax.nn.gelu(h, approximate=(act == "gelu_new"))
-        return x + self._dropout(h @ blk["fc2_w"].astype(x.dtype) + blk["fc2_b"].astype(x.dtype), dk(1))
+        return h @ blk["fc2_w"].astype(h.dtype) + blk["fc2_b"].astype(h.dtype)
+
+    def _block_finish(self, x, blk, attn, rng=None):
+        B, T, D = x.shape
+        dk = (lambda i: jax.random.fold_in(rng, i)) if rng is not None else (lambda i: None)
+        a = attn.reshape(B, T, D) @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype)
+        if self.config.parallel_residual:
+            # NeoX: x + attn(ln1(x)) + mlp(ln2(x)) — both branches read the
+            # block input, so the MLP does not wait on the attention residual
+            h = self._layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+            return x + self._dropout(a, dk(0)) + self._dropout(self._mlp(h, blk), dk(1))
+        x = x + self._dropout(a, dk(0))
+        h = self._layer_norm(x, blk["ln2_g"], blk["ln2_b"])
+        return x + self._dropout(self._mlp(h, blk), dk(1))
 
     def prefill(self, params, input_ids, cache):
         """Process the prompt, fill the cache, return last-position logits."""
@@ -344,10 +391,11 @@ class GPT2Model:
         B, T = input_ids.shape
         max_len = cache["k"].shape[2]
         x = self._embed(params, input_ids)
+        rope = self._rope_tables(jnp.arange(T))
 
         def body(carry, blk):
             x = carry
-            q, k, v = self._block_kv(x, blk)
+            q, k, v = self._block_kv(x, blk, rope)
             attn = self._attention_local(q, k, v)
             x = self._block_finish(x, blk, attn)
             k_pad = jnp.zeros((B, max_len, c.n_head, c.head_dim), c.dtype)
@@ -371,7 +419,7 @@ class GPT2Model:
         B = token.shape[0]
         pos = cache["pos"]
         x = params["wte"].astype(c.dtype)[token][:, None]  # (B, 1, D)
-        if not c.alibi:
+        if not c.alibi and not c.rotary_pct:
             x = x + jax.lax.dynamic_slice_in_dim(
                 params["wpe"].astype(c.dtype), pos, 1, 0)[None]
         if c.embed_layernorm:
@@ -379,10 +427,12 @@ class GPT2Model:
 
         from deepspeed_tpu.models.common import cached_decode_attention
 
+        rope = self._rope_tables(pos[None])
+
         def body(carry, xs):
             x = carry
             blk, k_cache, v_cache = xs
-            q, k, v = self._block_kv(x, blk)           # (B, 1, H, Dh)
+            q, k, v = self._block_kv(x, blk, rope)     # (B, 1, H, Dh)
             k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
             attn = cached_decode_attention(q[:, 0], k_cache, v_cache, pos,

@@ -58,6 +58,7 @@ class MoEGPT2(GPT2Model):
         c = self.config
         B, T = ids.shape
         x = self._embed(params, ids)
+        rope = self._rope_tables(jnp.arange(T))
 
         # interleave dense blocks and MoE MLP blocks without python-loop
         # unrolling of the dense part: scan pairs of (dense block, moe layer)
@@ -69,10 +70,10 @@ class MoEGPT2(GPT2Model):
             pair_blocks, moe_p = xs
             # dense block 0 of the pair
             b0 = jax.tree.map(lambda t: t[0], pair_blocks)
-            x = self._block(x, b0, None)
+            x = self._block(x, b0, None, rope)
             # block 1: attention part of the dense block, MoE as its MLP
             b1 = jax.tree.map(lambda t: t[1], pair_blocks)
-            x = self._attn_sublayer(x, b1)
+            x = self._attn_sublayer(x, b1, rope)
             h = self._layer_norm(x, b1["ln2_g"], b1["ln2_b"])
             moe_out, l_aux = self.moe(moe_p, h, rng, train=True)
             x = x + moe_out
@@ -91,12 +92,8 @@ class MoEGPT2(GPT2Model):
         ce = jnp.mean(lse - tgt)
         return ce + self.aux_loss_coef * aux / n_pairs
 
-    def _attn_sublayer(self, x, blk):
-        c = self.config
+    def _attn_sublayer(self, x, blk, rope=None):
         B, T, D = x.shape
-        h = self._layer_norm(x, blk["ln1_g"], blk["ln1_b"])
-        qkv = h @ blk["qkv_w"].astype(h.dtype) + blk["qkv_b"].astype(h.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        to_heads = lambda t: t.reshape(B, T, c.n_head, c.head_dim)
-        attn = self._attention(to_heads(q), to_heads(k), to_heads(v)).reshape(B, T, D)
+        q, k, v = self._block_kv(x, blk, rope)
+        attn = self._attention(q, k, v).reshape(B, T, D)
         return x + attn @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype)

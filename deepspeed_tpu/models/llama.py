@@ -33,6 +33,8 @@ import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.models.common import _rope_cos_sin, apply_rope
+
 
 @dataclasses.dataclass
 class LlamaConfig:
@@ -126,52 +128,6 @@ PRESETS = {
                                              "high_freq_factor": 4.0,
                                              "original_max_position_embeddings": 8192}),
 }
-
-
-def _scaled_inv_freq(inv_freq, scaling: Optional[dict]):
-    """Apply HF-style rope_scaling to the frequency vector."""
-    if not scaling:
-        return inv_freq
-    kind = scaling.get("rope_type", scaling.get("type", "default"))
-    if kind == "default":
-        return inv_freq
-    factor = float(scaling["factor"])
-    if kind == "linear":
-        return inv_freq / factor
-    # "llama3" (3.1+ context extension): low-frequency components divided by
-    # `factor`, high-frequency kept, smooth interpolation in between —
-    # matching transformers' _compute_llama3_parameters
-    low = float(scaling["low_freq_factor"])
-    high = float(scaling["high_freq_factor"])
-    old_len = float(scaling["original_max_position_embeddings"])
-    wavelen = 2.0 * math.pi / inv_freq
-    smooth = (old_len / wavelen - low) / (high - low)
-    smoothed = (1.0 - smooth) / factor * inv_freq + smooth * inv_freq
-    scaled = jnp.where(wavelen > old_len / low, inv_freq / factor, inv_freq)
-    is_medium = (wavelen >= old_len / high) & (wavelen <= old_len / low)
-    return jnp.where(is_medium, smoothed, scaled)
-
-
-def _rope_cos_sin(positions, head_dim: int, theta: float,
-                  scaling: Optional[dict] = None):
-    """cos/sin tables (T, Dh) for rotate-half RoPE (HF convention: the
-    frequency vector is duplicated, not interleaved)."""
-    d2 = head_dim // 2
-    inv_freq = 1.0 / (theta ** (jnp.arange(d2, dtype=jnp.float32) / d2))
-    inv_freq = _scaled_inv_freq(inv_freq, scaling)
-    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]   # (T, d2)
-    cos = jnp.concatenate([jnp.cos(ang)] * 2, axis=-1)
-    sin = jnp.concatenate([jnp.sin(ang)] * 2, axis=-1)
-    return cos, sin
-
-
-def apply_rope(x, cos, sin):
-    """x: (B, T, H, Dh); cos/sin: (T, Dh). Rotate-half convention."""
-    x32 = x.astype(jnp.float32)
-    x1, x2 = jnp.split(x32, 2, axis=-1)
-    rotated = jnp.concatenate([-x2, x1], axis=-1)
-    out = x32 * cos[None, :, None, :] + rotated * sin[None, :, None, :]
-    return out.astype(x.dtype)
 
 
 class LlamaModel:

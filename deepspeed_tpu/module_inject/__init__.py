@@ -2,10 +2,11 @@ from deepspeed_tpu.module_inject.auto_tp import AutoTP, ReplaceWithTensorSlicing
 from deepspeed_tpu.module_inject.hf import (export_bloom, export_gpt2,
                                             export_llama, hf_state_dict,
                                             load_bloom, load_gpt2,
-                                            load_hf_model, load_llama,
-                                            load_opt, state_dict_to_tree)
+                                            load_gptneox, load_hf_model,
+                                            load_llama, load_opt,
+                                            state_dict_to_tree)
 
 __all__ = ["AutoTP", "ReplaceWithTensorSlicing", "apply_tp", "export_bloom",
            "export_gpt2", "export_llama", "hf_state_dict", "load_bloom",
-           "load_gpt2", "load_hf_model", "load_llama", "load_opt",
-           "state_dict_to_tree"]
+           "load_gpt2", "load_gptneox", "load_hf_model", "load_llama",
+           "load_opt", "state_dict_to_tree"]

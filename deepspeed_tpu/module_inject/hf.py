@@ -14,8 +14,9 @@ unchanged.
 
 Supported today: GPT-2 family (``GPT2LMHeadModel`` — the flagship), LLaMA
 (``LlamaForCausalLM``, incl. GQA / llama2 / llama3 shapes), and OPT
-(``OPTForCausalLM`` — the DeepSpeed-Chat RLHF family), and BLOOM
-(``BloomForCausalLM`` — ALiBi, the reference's flagship injected model).
+(``OPTForCausalLM`` — the DeepSpeed-Chat RLHF family), BLOOM
+(``BloomForCausalLM`` — ALiBi, the reference's flagship injected model), and
+GPT-NeoX/Pythia (``GPTNeoXForCausalLM`` — partial rotary, parallel residual).
 Everything else still gets ``state_dict_to_tree`` + AutoTP's name-pattern
 classification (reference auto_tp.py role) for TP placement of the raw tree.
 """
@@ -84,6 +85,17 @@ def _stackers(g, n_layer: int, layer_tmpl: str):
     t = lambda name: np.stack(
         [g(layer_tmpl.format(i=i) + name + ".weight").T for i in range(n_layer)])
     return w, b, t
+
+
+def _deinterleave_qkv(w: np.ndarray, b: np.ndarray, n_head: int):
+    """BLOOM/NeoX fused query_key_value layout ([q_h k_h v_h per head] rows)
+    → GPT-2's [all-q, all-k, all-v]: weight (3D, D) torch-layout in, returns
+    (D, 3D) ours + reordered bias (3D,)."""
+    d3, d = w.shape
+    dh = d // n_head
+    wt = w.T.reshape(d, n_head, 3, dh).transpose(0, 2, 1, 3).reshape(d, d3)
+    bt = b.reshape(n_head, 3, dh).transpose(1, 0, 2).reshape(d3)
+    return wt, bt
 
 
 def _detect_tied(sd: Dict[str, np.ndarray], embed_key: str) -> bool:
@@ -332,15 +344,11 @@ def load_bloom(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]
 
     wte = g("word_embeddings.weight")
     vocab, d = wte.shape
-    dh = d // n_head
 
-    def qkv_w(i):
-        w = g(f"h.{i}.self_attention.query_key_value.weight").T  # (D, 3D)
-        return w.reshape(d, n_head, 3, dh).transpose(0, 2, 1, 3).reshape(d, 3 * d)
-
-    def qkv_b(i):
-        b = g(f"h.{i}.self_attention.query_key_value.bias")      # (3D,)
-        return b.reshape(n_head, 3, dh).transpose(1, 0, 2).reshape(3 * d)
+    qkv_pairs = [_deinterleave_qkv(
+        g(f"h.{i}.self_attention.query_key_value.weight"),
+        g(f"h.{i}.self_attention.query_key_value.bias"), n_head)
+        for i in range(n_layer)]
 
     stack_w, stack_b, stack_t = _stackers(g, n_layer, "h.{i}.")
     params = {
@@ -350,8 +358,8 @@ def load_bloom(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]
         "blocks": {
             "ln1_g": stack_w("input_layernorm"),
             "ln1_b": stack_b("input_layernorm"),
-            "qkv_w": np.stack([qkv_w(i) for i in range(n_layer)]),
-            "qkv_b": np.stack([qkv_b(i) for i in range(n_layer)]),
+            "qkv_w": np.stack([w for w, _ in qkv_pairs]),
+            "qkv_b": np.stack([b for _, b in qkv_pairs]),
             "proj_w": stack_t("self_attention.dense"),
             "proj_b": stack_b("self_attention.dense"),
             "ln2_g": stack_w("post_attention_layernorm"),
@@ -422,6 +430,84 @@ def export_bloom(params: Dict[str, Any], n_head: int,
             sd[f"{prefix}h.{i}.{hf_name}.weight"] = np.asarray(blocks[g_key][i])
             sd[f"{prefix}h.{i}.{hf_name}.bias"] = np.asarray(blocks[b_key][i])
     return sd
+
+
+
+# ---------------------------------------------------------------- GPT-NeoX
+def load_gptneox(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``GPTNeoXForCausalLM`` (NeoX-20B, the Pythia ladder) → (GPT2Config,
+    params) for GPT2Model.
+
+    NeoX is GPT-2-shaped plus two switches the runtime model carries:
+    partial rotary embeddings (``rotary_pct`` of each head, rotate-half) and
+    the parallel-residual block x + attn(ln1(x)) + mlp(ln2(x)). The fused
+    query_key_value is head-interleaved like BLOOM's and reordered the same
+    way; the head is the untied ``embed_out``. Reference counterpart:
+    module_inject/containers/gptneox.py.
+    """
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    cfg = getattr(model_or_sd, "config", None)
+    n_head = int(getattr(cfg, "num_attention_heads", 0) or 0)
+    if not n_head:
+        raise ValueError("load_gptneox needs the HF model (config carries "
+                         "num_attention_heads), not a bare state dict")
+    act = getattr(cfg, "hidden_act", "gelu") or "gelu"
+    if act not in ("relu", "gelu", "gelu_new"):
+        raise NotImplementedError(f"GPT-NeoX hidden_act {act!r} not supported")
+
+    sd = hf_state_dict(model_or_sd)
+    prefix = next((p for p in ("gpt_neox.", "")
+                   if p + "embed_in.weight" in sd), "")
+    g = lambda name: sd[prefix + name].astype(dtype)
+    n_layer = _layer_count(sd, prefix, "layers")
+
+    wte = g("embed_in.weight")
+    vocab, d = wte.shape
+
+    qkv_pairs = [_deinterleave_qkv(
+        g(f"layers.{i}.attention.query_key_value.weight"),
+        g(f"layers.{i}.attention.query_key_value.bias"), n_head)
+        for i in range(n_layer)]
+
+    stack_w, stack_b, stack_t = _stackers(g, n_layer, "layers.{i}.")
+    params = {
+        "wte": wte,
+        "blocks": {
+            "ln1_g": stack_w("input_layernorm"),
+            "ln1_b": stack_b("input_layernorm"),
+            "qkv_w": np.stack([w for w, _ in qkv_pairs]),
+            "qkv_b": np.stack([b for _, b in qkv_pairs]),
+            "proj_w": stack_t("attention.dense"),
+            "proj_b": stack_b("attention.dense"),
+            "ln2_g": stack_w("post_attention_layernorm"),
+            "ln2_b": stack_b("post_attention_layernorm"),
+            "fc_w": stack_t("mlp.dense_h_to_4h"),
+            "fc_b": stack_b("mlp.dense_h_to_4h"),
+            "fc2_w": stack_t("mlp.dense_4h_to_h"),
+            "fc2_b": stack_b("mlp.dense_4h_to_h"),
+        },
+        "lnf_g": g("final_layer_norm.weight"),
+        "lnf_b": g("final_layer_norm.bias"),
+    }
+    # NeoX's head is its own matrix ("embed_out"), untied by construction
+    tied = ("embed_out.weight" not in sd
+            or np.array_equal(sd["embed_out.weight"], sd[prefix + "embed_in.weight"]))
+    if not tied:
+        params["lm_head"] = sd["embed_out.weight"].astype(dtype).T
+
+    config = GPT2Config(
+        vocab_size=vocab,
+        n_positions=int(getattr(cfg, "max_position_embeddings", 2048) or 2048),
+        n_embd=d, n_layer=n_layer, n_head=n_head, activation=act,
+        rotary_pct=float(getattr(cfg, "rotary_pct", 0.25) or 0.25),
+        rotary_theta=float(getattr(cfg, "rotary_emb_base", 10000.0) or 10000.0),
+        parallel_residual=bool(getattr(cfg, "use_parallel_residual", True)),
+        tie_embeddings=tied, dtype=_compute_dtype(dtype))
+    logger.info(f"load_gptneox: {n_layer} layers, d={d}, vocab={vocab}, "
+                f"heads={n_head}, rotary_pct={config.rotary_pct}, "
+                f"parallel_residual={config.parallel_residual}")
+    return config, params
 
 
 # --------------------------------------------------------------------- OPT
@@ -531,7 +617,8 @@ def _llama_model(config):
 _LOADERS = {"gpt2": (load_gpt2, _gpt2_model),
             "llama": (load_llama, _llama_model),
             "opt": (load_opt, _gpt2_model),
-            "bloom": (load_bloom, _gpt2_model)}
+            "bloom": (load_bloom, _gpt2_model),
+            "gpt_neox": (load_gptneox, _gpt2_model)}
 
 
 def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,

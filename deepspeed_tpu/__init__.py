@@ -48,6 +48,25 @@ def initialize(args=None,
     # parse/validate ONCE; the engine receives the built config_class
     ds_config = DeepSpeedConfig(config if config is not None else {})
 
+    # ds_config "sparse_attention" block → model config (the reference
+    # applies it by patching the model's attention modules,
+    # sparse_attention_utils.py; here the model's attention dispatch reads it
+    # from its dataclass config)
+    if ds_config.sparse_attention and model is not None:
+        mcfg = getattr(model, "config", None)
+        if hasattr(mcfg, "sparse_attention"):
+            if getattr(mcfg, "sparse_attention") is None:
+                import dataclasses as _dc
+
+                model.config = _dc.replace(
+                    mcfg, sparse_attention=dict(ds_config.sparse_attention))
+                log_dist(f"sparse attention enabled: "
+                         f"{ds_config.sparse_attention}", ranks=[0])
+        else:
+            log_dist("ds_config sparse_attention set but the model does not "
+                     "support it (no config.sparse_attention field); ignored",
+                     ranks=[0])
+
     # RLHF actors get the hybrid train<->generate engine (reference
     # __init__.py:58 DeepSpeedHybridEngine branch on hybrid_engine.enabled)
     engine_cls = DeepSpeedEngine

@@ -64,6 +64,11 @@ class GPT2Config:
     rotary_pct: float = 0.0          # 0 = learned positions
     rotary_theta: float = 10000.0
     parallel_residual: bool = False
+    # block-sparse attention (reference ds_config "sparse_attention" block /
+    # ops/sparse_attention): {"mode": "fixed"|"variable"|"bigbird"|
+    # "bslongformer"|"dense", "block": int, ...} — kwargs of the matching
+    # SparsityConfig. Overrides flash/einsum attention when set.
+    sparse_attention: Optional[dict] = None
     # sequence parallelism over the 'seq' mesh axis: False | 'ring' | 'ulysses'
     # (parallel/sequence.py — long-context support beyond the reference)
     sequence_parallel: Any = False
@@ -81,6 +86,18 @@ class GPT2Config:
         if self.alibi and self.rotary_pct:
             raise ValueError("alibi and rotary_pct are mutually exclusive "
                              "position mechanisms")
+        if self.sparse_attention is not None:
+            mode = dict(self.sparse_attention).get("mode", "fixed")
+            if mode not in ("dense", "fixed", "variable", "bigbird",
+                            "bslongformer"):
+                raise ValueError(f"sparse_attention mode {mode!r} unknown")
+            if self.sequence_parallel:
+                raise NotImplementedError(
+                    "sparse_attention does not compose with ring/Ulysses "
+                    "sequence parallelism")
+            if self.alibi:
+                raise NotImplementedError(
+                    "sparse_attention does not carry ALiBi biases")
 
     @property
     def head_dim(self) -> int:
@@ -123,6 +140,32 @@ class GPT2Model:
 
     def __init__(self, config: GPT2Config):
         self.config = config
+        self._sparse = None
+
+    def _sparse_attention(self, q, k, v):
+        """Config-driven block-sparse attention (reference SparseSelfAttention
+        applied via the ds_config "sparse_attention" block). Off-TPU the
+        Pallas kernel cannot lower — the dense token-level expansion of the
+        layout stands in (exact, just not sparse-fast)."""
+        if self._sparse is None:
+            from deepspeed_tpu.ops import sparse_attention as sa
+
+            d = dict(self.config.sparse_attention)
+            mode = d.pop("mode", "fixed")
+            cls = {"dense": sa.DenseSparsityConfig,
+                   "fixed": sa.FixedSparsityConfig,
+                   "variable": sa.VariableSparsityConfig,
+                   "bigbird": sa.BigBirdSparsityConfig,
+                   "bslongformer": sa.BSLongformerSparsityConfig}[mode]
+            self._sparse = sa.SparseSelfAttention(
+                cls(num_heads=self.config.n_head, **d))
+        if jax.default_backend() != "tpu":
+            from deepspeed_tpu.ops.pallas.flash_attention import sparse_mha_reference
+
+            return sparse_mha_reference(q, k, v,
+                                        self._sparse.get_layout(q.shape[1]),
+                                        causal=True)
+        return self._sparse(q, k, v, causal=True)
 
     # ---------------------------------------------------------------- params
     def init_params(self, rng) -> Dict[str, Any]:
@@ -204,11 +247,14 @@ class GPT2Model:
         return alibi_slopes(self.config.n_head)
 
     def _attention(self, q, k, v):
-        """q,k,v: (B, T, H, Dh). Causal self-attention (models/common.py
-        dispatch: sequence-parallel → flash → einsum)."""
+        """q,k,v: (B, T, H, Dh). Causal self-attention (block-sparse when
+        configured, else the models/common.py dispatch: sequence-parallel →
+        flash → einsum)."""
         from deepspeed_tpu.models.common import causal_attention
 
         c = self.config
+        if c.sparse_attention is not None:
+            return self._sparse_attention(q, k, v)
         return causal_attention(q, k, v, use_flash=c.use_flash_attention,
                                 sequence_parallel=c.sequence_parallel,
                                 alibi=self._alibi())
@@ -317,6 +363,14 @@ class GPT2Model:
         The TPU counterpart of the reference's InferenceContext KV workspace
         (csrc/transformer/inference/includes/inference_context.h:287)."""
         c = self.config
+        if c.sparse_attention is not None:
+            # prefill/decode attend densely over the cache; serving a
+            # sparse-trained model that way would silently mismatch the
+            # trained attention distribution
+            raise NotImplementedError(
+                "KV-cache generation does not apply sparse_attention "
+                "layouts; serve with sparse_attention=None only if the "
+                "model was also trained dense")
         shape = (c.n_layer, batch_size, max_len, c.n_head, c.head_dim)
         return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
                 "pos": jnp.zeros((), jnp.int32)}

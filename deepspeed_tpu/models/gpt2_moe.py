@@ -25,6 +25,11 @@ class MoEGPT2(GPT2Model):
     def __init__(self, config: GPT2Config, num_experts: int = 8, ep_size: int = 1,
                  k: int = 1, capacity_factor: float = 1.25, aux_loss_coef: float = 0.01):
         super().__init__(config)
+        if config.parallel_residual:
+            # the MoE half-block is attn-then-MoE sequential; the inherited
+            # dense block would go parallel — a half-applied architecture
+            raise NotImplementedError(
+                "MoEGPT2 does not implement parallel_residual")
         self.moe = MoE(hidden_size=config.n_embd, num_experts=num_experts,
                        ep_size=ep_size, k=k, capacity_factor=capacity_factor)
         self.aux_loss_coef = aux_loss_coef

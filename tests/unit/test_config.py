@@ -61,3 +61,20 @@ def test_scheduler_optimizer_sections():
     assert cfg.optimizer_name == "adam"
     assert cfg.scheduler_name == "WarmupLR"
     assert cfg.gradient_clipping == 1.0
+
+
+def test_config_doc_in_sync(tmp_path):
+    """docs/CONFIG.md is generated from the live pydantic models
+    (bin/ds_config_doc); this keeps the committed copy from drifting."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+    out = str(tmp_path / "CONFIG.md")
+    r = subprocess.run([sys.executable, os.path.join(repo, "bin", "ds_config_doc"),
+                        out], capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(out) as f, open(os.path.join(repo, "docs", "CONFIG.md")) as g:
+        assert f.read() == g.read(), \
+            "docs/CONFIG.md is stale: run `python bin/ds_config_doc`"

@@ -61,23 +61,33 @@ def _scaled_inv_freq(inv_freq, scaling: Optional[dict]):
 
 
 def _rope_cos_sin(positions, head_dim: int, theta: float,
-                  scaling: Optional[dict] = None):
-    """cos/sin tables (T, Dh) for rotate-half RoPE (HF convention: the
-    frequency vector is duplicated, not interleaved)."""
+                  scaling: Optional[dict] = None, interleaved: bool = False):
+    """cos/sin tables (T, Dh) for RoPE. ``interleaved=False``: rotate-half
+    convention (LLaMA/NeoX — frequency vector duplicated by concatenation);
+    ``interleaved=True``: rotate-every-two (GPT-J — each frequency repeated
+    for an adjacent dim pair)."""
     d2 = head_dim // 2
     inv_freq = 1.0 / (theta ** (jnp.arange(d2, dtype=jnp.float32) / d2))
     inv_freq = _scaled_inv_freq(inv_freq, scaling)
     ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]   # (T, d2)
+    if interleaved:
+        return jnp.repeat(jnp.cos(ang), 2, axis=-1), jnp.repeat(jnp.sin(ang), 2, axis=-1)
     cos = jnp.concatenate([jnp.cos(ang)] * 2, axis=-1)
     sin = jnp.concatenate([jnp.sin(ang)] * 2, axis=-1)
     return cos, sin
 
 
-def apply_rope(x, cos, sin):
-    """x: (B, T, H, Dh); cos/sin: (T, Dh). Rotate-half convention."""
+def apply_rope(x, cos, sin, interleaved: bool = False):
+    """x: (B, T, H, Dh); cos/sin: (T, Dh) built with the SAME convention."""
     x32 = x.astype(jnp.float32)
-    x1, x2 = jnp.split(x32, 2, axis=-1)
-    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    if interleaved:
+        # rotate_every_two: out[2i] = -x[2i+1], out[2i+1] = x[2i]
+        x1 = x32[..., ::2]
+        x2 = x32[..., 1::2]
+        rotated = jnp.stack([-x2, x1], axis=-1).reshape(x32.shape)
+    else:
+        h1, h2 = jnp.split(x32, 2, axis=-1)
+        rotated = jnp.concatenate([-h2, h1], axis=-1)
     out = x32 * cos[None, :, None, :] + rotated * sin[None, :, None, :]
     return out.astype(x.dtype)
 
@@ -195,7 +205,7 @@ def parse_lm_batch(batch):
     return batch, batch, None
 
 
-def chunked_lm_loss(x, head, targets, loss_mask=None):
+def chunked_lm_loss(x, head, targets, loss_mask=None, bias=None):
     """Mean next-token NLL with the vocab projection computed in sequence
     chunks.
 
@@ -213,6 +223,8 @@ def chunked_lm_loss(x, head, targets, loss_mask=None):
     def chunk_nll(carry, xt):
         xc, tc = xt
         logits = (xc @ head).astype(jnp.float32)                  # (B, C, V)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
         return carry, lse - tgt

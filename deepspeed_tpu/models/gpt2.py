@@ -53,6 +53,7 @@ class GPT2Config:
     # models/common.py cached_decode_attention for measured numbers)
     use_flash_decode: bool = False
     tie_embeddings: bool = True
+    lm_head_bias: bool = False       # GPT-J style bias on the (untied) head
     # BLOOM-style variant switches: ALiBi replaces the learned position table
     # (no wpe param; attention gets per-head linear position biases) and an
     # extra layernorm follows the token embedding
@@ -63,6 +64,7 @@ class GPT2Config:
     # parallel-residual block x + attn(ln1(x)) + mlp(ln2(x))
     rotary_pct: float = 0.0          # 0 = learned positions
     rotary_theta: float = 10000.0
+    rotary_interleaved: bool = False  # GPT-J rotate-every-two convention
     parallel_residual: bool = False
     # block-sparse attention (reference ds_config "sparse_attention" block /
     # ops/sparse_attention): {"mode": "fixed"|"variable"|"bigbird"|
@@ -199,6 +201,8 @@ class GPT2Model:
             params["emb_ln_b"] = jnp.zeros((d,), jnp.float32)
         if not c.tie_embeddings:
             params["lm_head"] = jax.random.normal(keys[6], (d, c.vocab_size), jnp.float32) * 0.02
+            if c.lm_head_bias:
+                params["lm_head_b"] = jnp.zeros((c.vocab_size,), jnp.float32)
         return params
 
     def param_partition_specs(self) -> Dict[str, Any]:
@@ -228,6 +232,8 @@ class GPT2Model:
             specs["emb_ln_b"] = P(None)
         if not c.tie_embeddings:
             specs["lm_head"] = P(None, "tensor")
+            if c.lm_head_bias:
+                specs["lm_head_b"] = P("tensor")
         return specs
 
     # --------------------------------------------------------------- compute
@@ -293,12 +299,19 @@ class GPT2Model:
         attn = checkpoint_name(attn, "attn_out")
         return self._block_finish(x, blk, attn, rng)
 
+    def _lm_logits(self, params, x):
+        """Final hidden → fp32 logits (tied or untied head, optional GPT-J
+        style head bias)."""
+        c = self.config
+        head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
+        logits = (x @ head).astype(jnp.float32)
+        if "lm_head_b" in params:
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
+        return logits
+
     def apply(self, params, input_ids, rng=None):
         """input_ids (B, T) int32 → logits (B, T, V) fp32."""
-        c = self.config
-        x = self._trunk(params, input_ids, rng)
-        head = params["wte"].T if c.tie_embeddings else params["lm_head"]
-        return (x @ head.astype(x.dtype)).astype(jnp.float32)
+        return self._lm_logits(params, self._trunk(params, input_ids, rng))
 
     def _trunk(self, params, input_ids, rng=None):
         c = self.config
@@ -354,7 +367,8 @@ class GPT2Model:
         x = self._trunk(params, ids, rng)[:, :-1]          # (B, T-1, D)
         head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
         return chunked_lm_loss(x, head, labels[:, 1:],
-                               mask[:, 1:] if mask is not None else None)
+                               mask[:, 1:] if mask is not None else None,
+                               bias=params.get("lm_head_b"))
 
 
     # ------------------------------------------------------------- inference
@@ -387,22 +401,25 @@ class GPT2Model:
             return None
         from deepspeed_tpu.models.common import _rope_cos_sin
 
-        rot = int(c.head_dim * c.rotary_pct)
+        # round, not int(): converted ratios like 32/96 reconstruct exactly
+        rot = round(c.head_dim * c.rotary_pct)
         rot -= rot % 2
-        return _rope_cos_sin(positions, rot, c.rotary_theta)
+        return _rope_cos_sin(positions, rot, c.rotary_theta,
+                             interleaved=c.rotary_interleaved)
 
-    @staticmethod
-    def _apply_partial_rope(q, k, rope):
-        """NeoX-style partial rotary: rotate the first rotary_pct of each
-        head's dims (rotate-half convention), pass the rest through."""
+    def _apply_partial_rope(self, q, k, rope):
+        """Partial rotary: rotate the first rotary_pct of each head's dims
+        (NeoX rotate-half or GPT-J rotate-every-two), pass the rest
+        through."""
         if rope is None:
             return q, k
         from deepspeed_tpu.models.common import apply_rope
 
+        il = self.config.rotary_interleaved
         cos, sin = rope
         rot = cos.shape[-1]
-        qr = apply_rope(q[..., :rot], cos, sin)
-        kr = apply_rope(k[..., :rot], cos, sin)
+        qr = apply_rope(q[..., :rot], cos, sin, il)
+        kr = apply_rope(k[..., :rot], cos, sin, il)
         return (jnp.concatenate([qr, q[..., rot:]], axis=-1),
                 jnp.concatenate([kr, k[..., rot:]], axis=-1))
 
@@ -460,8 +477,7 @@ class GPT2Model:
 
         x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
         x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
-        head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
-        logits = (x[:, -1] @ head).astype(jnp.float32)
+        logits = self._lm_logits(params, x[:, -1])
         cache = {"k": ks, "v": vs, "pos": jnp.int32(T)}
         return logits, cache
 
@@ -497,8 +513,7 @@ class GPT2Model:
 
         x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
         x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
-        head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
-        logits = (x[:, 0] @ head).astype(jnp.float32)
+        logits = self._lm_logits(params, x[:, 0])
         return logits, {"k": ks, "v": vs, "pos": pos + 1}
 
 

@@ -89,8 +89,7 @@ class MoEGPT2(GPT2Model):
         (x, aux), _ = jax.lax.scan(pair_body, (x, jnp.float32(0.0)),
                                    (paired, params["moe"]))
         x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])[:, :-1]
-        head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
-        logits = (x @ head).astype(jnp.float32)
+        logits = self._lm_logits(params, x)
         targets = labels[:, 1:]
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

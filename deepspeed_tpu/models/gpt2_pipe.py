@@ -31,11 +31,12 @@ class PipelinedGPT2(GPT2Model):
         super().__init__(config)
         if config.n_layer % num_stages:
             raise ValueError(f"n_layer {config.n_layer} not divisible by stages {num_stages}")
-        if config.alibi or config.embed_layernorm or config.rotary_pct:
+        if (config.alibi or config.embed_layernorm or config.rotary_pct
+                or config.lm_head_bias):
             raise NotImplementedError(
-                "PipelinedGPT2 does not implement the BLOOM/NeoX variant "
-                "switches (alibi/embed_layernorm/rotary_pct); use the "
-                "non-pipelined GPT2Model")
+                "PipelinedGPT2 does not implement the BLOOM/NeoX/GPT-J "
+                "variant switches (alibi/embed_layernorm/rotary_pct/"
+                "lm_head_bias); use the non-pipelined GPT2Model")
         if schedule not in ("1f1b", "gpipe"):
             raise ValueError(f"schedule {schedule!r} not in ('1f1b', 'gpipe')")
         self.num_stages = num_stages

@@ -433,6 +433,76 @@ def export_bloom(params: Dict[str, Any], n_head: int,
 
 
 
+
+# ------------------------------------------------------------------- GPT-J
+def load_gptj(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``GPTJForCausalLM`` (GPT-J-6B) → (GPT2Config, params) for GPT2Model.
+
+    GPT-J switches: interleaved (rotate-every-two) rotary on the first
+    ``rotary_dim`` of each head, parallel residual with ONE shared layernorm
+    (the loader duplicates ln_1 into the ln2 slots — numerically identical
+    since both branches normalize the block input with the same weights),
+    bias-free attention projections (zero-filled), and a bias on the untied
+    lm_head. Reference counterpart: module_inject/containers/gptj.py.
+    """
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    cfg = getattr(model_or_sd, "config", None)
+    n_head = int(getattr(cfg, "n_head", 0) or getattr(cfg, "num_attention_heads", 0) or 0)
+    if not n_head:
+        raise ValueError("load_gptj needs the HF model (config carries the "
+                         "head count), not a bare state dict")
+
+    sd = hf_state_dict(model_or_sd)
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    g = lambda name: sd[prefix + name].astype(dtype)
+    n_layer = _layer_count(sd, prefix, "h")
+
+    wte = g("wte.weight")
+    vocab, d = wte.shape
+    rotary_dim = int(getattr(cfg, "rotary_dim", None) or d // n_head)
+
+    def qkv_w(i):
+        return np.concatenate(
+            [g(f"h.{i}.attn.{p}_proj.weight").T for p in ("q", "k", "v")], axis=1)
+
+    stack_w, stack_b, stack_t = _stackers(g, n_layer, "h.{i}.")
+    zeros_b = np.zeros((n_layer, d), dtype)
+    params = {
+        "wte": wte,
+        "blocks": {
+            "ln1_g": stack_w("ln_1"),
+            "ln1_b": stack_b("ln_1"),
+            "qkv_w": np.stack([qkv_w(i) for i in range(n_layer)]),
+            "qkv_b": np.zeros((n_layer, 3 * d), dtype),   # GPT-J: no attn biases
+            "proj_w": stack_t("attn.out_proj"),
+            "proj_b": zeros_b,
+            # the shared-LN parallel block: ln2 := ln_1 (see docstring)
+            "ln2_g": stack_w("ln_1"),
+            "ln2_b": stack_b("ln_1"),
+            "fc_w": stack_t("mlp.fc_in"),
+            "fc_b": stack_b("mlp.fc_in"),
+            "fc2_w": stack_t("mlp.fc_out"),
+            "fc2_b": stack_b("mlp.fc_out"),
+        },
+        "lnf_g": g("ln_f.weight"),
+        "lnf_b": g("ln_f.bias"),
+        "lm_head": sd["lm_head.weight"].astype(dtype).T,
+        "lm_head_b": sd["lm_head.bias"].astype(dtype),
+    }
+
+    config = GPT2Config(
+        vocab_size=vocab,
+        n_positions=int(getattr(cfg, "n_positions", 2048) or 2048),
+        n_embd=d, n_layer=n_layer, n_head=n_head, activation="gelu_new",
+        rotary_pct=rotary_dim / (d // n_head), rotary_interleaved=True,
+        parallel_residual=True, tie_embeddings=False, lm_head_bias=True,
+        dtype=_compute_dtype(dtype))
+    logger.info(f"load_gptj: {n_layer} layers, d={d}, vocab={vocab}, "
+                f"heads={n_head}, rotary_dim={rotary_dim}")
+    return config, params
+
+
 # ---------------------------------------------------------------- GPT-NeoX
 def load_gptneox(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
     """HF ``GPTNeoXForCausalLM`` (NeoX-20B, the Pythia ladder) → (GPT2Config,
@@ -618,7 +688,8 @@ _LOADERS = {"gpt2": (load_gpt2, _gpt2_model),
             "llama": (load_llama, _llama_model),
             "opt": (load_opt, _gpt2_model),
             "bloom": (load_bloom, _gpt2_model),
-            "gpt_neox": (load_gptneox, _gpt2_model)}
+            "gpt_neox": (load_gptneox, _gpt2_model),
+            "gptj": (load_gptj, _gpt2_model)}
 
 
 def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,

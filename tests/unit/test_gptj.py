@@ -1,0 +1,83 @@
+"""GPT-J conversion: interleaved rotary, shared-LN parallel residual, head
+bias (reference: module_inject/containers/gptj.py)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Model
+from deepspeed_tpu.module_inject.hf import load_hf_model
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+VOCAB = 128
+
+
+@pytest.fixture(scope="module")
+def hf_gptj():
+    from transformers import GPTJConfig, GPTJForCausalLM
+
+    torch.manual_seed(0)
+    cfg = GPTJConfig(vocab_size=VOCAB, n_embd=64, n_layer=2, n_head=4,
+                     rotary_dim=8, n_positions=64, n_inner=None,
+                     resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+                     tie_word_embeddings=False)
+    return GPTJForCausalLM(cfg).eval()
+
+
+@pytest.fixture()
+def ids():
+    rng = np.random.RandomState(0)
+    return rng.randint(4, VOCAB - 4, size=(2, 12)).astype(np.int32)
+
+
+class TestGPTJConversion:
+    def test_logits_match_torch(self, hf_gptj, ids):
+        model, params = load_hf_model(hf_gptj)
+        c = model.config
+        assert c.rotary_interleaved and c.parallel_residual and c.lm_head_bias
+        assert c.rotary_pct == 8 / 16  # rotary_dim / head_dim
+        assert "lm_head_b" in params
+        model = GPT2Model(dataclasses.replace(c, dtype=jnp.float32,
+                                              use_flash_attention=False,
+                                              remat=False))
+        ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+        with torch.no_grad():
+            theirs = hf_gptj(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+    def test_generate_matches_torch_greedy(self, hf_gptj, ids):
+        model, params = load_hf_model(hf_gptj)
+        model = GPT2Model(dataclasses.replace(model.config, dtype=jnp.float32,
+                                              use_flash_attention=False,
+                                              remat=False))
+        engine = deepspeed_tpu.init_inference(
+            model, config={"dtype": "fp32", "max_out_tokens": 64}, params=params)
+        out = np.asarray(engine.generate(ids, max_new_tokens=8, do_sample=False))
+        with torch.no_grad():
+            ref = hf_gptj.generate(torch.tensor(ids, dtype=torch.long),
+                                   max_new_tokens=8, do_sample=False).numpy()
+        np.testing.assert_array_equal(out, ref)
+
+    def test_train_through_initialize(self, hf_gptj):
+        model, params = load_hf_model(hf_gptj)
+        model = GPT2Model(dataclasses.replace(model.config,
+                                              use_flash_attention=False))
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "bf16": {"enabled": True},
+                    "zero_optimization": {"stage": 1},
+                    "steps_per_print": 0})
+        rng = np.random.RandomState(1)
+        batch = {"input_ids": rng.randint(0, VOCAB,
+                                          size=(8, 32)).astype(np.int32)}
+        losses = [float(engine.train_batch(batch)) for _ in range(6)]
+        assert losses[-1] < losses[0], losses

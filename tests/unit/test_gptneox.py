@@ -119,13 +119,13 @@ def test_moe_rotary_positions_apply(monkeypatch):
     ids = np.random.RandomState(0).randint(0, 64, size=(1, 8)).astype(np.int32)
 
     seen = []
-    orig = GPT2Model._apply_partial_rope  # staticmethod → plain function
+    orig = GPT2Model._apply_partial_rope
 
-    def spy(q, k, rope):
+    def spy(self, q, k, rope):
         seen.append(rope is not None)
-        return orig(q, k, rope)
+        return orig(self, q, k, rope)
 
-    monkeypatch.setattr(GPT2Model, "_apply_partial_rope", staticmethod(spy))
+    monkeypatch.setattr(GPT2Model, "_apply_partial_rope", spy)
     float(model.loss(params, {"input_ids": jnp.asarray(ids)}))
     assert seen and all(seen), f"rope dropped in MoE attention: {seen}"
 

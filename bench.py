@@ -6,9 +6,12 @@ Baseline: the north-star from BASELINE.md — ≥50% MFU for GPT-2-class ZeRO-3
 pretraining (the reference's best published efficiency is 52% of peak on V100,
 docs/_posts/2020-05-19-bert-record.md:13). vs_baseline = MFU / 0.50.
 
-Env knobs: BENCH_MODEL (preset name), BENCH_BS (per-chip microbatch),
-BENCH_SEQ, BENCH_STEPS, BENCH_GAS (gradient accumulation), BENCH_REMAT
-(none|full|dots|attn; default attn).
+Env knobs: BENCH_MODEL (gpt2-*/llama-*/bert-* preset; default gpt2-760m —
+the headline), BENCH_BS (per-chip microbatch), BENCH_SEQ, BENCH_STEPS,
+BENCH_GAS (gradient accumulation), BENCH_REMAT (none|full|dots|attn; default
+attn). Measured secondary points on one v5e chip: bert-large (the
+reference's own headline family) ≈0.33 MFU at bs=256/seq=128 or bs=16/seq=512
+(d=1024 matmul shapes + post-LN fp32 passes bound it, not attention).
 """
 
 import json
@@ -33,11 +36,27 @@ def main():
 
     import dataclasses
 
+    # model registry: gpt2-* (default flagship), llama-*, bert-* (the
+    # reference's own headline benchmark family — MLM pretraining)
+    if model_name.startswith("llama"):
+        from deepspeed_tpu.models.llama import PRESETS as LLAMA_PRESETS, LlamaModel
+
+        PRESETS, model_cls, make_batch = LLAMA_PRESETS, LlamaModel, synthetic_lm_batch
+    elif model_name.startswith("bert"):
+        from deepspeed_tpu.models.bert import (PRESETS as BERT_PRESETS, BertModel,
+                                               synthetic_mlm_batch)
+
+        PRESETS, model_cls, make_batch = BERT_PRESETS, BertModel, synthetic_mlm_batch
+    else:
+        model_cls, make_batch = GPT2Model, synthetic_lm_batch
+
     config = PRESETS[model_name]
     # 'attn' (save flash-attention outputs, recompute the cheap matmul chain)
     # + bs=12 is the measured single-chip sweet spot for gpt2-760m on v5e:
     # 'full' wastes a flash recompute, 'dots'/bs>=16 exceed 16G HBM
     remat = os.environ.get("BENCH_REMAT", "attn")
+    if model_name.startswith("bert") and remat == "attn":
+        remat = "full"      # BertConfig supports False/'full' only
     config = dataclasses.replace(config, remat=remat if remat != "none" else False)
     seq = int(os.environ.get("BENCH_SEQ", min(1024, config.n_positions)))
     per_chip_bs = int(os.environ.get("BENCH_BS", 12 if on_tpu else 2))
@@ -60,9 +79,9 @@ def main():
         ds_config["data_types"] = {"grad_accum_dtype": os.environ.get(
             "BENCH_ACC_DTYPE", "bf16")}
 
-    model = GPT2Model(config)
+    model = model_cls(config)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
-    batch = synthetic_lm_batch(batch_size, seq, config.vocab_size, seed=0)
+    batch = make_batch(batch_size, seq, config.vocab_size, seed=0)
     batch = engine._shard_batch(batch)  # pre-place once; steps then pipeline
 
     # warmup / compile

@@ -15,8 +15,10 @@ unchanged.
 Supported today: GPT-2 family (``GPT2LMHeadModel`` — the flagship), LLaMA
 (``LlamaForCausalLM``, incl. GQA / llama2 / llama3 shapes), and OPT
 (``OPTForCausalLM`` — the DeepSpeed-Chat RLHF family), BLOOM
-(``BloomForCausalLM`` — ALiBi, the reference's flagship injected model), and
-GPT-NeoX/Pythia (``GPTNeoXForCausalLM`` — partial rotary, parallel residual).
+(``BloomForCausalLM`` — ALiBi, the reference's flagship injected model),
+GPT-NeoX/Pythia (``GPTNeoXForCausalLM`` — partial rotary, parallel residual),
+GPT-J (``GPTJForCausalLM`` — interleaved rotary, head bias), and BERT
+(``BertForMaskedLM`` — the reference's headline benchmark family).
 Everything else still gets ``state_dict_to_tree`` + AutoTP's name-pattern
 classification (reference auto_tp.py role) for TP placement of the raw tree.
 """
@@ -434,6 +436,101 @@ def export_bloom(params: Dict[str, Any], n_head: int,
 
 
 
+
+# -------------------------------------------------------------------- BERT
+def load_bert(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``BertForMaskedLM`` → (BertConfig, params) for
+    ``deepspeed_tpu.models.bert.BertModel``.
+
+    The reference's headline benchmark family (BERT-large pretraining) and
+    its kernel-parity baseline (vendored HF BERT, tests/unit/ops/
+    accelerators). Separate q/k/v fuse into one qkv matrix; the MLM head's
+    decoder weight is tied to the word embedding (only its bias is kept).
+    Reference counterpart: module_inject/containers/bert.py.
+    """
+    from deepspeed_tpu.models.bert import BertConfig
+
+    cfg = getattr(model_or_sd, "config", None)
+    n_head = int(getattr(cfg, "num_attention_heads", 0) or 0)
+    if not n_head:
+        raise ValueError("load_bert needs the HF model (config carries "
+                         "num_attention_heads), not a bare state dict")
+
+    sd = hf_state_dict(model_or_sd)
+    if "cls.predictions.transform.dense.weight" not in sd:
+        raise NotImplementedError(
+            "load_bert converts BertForMaskedLM checkpoints (needs the "
+            "cls.predictions MLM head); bare BertModel / classification "
+            "heads are not supported")
+    prefix = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    g = lambda name: sd[prefix + name].astype(dtype)
+    n_layer = _layer_count(sd, prefix, "encoder.layer")
+
+    wte = g("embeddings.word_embeddings.weight")
+    vocab, d = wte.shape
+
+    def qkv_w(i):
+        p = f"encoder.layer.{i}.attention.self."
+        return np.concatenate([g(p + f"{n}.weight").T for n in ("query", "key", "value")],
+                              axis=1)
+
+    def qkv_b(i):
+        p = f"encoder.layer.{i}.attention.self."
+        return np.concatenate([g(p + f"{n}.bias") for n in ("query", "key", "value")])
+
+    stack_w, stack_b, stack_t = _stackers(g, n_layer, "encoder.layer.{i}.")
+    params = {
+        "wte": wte,
+        "wpe": g("embeddings.position_embeddings.weight"),
+        "wtype": g("embeddings.token_type_embeddings.weight"),
+        "emb_ln_g": g("embeddings.LayerNorm.weight"),
+        "emb_ln_b": g("embeddings.LayerNorm.bias"),
+        "blocks": {
+            "qkv_w": np.stack([qkv_w(i) for i in range(n_layer)]),
+            "qkv_b": np.stack([qkv_b(i) for i in range(n_layer)]),
+            "proj_w": stack_t("attention.output.dense"),
+            "proj_b": stack_b("attention.output.dense"),
+            "attn_ln_g": stack_w("attention.output.LayerNorm"),
+            "attn_ln_b": stack_b("attention.output.LayerNorm"),
+            "fc_w": stack_t("intermediate.dense"),
+            "fc_b": stack_b("intermediate.dense"),
+            "fc2_w": stack_t("output.dense"),
+            "fc2_b": stack_b("output.dense"),
+            "mlp_ln_g": stack_w("output.LayerNorm"),
+            "mlp_ln_b": stack_b("output.LayerNorm"),
+        },
+        "mlm_w": sd["cls.predictions.transform.dense.weight"].astype(dtype).T,
+        "mlm_b": sd["cls.predictions.transform.dense.bias"].astype(dtype),
+        "mlm_ln_g": sd["cls.predictions.transform.LayerNorm.weight"].astype(dtype),
+        "mlm_ln_b": sd["cls.predictions.transform.LayerNorm.bias"].astype(dtype),
+        "decoder_b": sd["cls.predictions.bias"].astype(dtype),
+    }
+    if "cls.predictions.decoder.weight" in sd and not np.array_equal(
+            sd["cls.predictions.decoder.weight"], sd[prefix + "embeddings.word_embeddings.weight"]):
+        raise NotImplementedError("untied BERT MLM decoder weight not supported")
+
+    act = getattr(cfg, "hidden_act", "gelu") or "gelu"
+    if act not in ("relu", "gelu", "gelu_new"):
+        raise NotImplementedError(f"BERT hidden_act {act!r} not supported")
+    config = BertConfig(
+        vocab_size=vocab,
+        n_positions=int(getattr(cfg, "max_position_embeddings", 512) or 512),
+        n_embd=d, n_layer=n_layer, n_head=n_head,
+        intermediate_size=int(getattr(cfg, "intermediate_size", 4 * d) or 4 * d),
+        type_vocab_size=int(getattr(cfg, "type_vocab_size", 2) or 2),
+        layer_norm_eps=float(getattr(cfg, "layer_norm_eps", 1e-12) or 1e-12),
+        activation=act, dtype=_compute_dtype(dtype))
+    logger.info(f"load_bert: {n_layer} layers, d={d}, vocab={vocab}, "
+                f"heads={n_head}")
+    return config, params
+
+
+def _bert_model(config):
+    from deepspeed_tpu.models.bert import BertModel
+
+    return BertModel(config)
+
+
 # ------------------------------------------------------------------- GPT-J
 def load_gptj(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
     """HF ``GPTJForCausalLM`` (GPT-J-6B) → (GPT2Config, params) for GPT2Model.
@@ -689,7 +786,8 @@ _LOADERS = {"gpt2": (load_gpt2, _gpt2_model),
             "opt": (load_opt, _gpt2_model),
             "bloom": (load_bloom, _gpt2_model),
             "gpt_neox": (load_gptneox, _gpt2_model),
-            "gptj": (load_gptj, _gpt2_model)}
+            "gptj": (load_gptj, _gpt2_model),
+            "bert": (load_bert, _bert_model)}
 
 
 def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,

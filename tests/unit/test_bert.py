@@ -1,0 +1,129 @@
+"""BERT family: MLM numerics vs HF torch, masks, MLM training, TP serving
+(the reference's headline benchmark family and kernel-parity baseline)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.bert import (IGNORE_INDEX, PRESETS, BertConfig,
+                                       BertModel, synthetic_mlm_batch)
+from deepspeed_tpu.module_inject.hf import load_bert, load_hf_model
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+VOCAB = 128
+
+
+@pytest.fixture(scope="module")
+def hf_bert():
+    from transformers import BertConfig as HFConfig, BertForMaskedLM
+
+    torch.manual_seed(0)
+    cfg = HFConfig(vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=64,
+                   max_position_embeddings=64, type_vocab_size=2,
+                   hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    return BertForMaskedLM(cfg).eval()
+
+
+@pytest.fixture()
+def ids():
+    rng = np.random.RandomState(0)
+    return rng.randint(4, VOCAB - 4, size=(2, 16)).astype(np.int32)
+
+
+def _fp32(model):
+    return BertModel(dataclasses.replace(model.config, dtype=jnp.float32,
+                                         use_flash_attention=False))
+
+
+class TestBertConversion:
+    def test_logits_match_torch(self, hf_bert, ids):
+        model, params = load_hf_model(hf_bert)
+        assert isinstance(model, BertModel)
+        model = _fp32(model)
+        ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+        with torch.no_grad():
+            theirs = hf_bert(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+    def test_token_types_and_attention_mask_match_torch(self, hf_bert, ids):
+        model, params = load_hf_model(hf_bert)
+        model = _fp32(model)
+        tt = np.zeros_like(ids)
+        tt[:, 8:] = 1
+        am = np.ones_like(ids)
+        am[:, 12:] = 0        # padded tail
+        ours = np.asarray(model.apply(params, jnp.asarray(ids),
+                                      token_type_ids=jnp.asarray(tt),
+                                      attention_mask=jnp.asarray(am)))
+        with torch.no_grad():
+            theirs = hf_bert(torch.tensor(ids, dtype=torch.long),
+                             token_type_ids=torch.tensor(tt, dtype=torch.long),
+                             attention_mask=torch.tensor(am, dtype=torch.long)
+                             ).logits.numpy()
+        # positions attending only to unpadded tokens must agree
+        np.testing.assert_allclose(ours[:, :12], theirs[:, :12],
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_mlm_loss_matches_torch(self, hf_bert, ids):
+        model, params = load_hf_model(hf_bert)
+        model = _fp32(model)
+        labels = ids.copy().astype(np.int32)
+        labels[:, ::3] = IGNORE_INDEX
+        ours = float(model.loss(params, {"input_ids": jnp.asarray(ids),
+                                         "labels": jnp.asarray(labels)}))
+        with torch.no_grad():
+            theirs = float(hf_bert(torch.tensor(ids, dtype=torch.long),
+                                   labels=torch.tensor(labels, dtype=torch.long)
+                                   ).loss)
+        assert abs(ours - theirs) < 2e-3, (ours, theirs)
+
+
+class TestBertNative:
+    def test_mlm_train_through_initialize(self):
+        cfg = dataclasses.replace(PRESETS["bert-tiny"],
+                                  use_flash_attention=False)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=BertModel(cfg),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "bf16": {"enabled": True},
+                    "zero_optimization": {"stage": 2},
+                    "steps_per_print": 0})
+        batch = synthetic_mlm_batch(8, 64, cfg.vocab_size)
+        losses = [float(engine.train_batch(batch)) for _ in range(6)]
+        assert losses[-1] < losses[0], losses
+
+    def test_num_params_matches_tree(self):
+        cfg = PRESETS["bert-tiny"]
+        params = BertModel(cfg).init_params(jax.random.PRNGKey(0))
+        n = sum(x.size for x in jax.tree.leaves(params))
+        assert n == cfg.num_params()
+
+    def test_bert_large_param_count(self):
+        assert abs(PRESETS["bert-large"].num_params() - 335e6) / 335e6 < 0.02
+
+    def test_tp2_logits_match_tp1(self, hf_bert, ids):
+        from deepspeed_tpu.comm import comm
+        from deepspeed_tpu.parallel.topology import build_mesh
+
+        model, params = load_hf_model(hf_bert)
+        model = _fp32(model)
+        outs = {}
+        for tp in (1, 2):
+            comm.cdb = None
+            mesh = build_mesh(axis_dims={"pipe": 1, "data": 8 // tp, "expert": 1,
+                                         "seq": 1, "tensor": tp})
+            comm.init_distributed(mesh=mesh, verbose=False)
+            engine = deepspeed_tpu.init_inference(
+                model, config={"dtype": "fp32", "max_out_tokens": 64},
+                params=params, mesh=mesh)
+            outs[tp] = np.asarray(engine.forward(ids))
+        np.testing.assert_allclose(outs[2], outs[1], rtol=1e-5, atol=1e-5)

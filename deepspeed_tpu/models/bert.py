@@ -89,8 +89,6 @@ PRESETS = {
 class BertModel:
     """Functional BERT MLM: params are a dict with stacked per-layer leaves."""
 
-    _warned_flash_fallback = [False]
-
     def __init__(self, config: BertConfig):
         self.config = config
 
@@ -168,28 +166,15 @@ class BertModel:
         return jax.nn.gelu(h, approximate=(a == "gelu_new"))
 
     def _attention(self, q, k, v, attention_mask):
-        """Bidirectional attention; ``attention_mask`` (B, T) True=attend
-        routes to the masked einsum path (the flash kernel is mask-free)."""
-        if attention_mask is None and self.config.use_flash_attention \
-                and jax.default_backend() == "tpu":
-            try:
-                from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        """Bidirectional attention via the shared dispatch; ``attention_mask``
+        (B, T) True=attend routes to the masked einsum path (the flash
+        kernel is mask-free)."""
+        from deepspeed_tpu.models.common import local_causal_attention
 
-                return flash_attention(q, k, v, causal=False)
-            except Exception as e:
-                if not BertModel._warned_flash_fallback[0]:
-                    BertModel._warned_flash_fallback[0] = True
-                    from deepspeed_tpu.utils.logging import logger
-
-                    logger.warning(f"flash attention unavailable ({e}); "
-                                   "using XLA einsum attention")
-        scale = 1.0 / math.sqrt(self.config.head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-        if attention_mask is not None:
-            keep = jnp.asarray(attention_mask).astype(jnp.bool_)
-            logits = jnp.where(keep[:, None, None, :], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return local_causal_attention(q, k, v,
+                                      use_flash=self.config.use_flash_attention,
+                                      causal=False,
+                                      key_padding_mask=attention_mask)
 
     def _block(self, x, blk, attention_mask):
         c = self.config

@@ -92,24 +92,28 @@ def apply_rope(x, cos, sin, interleaved: bool = False):
     return out.astype(x.dtype)
 
 
-def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None):
-    """Causal self-attention on local (unsharded-sequence) q, k, v with equal
-    head counts (B, T, H, Dh): Pallas flash kernel when available, XLA einsum
-    otherwise (CPU tests, unsupported shapes).
+def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None,
+                           causal: bool = True, key_padding_mask=None):
+    """Self-attention on local (unsharded-sequence) q, k, v with equal head
+    counts (B, T, H, Dh): Pallas flash kernel when available, XLA einsum
+    otherwise (CPU tests, unsupported shapes). Causal by default;
+    ``causal=False`` is the encoder (BERT) path.
 
     ``alibi``: optional (H,) per-head slopes; the bias added is
     ``slopes[h] * j`` (key position only) — equivalent to the canonical
     ``slopes * (j - i)`` because per-row constants cancel in softmax, and
     exactly HF BLOOM's ``build_alibi_tensor`` under a full attention mask.
-    Biased attention takes the einsum path (the flash kernel carries no bias).
+    ``key_padding_mask``: optional (B, T) True=attend. Biased or masked
+    attention takes the einsum path (the flash kernel carries neither).
     """
     # the backend gate matters: off-TPU the Mosaic kernel fails at LOWERING
     # time (inside jit compilation), where no try/except here could catch it
-    if use_flash and alibi is None and jax.default_backend() == "tpu":
+    if use_flash and alibi is None and key_padding_mask is None \
+            and jax.default_backend() == "tpu":
         try:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=True)
+            return flash_attention(q, k, v, causal=causal)
         except Exception as e:
             if not _warned_flash_fallback[0]:
                 _warned_flash_fallback[0] = True
@@ -123,8 +127,12 @@ def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None):
     if alibi is not None:
         logits = logits + (alibi[None, :, None, None]
                            * jnp.arange(T, dtype=jnp.float32)[None, None, None, :])
-    mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
-    logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
+    if key_padding_mask is not None:
+        keep = jnp.asarray(key_padding_mask).astype(jnp.bool_)
+        logits = jnp.where(keep[:, None, None, :], logits, NEG_INF_ATTN)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 

@@ -512,6 +512,11 @@ def load_bert(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
     act = getattr(cfg, "hidden_act", "gelu") or "gelu"
     if act not in ("relu", "gelu", "gelu_new"):
         raise NotImplementedError(f"BERT hidden_act {act!r} not supported")
+    pos_type = getattr(cfg, "position_embedding_type", "absolute") or "absolute"
+    if pos_type != "absolute":
+        raise NotImplementedError(
+            f"BERT position_embedding_type {pos_type!r} not supported "
+            "(relative-position attention would silently diverge)")
     config = BertConfig(
         vocab_size=vocab,
         n_positions=int(getattr(cfg, "max_position_embeddings", 512) or 512),

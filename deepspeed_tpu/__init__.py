@@ -55,13 +55,20 @@ def initialize(args=None,
     if ds_config.sparse_attention and model is not None:
         mcfg = getattr(model, "config", None)
         if hasattr(mcfg, "sparse_attention"):
-            if getattr(mcfg, "sparse_attention") is None:
+            existing = getattr(mcfg, "sparse_attention")
+            if existing is None:
                 import dataclasses as _dc
 
                 model.config = _dc.replace(
                     mcfg, sparse_attention=dict(ds_config.sparse_attention))
                 log_dist(f"sparse attention enabled: "
                          f"{ds_config.sparse_attention}", ranks=[0])
+            elif dict(existing) != dict(ds_config.sparse_attention):
+                raise ValueError(
+                    "ds_config sparse_attention conflicts with the model's own "
+                    f"config.sparse_attention (model: {existing}, ds_config: "
+                    f"{dict(ds_config.sparse_attention)}); set only one, or "
+                    "make them identical")
         else:
             log_dist("ds_config sparse_attention set but the model does not "
                      "support it (no config.sparse_attention field); ignored",

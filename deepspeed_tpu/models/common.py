@@ -138,6 +138,7 @@ def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None,
 
 
 _warned_decode_fallback = [False]
+_warned_decode_alibi = [False]
 
 
 def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False,
@@ -155,6 +156,13 @@ def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False,
     on a 4-layer model: 79ms vs 113ms) but loses ~2× to XLA's fused einsum
     when the cache is exactly full — hence opt-in.
     """
+    if use_flash_decode and alibi is not None and not _warned_decode_alibi[0]:
+        _warned_decode_alibi[0] = True
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning("use_flash_decode is set but ALiBi is active; the "
+                       "decode kernel has no bias input — using XLA einsum "
+                       "decode for this model")
     if use_flash_decode and alibi is None:
         try:
             from deepspeed_tpu.ops.pallas.decode_attention import decode_attention

@@ -6,7 +6,10 @@ sharded over the 'pipe' mesh axis; embeddings/final-LN/head live in a 'shared'
 subtree replicated across stages (tied embeddings ⇒ their gradient is the AD
 sum of the stage-0 and last-stage uses — the reference's ReduceTiedGrads,
 pipe/engine.py:225, with no explicit collective). The microbatch loop runs
-inside jit (runtime/pipe/engine.py).
+inside jit (runtime/pipe/engine.py). All GPT2Config variant switches (partial
+rotary, ALiBi, parallel residual, embed layernorm, untied/biased head) thread
+through the stage fns — the reference's arbitrary-stage-content property
+(pipe/module.py:353) for this family.
 """
 
 from __future__ import annotations
@@ -23,34 +26,22 @@ from deepspeed_tpu.runtime.pipe.engine import (pipelined_loss_fn,
                                                pipelined_loss_fn_1f1b)
 
 
-class PipelinedGPT2(GPT2Model):
-    """Model-protocol implementation whose loss is the in-jit pipeline."""
+class PipelinedDecoderMixin:
+    """Shared in-jit pipeline scaffolding for the decoder families.
 
-    def __init__(self, config: GPT2Config, num_stages: int, num_micro: int,
-                 schedule: str = "1f1b"):
-        super().__init__(config)
-        if config.n_layer % num_stages:
-            raise ValueError(f"n_layer {config.n_layer} not divisible by stages {num_stages}")
-        if (config.alibi or config.embed_layernorm or config.rotary_pct
-                or config.lm_head_bias):
-            raise NotImplementedError(
-                "PipelinedGPT2 does not implement the BLOOM/NeoX/GPT-J "
-                "variant switches (alibi/embed_layernorm/rotary_pct/"
-                "lm_head_bias); use the non-pipelined GPT2Model")
-        if schedule not in ("1f1b", "gpipe"):
-            raise ValueError(f"schedule {schedule!r} not in ('1f1b', 'gpipe')")
-        self.num_stages = num_stages
-        self.num_micro = num_micro
-        self.schedule = schedule
-        self._pipe_loss = None
+    Subclasses provide ``_stage_fn`` and the per-family hooks
+    ``_first_stage_fn`` / ``_final_norm_shared`` / ``_head_shared``, plus
+    ``num_stages`` / ``num_micro`` / ``schedule`` attributes. The mixin owns
+    structure conversion (flat ↔ staged param trees), the 'pipe'-axis
+    partition specs, the chunked last-stage CE, and the cached loss builder.
+    """
 
-    # ---------------------------------------------------------------- params
     def init_params(self, rng) -> Dict[str, Any]:
         return self.flat_to_pipe(super().init_params(rng), self.num_stages)
 
     @staticmethod
     def flat_to_pipe(flat_params: Dict[str, Any], num_stages: int) -> Dict[str, Any]:
-        """Non-pipelined GPT2Model param tree → pipelined layout.
+        """Non-pipelined param tree → pipelined layout.
 
         The universal-checkpoint bridge across PIPELINE degree (reference
         universal_checkpoint.py role for pp changes): a checkpoint trained at
@@ -80,47 +71,29 @@ class PipelinedGPT2(GPT2Model):
 
     def param_partition_specs(self) -> Dict[str, Any]:
         flat = super().param_partition_specs()
+
         def stage_spec(spec):
             # (L, ...) -> (S, Lp, ...): new leading 'pipe' dim, layer dim unsharded
             rest = tuple(spec)[1:]
             return P("pipe", None, *rest)
+
         stages = jax.tree.map(stage_spec, flat["blocks"],
                               is_leaf=lambda x: isinstance(x, P))
         shared = {k: v for k, v in flat.items() if k != "blocks"}
         return {"stages": stages, "shared": shared}
 
-    # --------------------------------------------------------------- compute
-    def _stage_fn(self, stage_params, x, rng):
-        def body(carry, blk):
-            return self._block(carry, blk, None), None
-        out, _ = jax.lax.scan(body, x, stage_params)
-        return out
-
-    def _first_stage_fn(self, shared, mb, rng):
-        ids = mb["input_ids"] if isinstance(mb, dict) else mb
-        T = ids.shape[1]
-        c = self.config
-        return shared["wte"].astype(c.dtype)[ids] + shared["wpe"].astype(c.dtype)[:T]
-
     def _last_stage_loss_fn(self, shared, x, mb):
-        c = self.config
-        if isinstance(mb, dict):
-            ids = mb["input_ids"]
-            labels = mb.get("labels", ids)
-            mask = mb.get("loss_mask")
-        else:
-            ids, labels, mask = mb, mb, None
-        x = self._layer_norm(x, shared["lnf_g"], shared["lnf_b"])[:, :-1]
-        head = (shared["wte"].T if c.tie_embeddings else shared["lm_head"]).astype(x.dtype)
-        logits = (x @ head).astype(jnp.float32)
-        targets = labels[:, 1:]
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-        nll = lse - tgt
-        if mask is not None:
-            m = mask[:, 1:].astype(jnp.float32)
-            return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
-        return jnp.mean(nll)
+        """Final norm + chunked vocab CE — the same memory discipline as the
+        non-pipelined loss (the (B, T, V) fp32 logits tensor is never
+        materialized; at llama3 vocab sizes it is multiple GB/microbatch)."""
+        from deepspeed_tpu.models.common import chunked_lm_loss, parse_lm_batch
+
+        _, labels, mask = parse_lm_batch(mb)
+        x = self._final_norm_shared(shared, x)[:, :-1]
+        return chunked_lm_loss(x, self._head_shared(shared, x.dtype),
+                               labels[:, 1:],
+                               mask[:, 1:] if mask is not None else None,
+                               bias=shared.get("lm_head_b"))
 
     def loss(self, params, batch, rng=None):
         if self._pipe_loss is None:
@@ -139,3 +112,47 @@ class PipelinedGPT2(GPT2Model):
                 # 'dots'/'attn' policies of the non-pipelined model don't apply
                 remat_stage=self.config.remat not in (False, None, "none"))
         return self._pipe_loss(params, batch, rng)
+
+
+class PipelinedGPT2(PipelinedDecoderMixin, GPT2Model):
+    """Model-protocol implementation whose loss is the in-jit pipeline."""
+
+    def __init__(self, config: GPT2Config, num_stages: int, num_micro: int,
+                 schedule: str = "1f1b"):
+        super().__init__(config)
+        if config.n_layer % num_stages:
+            raise ValueError(f"n_layer {config.n_layer} not divisible by stages {num_stages}")
+        if config.sequence_parallel or config.sparse_attention is not None:
+            raise NotImplementedError(
+                "PipelinedGPT2 does not compose with sequence_parallel or "
+                "sparse_attention; use the non-pipelined GPT2Model")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"schedule {schedule!r} not in ('1f1b', 'gpipe')")
+        self.num_stages = num_stages
+        self.num_micro = num_micro
+        self.schedule = schedule
+        self._pipe_loss = None
+
+    # --------------------------------------------------------------- compute
+    def _stage_fn(self, stage_params, x, rng):
+        # rope tables depend only on T (full microbatch sequence at every
+        # stage), so each stage recomputes them locally — no extra p2p traffic
+        rope = self._rope_tables(jnp.arange(x.shape[1]))
+
+        def body(carry, blk):
+            return self._block(carry, blk, None, rope), None
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    def _first_stage_fn(self, shared, mb, rng):
+        ids = mb["input_ids"] if isinstance(mb, dict) else mb
+        # the base _embed handles all first-stage variants: learned wpe vs
+        # ALiBi/rotary (no wpe param), and BLOOM's post-embedding layernorm
+        return self._embed(shared, ids)
+
+    def _final_norm_shared(self, shared, x):
+        return self._layer_norm(x, shared["lnf_g"], shared["lnf_b"])
+
+    def _head_shared(self, shared, dtype):
+        c = self.config
+        return (shared["wte"].T if c.tie_embeddings else shared["lm_head"]).astype(dtype)

@@ -28,17 +28,18 @@ from deepspeed_tpu.utils.logging import logger
 # down projections across the model zoo (cf. reference policy containers:
 # bert/bloom/gpt2/gptj/gptneo/gptneox/llama/megatron/opt):
 ROW_PATTERNS = [
-    # note "attention" (NeoX/BLOOM) does NOT contain the substring "attn"
+    # note "attention" (NeoX/BLOOM) does NOT contain the substring "attn";
+    # paths are '/'-joined by _path_str, so separators must be [./] not \.
     r"(attn|attention).*(c_proj|o_proj|out_proj|dense\b)",
-    r"attention\.output", r"self_attention\.dense",
+    r"attention[./]output", r"self_attention[./]dense",
     r"(mlp|ffn).*(c_proj|down_proj|fc2|fc_out|dense_4h_to_h|w2|wo)\b",
-    r"output\.dense",
+    r"output[./]dense",
 ]
 # column-parallel (output-dim sharded):
 COL_PATTERNS = [
     r"(c_attn|q_proj|k_proj|v_proj|qkv|query|key|value|query_key_value)",
     r"(mlp|ffn).*(c_fc|up_proj|gate_proj|fc1|fc_in|dense_h_to_4h|w1|w3|wi)\b",
-    r"intermediate\.dense", r"lm_head", r"embed_out",
+    r"intermediate[./]dense", r"lm_head", r"embed_out",
 ]
 # vocab-sharded embeddings:
 EMBED_PATTERNS = [r"(wte|word_embeddings|embed_tokens|tok_embeddings)\b"]

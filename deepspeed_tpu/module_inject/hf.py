@@ -379,7 +379,10 @@ def load_bloom(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]
         params["lm_head"] = sd["lm_head.weight"].astype(dtype).T
 
     config = GPT2Config(
-        vocab_size=vocab, n_positions=int(getattr(cfg, "seq_length", 0) or 2048),
+        # BLOOM has no positional table (ALiBi extrapolates); HF BloomConfig
+        # carries no max-length field, so n_positions is a synthetic default
+        # that only sizes internal buffers, never a learned embedding.
+        vocab_size=vocab, n_positions=2048,
         n_embd=d, n_layer=n_layer, n_head=n_head, activation="gelu_new",
         alibi=True, embed_layernorm=True, tie_embeddings=tied,
         dtype=_compute_dtype(dtype))
@@ -673,7 +676,11 @@ def load_gptneox(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any
         n_positions=int(getattr(cfg, "max_position_embeddings", 2048) or 2048),
         n_embd=d, n_layer=n_layer, n_head=n_head, activation=act,
         rotary_pct=float(getattr(cfg, "rotary_pct", 0.25) or 0.25),
-        rotary_theta=float(getattr(cfg, "rotary_emb_base", 10000.0) or 10000.0),
+        # transformers is migrating GPTNeoXConfig rotary_emb_base → rope_theta;
+        # probe the new name first so non-default bases survive the rename
+        rotary_theta=float(getattr(cfg, "rope_theta", None)
+                           or getattr(cfg, "rotary_emb_base", 10000.0)
+                           or 10000.0),
         parallel_residual=bool(getattr(cfg, "use_parallel_residual", True)),
         tie_embeddings=tied, dtype=_compute_dtype(dtype))
     logger.info(f"load_gptneox: {n_layer} layers, d={d}, vocab={vocab}, "

@@ -153,3 +153,34 @@ def test_autotp_classifies_neox_and_gptj_trees():
     assert tuple(get("out_proj")) == ("tensor", None)
     assert tuple(get("fc_in")) == (None, "tensor")
     assert tuple(get("fc_out")) == ("tensor", None)
+
+
+def test_autotp_classifies_raw_bert_tree():
+    """A raw BERT state-dict tree: paths are '/'-joined, so the
+    intermediate.dense / output.dense patterns must use [./] separators
+    (reference container bert.py name set)."""
+    from deepspeed_tpu.module_inject.auto_tp import AutoTP
+    from deepspeed_tpu.module_inject.hf import state_dict_to_tree
+
+    d, ffn = 16, 64
+    sd = {}
+    pre = "bert.encoder.layer.0"
+    sd[f"{pre}.attention.self.query.weight"] = np.zeros((d, d), np.float32)
+    sd[f"{pre}.attention.self.key.weight"] = np.zeros((d, d), np.float32)
+    sd[f"{pre}.attention.self.value.weight"] = np.zeros((d, d), np.float32)
+    sd[f"{pre}.attention.output.dense.weight"] = np.zeros((d, d), np.float32)
+    sd[f"{pre}.intermediate.dense.weight"] = np.zeros((ffn, d), np.float32)
+    sd[f"{pre}.output.dense.weight"] = np.zeros((d, ffn), np.float32)
+    tree = state_dict_to_tree(sd)
+    specs = AutoTP.infer_specs(jax.eval_shape(lambda: tree))
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path): s
+            for path, s in jax.tree_util.tree_flatten_with_path(
+                specs, is_leaf=lambda x: hasattr(x, "index"))[0]}
+    get = lambda frag: next(v for k, v in flat.items() if frag in k)
+    assert tuple(get("self/query")) == (None, "tensor")
+    assert tuple(get("attention/output/dense")) == ("tensor", None)
+    assert tuple(get("intermediate/dense")) == (None, "tensor")
+    # MLP output projection (NOT the attention one) must be row-parallel
+    mlp_out = next(v for k, v in flat.items()
+                   if "output/dense" in k and "attention" not in k)
+    assert tuple(mlp_out) == ("tensor", None)

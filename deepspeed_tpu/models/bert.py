@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 IGNORE_INDEX = -100
@@ -45,10 +46,25 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     activation: str = "gelu"                  # BERT uses exact-erf gelu
     dtype: Any = jnp.bfloat16
-    remat: Any = False               # False/'none' | True/'full'
+    # False/'none' | True/'full' | 'dots' | 'attn' — same policy ladder as the
+    # decoders; 'attn' saves only the flash-attention outputs so the backward
+    # never re-runs the kernel (the policy behind gpt2's headline MFU)
+    remat: Any = False
     use_flash_attention: bool = True
+    # lax.scan unroll factor for the layer loop: >1 trades compile time for
+    # schedule freedom (fewer while-loop iterations and less saved-activation
+    # dynamic-update-slice traffic, which profiles as ~15% of a remat='dots'
+    # step on v5e)
+    scan_unroll: int = 1
+    # MLM head over gathered masked positions only (the original BERT's
+    # gather_indexes: at 15% masking the vocab projection+CE runs on ~1/6 of
+    # the tokens). Static shape: positions are padded/truncated to
+    # max_predictions_per_seq; None = project every position. Loss value is
+    # identical (unmasked positions carry zero weight either way) as long as
+    # no row has more than max_predictions_per_seq labels.
+    max_predictions_per_seq: Optional[int] = None
 
-    VALID_REMAT = (False, None, "none", True, "full")
+    VALID_REMAT = (False, None, "none", True, "full", "dots", "attn")
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -56,8 +72,7 @@ class BertConfig:
         if self.activation not in ("gelu", "gelu_new", "relu"):
             raise ValueError(f"activation {self.activation!r} unknown")
         if self.remat not in self.VALID_REMAT:
-            raise ValueError(f"remat={self.remat!r} not in {self.VALID_REMAT} "
-                             "(BERT has no flash-recompute policies)")
+            raise ValueError(f"remat={self.remat!r} not in {self.VALID_REMAT}")
 
     @property
     def head_dim(self) -> int:
@@ -73,9 +88,17 @@ class BertConfig:
 
     def flops_per_token(self, seq_len: Optional[int] = None) -> float:
         """6N + 12·l·d·s, the same Megatron accounting as the decoders (the
-        reference's BERT TFLOPS numbers use the equivalent formula)."""
+        reference's BERT TFLOPS numbers use the equivalent formula). When the
+        MLM head runs only on gathered masked positions, the head matmuls the
+        model genuinely skips are subtracted — MFU stays honest."""
         s = seq_len or self.n_positions
-        return 6 * self.num_params() + 12 * self.n_layer * self.n_embd * s
+        f = 6 * self.num_params() + 12 * self.n_layer * self.n_embd * s
+        maxp = self.max_predictions_per_seq
+        if maxp is not None and maxp < s:
+            # per-token head work: vocab decode (d·V, tied wte) + transform (d²)
+            head = self.n_embd * self.vocab_size + self.n_embd * self.n_embd
+            f -= 6.0 * head * (1.0 - maxp / s)
+        return f
 
 
 PRESETS = {
@@ -183,7 +206,10 @@ class BertModel:
         q, k, v = jnp.split(qkv, 3, axis=-1)
         to_heads = lambda t: t.reshape(B, T, c.n_head, c.head_dim)
         attn = self._attention(to_heads(q), to_heads(k), to_heads(v),
-                               attention_mask).reshape(B, T, D)
+                               attention_mask)
+        # named so remat='attn' can save exactly this tensor (the only one
+        # whose recompute re-runs the flash kernel)
+        attn = checkpoint_name(attn, "attn_out").reshape(B, T, D)
         attn = attn @ blk["proj_w"].astype(x.dtype) + blk["proj_b"].astype(x.dtype)
         x = self._layer_norm(x + attn, blk["attn_ln_g"], blk["attn_ln_b"])
         h = x @ blk["fc_w"].astype(x.dtype) + blk["fc_b"].astype(x.dtype)
@@ -203,11 +229,20 @@ class BertModel:
         if c.remat in (True, "full"):
             block_fn = jax.checkpoint(
                 block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        elif c.remat == "dots":
+            block_fn = jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        elif c.remat == "attn":
+            block_fn = jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
 
         def scan_body(carry, blk):
             return block_fn(carry, blk, attention_mask), None
 
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"],
+                            unroll=c.scan_unroll)
         return x
 
     def hidden_states(self, params, input_ids, token_type_ids=None,
@@ -242,20 +277,40 @@ class BertModel:
         labels = batch.get("labels", ids)
         x = self._trunk(params, ids, batch.get("token_type_ids"),
                         batch.get("attention_mask"))
-        h = self._mlm_transform(params, x)
         mask = (labels != IGNORE_INDEX)
+        maxp = self.config.max_predictions_per_seq
+        if maxp is not None and maxp < ids.shape[1]:
+            # gather_indexes (original BERT run_pretraining): transform +
+            # vocab projection only at the (padded-static) masked positions.
+            # top_k on the mask is stable, so real positions come first; rows
+            # with fewer than maxp labels pad with zero-weight positions.
+            w, pos = jax.lax.top_k(mask.astype(jnp.int32), maxp)   # (B, maxp)
+            x = jnp.take_along_axis(x, pos[..., None], axis=1)
+            labels = jnp.take_along_axis(jnp.where(mask, labels, 0), pos, axis=1)
+            mask = w.astype(jnp.bool_)
+        h = self._mlm_transform(params, x)
         safe = jnp.where(mask, labels, 0)
         return chunked_lm_loss(h, params["wte"].T.astype(h.dtype), safe,
                                loss_mask=mask, bias=params["decoder_b"])
 
 
 def synthetic_mlm_batch(batch_size: int, seq_len: int, vocab_size: int,
-                        mask_frac: float = 0.15, seed: int = 0):
+                        mask_frac: float = 0.15, seed: int = 0,
+                        max_predictions: Optional[int] = None):
     """Random MLM batch: 15% of positions predicted (HF -100 convention),
-    masked inputs replaced by token 0 (the [MASK] stand-in)."""
+    masked inputs replaced by token 0 (the [MASK] stand-in).
+
+    ``max_predictions`` caps the masked count per row (the original BERT data
+    builder's max_predictions_per_seq truncation) so the gathered MLM head
+    sees every label — without it, Binomial(seq, 0.15) rows routinely exceed
+    ceil(0.15·seq) and the gather path would silently drop the excess."""
     rng = np.random.default_rng(seed)
     ids = rng.integers(4, vocab_size, size=(batch_size, seq_len), dtype=np.int32)
     predict = rng.random((batch_size, seq_len)) < mask_frac
+    if max_predictions is not None:
+        # unmask the excess per row (keep the first max_predictions)
+        excess = np.cumsum(predict, axis=1) > max_predictions
+        predict &= ~excess
     labels = np.where(predict, ids, IGNORE_INDEX).astype(np.int32)
     inputs = np.where(predict, 0, ids).astype(np.int32)
     return {"input_ids": inputs, "labels": labels}

@@ -11,8 +11,6 @@ per-block compute is the base model's own ``_block``.
 
 from __future__ import annotations
 
-from typing import Any, Dict
-
 import jax
 import jax.numpy as jnp
 

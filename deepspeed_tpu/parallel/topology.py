@@ -28,13 +28,20 @@ from jax.sharding import Mesh
 # Canonical axis order for the global mesh.
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+# MiCS sub-group axis (reference zero/mics.py:31): when mics_shard_size is
+# set, the data-parallel world is factored into (DATA_AXIS = replica groups,
+# MICS_AXIS = in-group shard). ZeRO state shards over MICS_AXIS only, so
+# GSPMD's allgather-on-use is confined to the small group; placing 'mics'
+# immediately inside 'data' puts each shard group on contiguous ICI
+# neighbors — the hierarchical intra-node gather MiCS hand-codes.
+MICS_AXIS = "mics"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
-ALL_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+ALL_AXES = (PIPE_AXIS, DATA_AXIS, MICS_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
 
 # Axes over which dense parameters are replicated (ZeRO shards over these).
-DP_AXES = (DATA_AXIS, EXPERT_AXIS)
+DP_AXES = (DATA_AXIS, MICS_AXIS, EXPERT_AXIS)
 
 
 class ProcessTopology:
@@ -119,6 +126,7 @@ def _resolve_mesh_dims(mesh_config, n_devices: int) -> Dict[str, int]:
     dims = {
         PIPE_AXIS: mesh_config.pipe,
         DATA_AXIS: mesh_config.data,
+        MICS_AXIS: getattr(mesh_config, "mics", 1),
         EXPERT_AXIS: mesh_config.expert,
         SEQ_AXIS: mesh_config.seq,
         TENSOR_AXIS: mesh_config.tensor,
@@ -126,7 +134,7 @@ def _resolve_mesh_dims(mesh_config, n_devices: int) -> Dict[str, int]:
     fixed = int(np.prod([v for v in dims.values() if v != -1]))
     if dims[DATA_AXIS] == -1:
         if n_devices % fixed != 0:
-            raise ValueError(f"device count {n_devices} not divisible by pipe*expert*seq*tensor={fixed}")
+            raise ValueError(f"device count {n_devices} not divisible by pipe*mics*expert*seq*tensor={fixed}")
         dims[DATA_AXIS] = n_devices // fixed
     total = int(np.prod(list(dims.values())))
     if total != n_devices:
@@ -181,7 +189,8 @@ class ParallelGrid:
         return self._axis_size(PIPE_AXIS)
 
     def get_data_parallel_world_size(self) -> int:
-        return self._axis_size(DATA_AXIS) * self._axis_size(EXPERT_AXIS)
+        return (self._axis_size(DATA_AXIS) * self._axis_size(MICS_AXIS)
+                * self._axis_size(EXPERT_AXIS))
 
     def get_model_parallel_world_size(self) -> int:
         return self._axis_size(TENSOR_AXIS)
@@ -213,7 +222,9 @@ class ParallelGrid:
 
     def get_data_parallel_rank(self) -> int:
         c = self._my_coord()
-        return getattr(c, DATA_AXIS, 0) * self._axis_size(EXPERT_AXIS) + getattr(c, EXPERT_AXIS, 0)
+        return ((getattr(c, DATA_AXIS, 0) * self._axis_size(MICS_AXIS)
+                 + getattr(c, MICS_AXIS, 0)) * self._axis_size(EXPERT_AXIS)
+                + getattr(c, EXPERT_AXIS, 0))
 
     def get_model_parallel_rank(self) -> int:
         return getattr(self._my_coord(), TENSOR_AXIS, 0)

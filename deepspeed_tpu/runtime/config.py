@@ -137,6 +137,10 @@ class TPUMeshConfig(DeepSpeedConfigModel):
     """
     pipe: int = Field(1, ge=1)
     data: int = Field(-1)
+    # MiCS shard-group axis; normally not set by hand — initialize() factors
+    # the data axis into (data=replica groups, mics=shard) from
+    # zero_optimization.mics_shard_size (reference zero/mics.py:31)
+    mics: int = Field(1, ge=1)
     expert: int = Field(1, ge=1)
     seq: int = Field(1, ge=1)
     tensor: int = Field(1, ge=1)

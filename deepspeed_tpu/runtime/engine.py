@@ -102,9 +102,32 @@ class DeepSpeedEngine:
         # ---- distributed backend / mesh ---------------------------------
         if mpu is not None and hasattr(mpu, "mesh"):
             mesh = mpu.mesh
+            mics = int(getattr(self._config.zero_config, "mics_shard_size", -1) or -1)
+            if mics > 0 and mesh.shape.get("mics", 1) != mics:
+                raise ValueError(
+                    f"mics_shard_size={mics} with a user-supplied mpu mesh: "
+                    "the mesh must already carry a 'mics' axis of that size "
+                    "(build it via parallel.topology.build_mesh with "
+                    "axis_dims={'mics': ...}), or omit mpu so initialize() "
+                    "factors the data axis itself")
             dist.init_distributed(mesh=mesh, verbose=False)
         else:
-            backend = dist.init_distributed(mesh_config=self._config.mesh_config, verbose=False)
+            mesh_cfg = self._config.mesh_config
+            mics = int(getattr(self._config.zero_config, "mics_shard_size", -1) or -1)
+            if mics > 0 and mesh_cfg.mics == 1:
+                # MiCS (ref zero/mics.py:31): factor the data axis into
+                # (data = replica groups, mics = in-group shard) so ZeRO
+                # state shards over the small contiguous group only
+                if mesh_cfg.data != -1:
+                    if mesh_cfg.data % mics:
+                        raise ValueError(
+                            f"mics_shard_size={mics} does not divide the "
+                            f"data axis ({mesh_cfg.data})")
+                    mesh_cfg = mesh_cfg.model_copy(
+                        update={"data": mesh_cfg.data // mics, "mics": mics})
+                else:
+                    mesh_cfg = mesh_cfg.model_copy(update={"mics": mics})
+            backend = dist.init_distributed(mesh_config=mesh_cfg, verbose=False)
             mesh = backend.mesh
         self.mesh = mesh
         self.grid = ParallelGrid(mesh)

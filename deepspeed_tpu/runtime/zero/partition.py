@@ -29,7 +29,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import DATA_AXIS, DP_AXES, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, DP_AXES, EXPERT_AXIS,
+                                             MICS_AXIS, SEQ_AXIS, TENSOR_AXIS)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -171,21 +172,29 @@ def plan_sharding(param_shapes: Any,
         dp_axes = tuple(zc.shard_axes)
     elif zc.mics_shard_size and zc.mics_shard_size > 0:
         # MiCS (ref zero/mics.py:31): shard state within groups of
-        # mics_shard_size, replicate across groups. On a named mesh that is
-        # "shard over the data axis" when the group IS the data axis; a
-        # strict sub-group would need the data axis factored into
-        # (replica, shard) mesh axes at build time — reject loudly rather
-        # than silently shard wider than the user asked.
+        # mics_shard_size, replicate across groups. The engine factors the
+        # data-parallel world into (DATA_AXIS = replica groups, MICS_AXIS =
+        # in-group shard) at mesh build; sharding over MICS_AXIS only then
+        # confines GSPMD's allgather-on-use to the small contiguous group
+        # (the hierarchical intra-node gather the reference hand-codes in
+        # MiCS_AllGatherCoalescedHandle), while grads still psum over the
+        # full (data, mics) product for correctness — the inter-group
+        # allreduce riding the outer links.
+        want = int(zc.mics_shard_size)
+        mics_size = mesh.shape.get(MICS_AXIS, 1)
         data_size = mesh.shape.get(DATA_AXIS, 1)
-        if int(zc.mics_shard_size) != data_size:
+        if mics_size == want:
+            dp_axes = (MICS_AXIS,)
+        elif mics_size == 1 and data_size == want:
+            # group == the whole data axis: MiCS degenerates to plain ZeRO
+            dp_axes = (DATA_AXIS,)
+        else:
             raise ValueError(
-                f"mics_shard_size={zc.mics_shard_size} != data-axis size "
-                f"{data_size}: sub-data-axis MiCS groups need a mesh whose "
-                "data axis is factored into (replica, shard) — build the "
-                "mesh with tpu={'data': <shard_size>, ...} and scale the "
-                "remaining replication onto another axis, or use "
-                "zero_optimization.shard_axes to pick the axes explicitly")
-        dp_axes = (DATA_AXIS,)
+                f"mics_shard_size={want} does not match the mesh: mics axis "
+                f"is {mics_size}, data axis is {data_size}. Pass "
+                "mics_shard_size through ds_config zero_optimization so "
+                "initialize() factors the mesh, or build the mesh with "
+                "tpu={'mics': <shard_size>, ...} explicitly")
     dp_axes = tuple(a for a in dp_axes if mesh.shape.get(a, 1) > 1)
 
     if tp_specs is None:
@@ -213,7 +222,8 @@ def plan_sharding(param_shapes: Any,
     grad_specs = jax.tree.map(grad_spec, param_shapes, tp_specs)
 
     if batch_spec is None:
-        batch_axes = tuple(a for a in (DATA_AXIS, EXPERT_AXIS) if mesh.shape.get(a, 1) > 1)
+        batch_axes = tuple(a for a in (DATA_AXIS, MICS_AXIS, EXPERT_AXIS)
+                           if mesh.shape.get(a, 1) > 1)
         if mesh.shape.get(SEQ_AXIS, 1) > 1:
             # sequence parallelism: tokens dim sharded over 'seq' too
             batch_spec = P(batch_axes if batch_axes else None, SEQ_AXIS)
@@ -241,5 +251,10 @@ def partition_report(plan: ShardingPlan, param_shapes: Any) -> str:
         if any(a in plan.dp_axes for a in axes):
             n_sharded += n
     pct = 100.0 * n_sharded / max(1, n_total)
-    return (f"ZeRO stage {plan.zero_stage}: {n_total/1e6:.1f}M params, "
-            f"{pct:.1f}% dp-sharded over axes {plan.dp_axes}")
+    msg = (f"ZeRO stage {plan.zero_stage}: {n_total/1e6:.1f}M params, "
+           f"{pct:.1f}% dp-sharded over axes {plan.dp_axes}")
+    if plan.dp_axes == (MICS_AXIS,):
+        n_groups = plan.mesh.shape.get(DATA_AXIS, 1)
+        msg += (f" (MiCS: {n_groups} replica groups × "
+                f"{plan.mesh.shape.get(MICS_AXIS, 1)}-way shard)")
+    return msg

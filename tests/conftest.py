@@ -21,6 +21,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_collection_modifyitems(config, items):
+    """Everything not marked slow is smoke: `pytest -m smoke` = the <2min
+    profile, `pytest -m slow` = the long tail, plain `pytest` = both."""
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.smoke)
+
+
 @pytest.fixture(autouse=True)
 def _reset_comm():
     """Each test gets a fresh global comm backend."""

@@ -101,6 +101,25 @@ class TestBertNative:
         losses = [float(engine.train_batch(batch)) for _ in range(6)]
         assert losses[-1] < losses[0], losses
 
+    def test_masked_gather_loss_matches_full(self):
+        """max_predictions_per_seq (gather_indexes) must not change the loss
+        as long as every row has ≤ maxp labels; scan_unroll must not either."""
+        cfg = dataclasses.replace(PRESETS["bert-tiny"], dtype=jnp.float32,
+                                  use_flash_attention=False)
+        batch = synthetic_mlm_batch(4, 64, cfg.vocab_size, seed=3)
+        assert int((batch["labels"] != IGNORE_INDEX).sum(axis=1).max()) <= 20
+        params = BertModel(cfg).init_params(jax.random.PRNGKey(0))
+        full = float(BertModel(cfg).loss(params, batch))
+        gathered = float(BertModel(dataclasses.replace(
+            cfg, max_predictions_per_seq=20)).loss(params, batch))
+        unrolled = float(BertModel(dataclasses.replace(
+            cfg, scan_unroll=2)).loss(params, batch))
+        np.testing.assert_allclose(full, gathered, rtol=1e-6)
+        np.testing.assert_allclose(full, unrolled, rtol=1e-6)
+        # honest MFU: gathered config reports fewer flops than full
+        g = dataclasses.replace(cfg, max_predictions_per_seq=20)
+        assert g.flops_per_token(64) < cfg.flops_per_token(64)
+
     def test_num_params_matches_tree(self):
         cfg = PRESETS["bert-tiny"]
         params = BertModel(cfg).init_params(jax.random.PRNGKey(0))

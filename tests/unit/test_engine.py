@@ -59,6 +59,35 @@ def test_zero_stage_matches_stage0(stage):
     np.testing.assert_allclose(l0, ls, rtol=2e-4, atol=2e-5)
 
 
+def test_mics_matches_plain_zero3():
+    """MiCS (mics_shard_size=2 on an 8-way dp world): initialize() factors
+    the mesh into 4 replica groups × 2-way shard, state shards over the
+    'mics' axis only, and numerics equal plain ZeRO-3 (reference
+    zero/mics.py:31 — placement must not change the math)."""
+    from deepspeed_tpu.runtime.zero.partition import partition_report
+
+    l3, _ = train_losses(base_config(zero_optimization={"stage": 3}), steps=5)
+    lm, em = train_losses(
+        base_config(zero_optimization={"stage": 3, "mics_shard_size": 2}),
+        steps=5)
+    np.testing.assert_allclose(l3, lm, rtol=2e-4, atol=2e-5)
+    assert em.mesh.shape["mics"] == 2
+    assert em.mesh.shape["data"] == 4          # 4 replica groups
+    report = partition_report(em.plan, jax.eval_shape(lambda: em.state.params))
+    assert "4 replica groups" in report and "2-way shard" in report
+    # state is sharded over the small group only: specs carry 'mics', not 'data'
+    from jax.sharding import PartitionSpec as P
+
+    master_axes = set()
+    for spec in jax.tree.leaves(em.plan.master_specs,
+                                is_leaf=lambda x: isinstance(x, P)):
+        for entry in tuple(spec):
+            if entry is None:
+                continue
+            master_axes.update(entry if isinstance(entry, tuple) else (entry,))
+    assert "mics" in master_axes and "data" not in master_axes
+
+
 def test_bf16_trains():
     cfg = base_config(bf16={"enabled": True}, zero_optimization={"stage": 2})
     losses, engine = train_losses(cfg, steps=8)

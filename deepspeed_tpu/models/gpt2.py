@@ -161,7 +161,13 @@ class GPT2Model:
                    "bslongformer": sa.BSLongformerSparsityConfig}[mode]
             self._sparse = sa.SparseSelfAttention(
                 cls(num_heads=self.config.n_head, **d))
-        if jax.default_backend() != "tpu":
+        from deepspeed_tpu.utils import env_flag
+
+        if jax.default_backend() != "tpu" and not env_flag(
+                "DS_TPU_SPARSE_INTERPRET"):
+            # the dense token-level oracle is orders of magnitude faster than
+            # Pallas interpret mode; DS_TPU_SPARSE_INTERPRET=1 forces the real
+            # kernel off-TPU (CI exercises it via the interpret monkeypatch)
             from deepspeed_tpu.ops.pallas.flash_attention import sparse_mha_reference
 
             return sparse_mha_reference(q, k, v,

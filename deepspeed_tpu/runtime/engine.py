@@ -19,6 +19,7 @@ grad sharding constraints; bucket sizes become advisory (SURVEY §7).
 from __future__ import annotations
 
 import inspect
+import math
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -580,6 +581,12 @@ class DeepSpeedEngine:
         cached = getattr(self, "_offload_streamed_cached", None)
         if cached is not None:
             return cached
+        from deepspeed_tpu.utils import env_flag
+        if env_flag("DS_TPU_FORCE_STREAMED_OFFLOAD"):
+            # test hook: exercise the leaf-streamed (and chunked) update on
+            # models small enough to verify numerics against the in-HBM path
+            self._offload_streamed_cached = True
+            return True
         n = sum(l.size for l in jax.tree.leaves(self.state.params))
         # ZeRO shards the fp32 state over the dp axes: the whole-tree
         # stream-in is PER-DEVICE bytes, not global
@@ -639,35 +646,100 @@ class DeepSpeedEngine:
         psh = jax.tree_util.tree_flatten(self.state_shardings.params)[0]
 
         keep = lambda new, old: jnp.where(finite, new, old)
-        token = jnp.float32(0.0)
+        # ordering: each pull chains on a previous chunk's host write-back.
+        # DS_TPU_OFFLOAD_OVERLAP=1 chains on the write TWO steps back
+        # instead (double-buffering, peak = two working sets) — measured
+        # slightly SLOWER on v5e via the axon tunnel (0.149 vs 0.171 MFU on
+        # gpt2-1.3b), so strict serial is the default.
+        token = token_prev = jnp.float32(0.0)
+        # giant leaves (layer-stacked (L, ...) weights are GBs in fp32 — a
+        # gpt2-1.3b fc stack is 1.5G and its streamed update needs ~6 temps
+        # of that size at once, observed OOM on 16G) stream in chunks along
+        # the stack dim; the updated chunk DUSes back into the host-resident
+        # buffer (a host-DMA subrange write, the same mechanism XLA's
+        # activation-offload uses)
+        import os
+
+        from deepspeed_tpu.utils import env_flag
+        chunk_budget = int(os.environ.get("DS_TPU_OFFLOAD_CHUNK_BYTES",
+                                          256 << 20))  # fp32 bytes per chunk
+        def dev_token(x):
+            # ordering token from the DEVICE-side update result: chunk c+1's
+            # pull then depends on chunk c's compute, which transitively
+            # depends on chunk c's pull — the scheduler cannot prefetch the
+            # whole state. (Scalar reads of HOST buffers would order the
+            # write-backs too, but host-memory dynamic-slice emission crashes
+            # the TPU compiler on several stacked-leaf layouts; write-back
+            # DMAs overlapping the next chunk is fine for both correctness
+            # and the peak bound, as buffers free on write completion.)
+            return x.ravel()[0].astype(jnp.float32)
+
+        serial = not env_flag("DS_TPU_OFFLOAD_OVERLAP")
+
+        def advance(new_tok):
+            nonlocal token, token_prev
+            token_prev, token = (new_tok, new_tok) if serial else (token, new_tok)
+
         out_m, out_mu, out_nu, out_p = [], [], [], []
         for i in range(len(m_leaves)):
-            # pull this leaf to HBM. EVERY pull folds in the ordering token
-            # (a scalar read of the previous leaf's host write-back): without
-            # the data dependency the scheduler is free to prefetch all
-            # moment leaves at once, defeating the one-leaf peak bound
             dev = lambda sh: sh.with_memory_kind("device")
-            chain = lambda x: x + token.astype(x.dtype) * 0
-            m = jax.device_put(chain(m_leaves[i]), dev(msh[i]))
-            mu = jax.device_put(chain(mu_leaves[i]), dev(mush[i]))
-            nu = jax.device_put(chain(nu_leaves[i]), dev(nush[i]))
-            m_n, mu_n, nu_n = adam_leaf_update(
-                m, mu, nu, g_leaves[i], lr, b1, b2, eps, wd, adam_w_mode,
-                bc1, bc2)
-            m_n = keep(m_n, m)
-            mu_n = keep(mu_n, mu)
-            nu_n = keep(nu_n, nu)
-            p_n = m_n.astype(p_leaves[i].dtype)
-            # write back to host placements
-            hm = jax.device_put(m_n, msh[i])
-            hmu = jax.device_put(mu_n, mush[i])
-            hnu = jax.device_put(nu_n, nush[i])
-            hp = jax.device_put(p_n, psh[i])
+            leaf = m_leaves[i]
+            n_chunks = 1
+            # only ndim>=3 (layer-stacked) leaves chunk: their leading dim is
+            # outside the (8,128) tile so host-DMA slices stay tile-aligned;
+            # slicing a 2D table's row dim (e.g. a 50257-row vocab embedding)
+            # hits sublane misalignment in the TPU DUS emitter
+            if leaf.ndim >= 3:
+                want = max(1, math.ceil(leaf.size * 4 / chunk_budget))
+                # only equal chunks (static shapes)
+                n_chunks = next((c for c in range(min(want, leaf.shape[0]),
+                                                  leaf.shape[0] + 1)
+                                 if leaf.shape[0] % c == 0), 1)
+            rows = leaf.shape[0] // n_chunks if leaf.ndim >= 1 and n_chunks > 1 else 0
+
+            def pull_update_writeback(sl):
+                """One pull→Adam→write-back round on `sl(leaf)`. EVERY pull
+                folds in the ordering token (a scalar read chained off a
+                previous update): without the data dependency the scheduler
+                is free to prefetch all moment leaves at once, defeating the
+                bounded-peak guarantee."""
+                chain = lambda x: x + token_prev.astype(x.dtype) * 0
+                m = jax.device_put(chain(sl(m_leaves[i])), dev(msh[i]))
+                mu = jax.device_put(chain(sl(mu_leaves[i])), dev(mush[i]))
+                nu = jax.device_put(chain(sl(nu_leaves[i])), dev(nush[i]))
+                m_n, mu_n, nu_n = adam_leaf_update(
+                    m, mu, nu, sl(g_leaves[i]), lr, b1, b2, eps, wd,
+                    adam_w_mode, bc1, bc2)
+                m_n = keep(m_n, m)
+                mu_n = keep(mu_n, mu)
+                nu_n = keep(nu_n, nu)
+                p_n = m_n.astype(p_leaves[i].dtype)
+                advance(dev_token(m_n))
+                return (jax.device_put(m_n, msh[i]), jax.device_put(mu_n, mush[i]),
+                        jax.device_put(nu_n, nush[i]), jax.device_put(p_n, psh[i]))
+
+            if n_chunks == 1:
+                hm, hmu, hnu, hp = pull_update_writeback(lambda x: x)
+            else:
+                hm, hmu, hnu = m_leaves[i], mu_leaves[i], nu_leaves[i]
+                hp = p_leaves[i]
+                for c in range(n_chunks):
+                    start = c * rows
+                    cm, cmu, cnu, cp = pull_update_writeback(
+                        lambda x: jax.lax.dynamic_slice_in_dim(x, start, rows, 0))
+                    dus = jax.lax.dynamic_update_slice_in_dim
+                    hm = dus(hm, cm, start, 0)
+                    hmu = dus(hmu, cmu, start, 0)
+                    hnu = dus(hnu, cnu, start, 0)
+                    hp = dus(hp, cp, start, 0)
+                hm = jax.device_put(hm, msh[i])
+                hmu = jax.device_put(hmu, mush[i])
+                hnu = jax.device_put(hnu, nush[i])
+                hp = jax.device_put(hp, psh[i])
             out_m.append(hm)
             out_mu.append(hmu)
             out_nu.append(hnu)
             out_p.append(hp)
-            token = hm.ravel()[0].astype(jnp.float32)
 
         new_master = jax.tree_util.tree_unflatten(m_def, out_m)
         new_opt = AdamState(count=keep(count, opt_in.count),

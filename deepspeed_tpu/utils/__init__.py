@@ -1,1 +1,7 @@
+import os
 
+
+def env_flag(name: str) -> bool:
+    """Boolean env knob: unset, empty, "0", and "false" are OFF — so a user
+    exporting FLAG=0 to disable a behavior does not accidentally enable it."""
+    return os.environ.get(name, "").strip().lower() not in ("", "0", "false")

@@ -1,0 +1,177 @@
+"""Megatron 2D (tp × pp) checkpoint grid reshaping.
+
+Counterpart of the reference's ``deepspeed/checkpoint/reshape_meg_2d.py``
+(meg_2d_parallel_map :9, _reshape_tp_dimension :56, _reshape_pp_dimension
+:68): a (pp, tp) grid of state-dict shards is reshaped to a new (pp', tp')
+grid by merging/splitting tensor shards along each parameter's partition
+dimension. Numpy-native — shards are {name: ndarray} dicts; torch tensors
+convert on entry.
+
+Partition-dimension rules follow Megatron naming: row-parallel weights
+(attention output ``self_attention.dense.weight``, MLP down
+``mlp.dense_4h_to_h.weight``) concat on dim 1; replicated tensors
+(layernorms, biases of row-parallel layers) must be identical across tp and
+pass through; everything else partitioned on dim 0 (column-parallel weights
++ their biases, vocab-sharded embeddings).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+# replicated across tp (reference SEQUENTIAL_LAYERS): merged by identity
+SEQUENTIAL_LAYERS = [
+    "input_layernorm.weight", "input_layernorm.bias",
+    "post_attention_layernorm.weight", "post_attention_layernorm.bias",
+    "final_layernorm.weight", "final_layernorm.bias",
+    "self_attention.dense.bias", "mlp.dense_4h_to_h.bias",
+    "attention.dense.bias",
+    # Megatron's position embedding is a plain nn.Embedding, replicated
+    # across tp (only the WORD embedding is vocab-parallel)
+    "position_embeddings.weight",
+]
+# bare final-norm file keys: replicated, but matched by EQUALITY only — a
+# suffix match on "weight" would classify every weight as replicated
+SEQUENTIAL_EXACT = ["weight", "bias"]
+# concat dim overrides (reference LAYER_CONCAT_DIM); default is dim 0
+LAYER_CONCAT_DIM = {"self_attention.dense.weight": 1,
+                    "attention.dense.weight": 1,
+                    "mlp.dense_4h_to_h.weight": 1}
+
+
+def _endswith_any(name: str, suffixes) -> bool:
+    return any(name == s or name.endswith("." + s) for s in suffixes)
+
+
+def partition_dim(name: str) -> Optional[int]:
+    """None = replicated; else the tp-partition dimension."""
+    if name in SEQUENTIAL_EXACT or _endswith_any(name, SEQUENTIAL_LAYERS):
+        return None
+    for key, dim in LAYER_CONCAT_DIM.items():
+        if name == key or name.endswith("." + key):
+            return dim
+    return 0
+
+
+class meg_2d_parallel_map:
+    """(pp, tp) → list-of-payloads map (reference reshape_meg_2d.py:9)."""
+
+    def __init__(self, pp_degree: int, tp_degree: int):
+        self.pp_degree = int(pp_degree)
+        self.tp_degree = int(tp_degree)
+        self.map: Dict[str, List] = {}
+
+    def simple_init(self):
+        for pp in range(self.pp_degree):
+            for tp in range(self.tp_degree):
+                self.add_data(pp, tp, [pp * self.tp_degree + tp])
+
+    def _key(self, pp: int, tp: int) -> str:
+        self._validate_indices(pp, tp)
+        return f"{pp},{tp}"
+
+    def _validate_indices(self, pp: int, tp: int):
+        assert 0 <= pp < self.pp_degree, f"pp {pp} out of [0, {self.pp_degree})"
+        assert 0 <= tp < self.tp_degree, f"tp {tp} out of [0, {self.tp_degree})"
+
+    def add_data(self, pp_index: int, tp_index: int, data) -> None:
+        key = self._key(pp_index, tp_index)
+        self.map.setdefault(key, [])
+        self.map[key].extend(data if isinstance(data, list) else [data])
+
+    def get_data(self, pp_index: Optional[int] = None,
+                 tp_index: Optional[int] = None) -> List:
+        pps = [pp_index] if pp_index is not None else range(self.pp_degree)
+        tps = [tp_index] if tp_index is not None else range(self.tp_degree)
+        out = []
+        for pp in pps:
+            for tp in tps:
+                out.extend(self.map.get(self._key(pp, tp), []))
+        return out
+
+
+def _np(x):
+    if hasattr(x, "detach"):
+        x = x.detach().cpu()
+        if str(x.dtype) == "torch.bfloat16":
+            # numpy has no bf16: widen (exact) before .numpy()
+            x = x.float()
+        x = x.numpy()
+    return np.asarray(x)
+
+
+def merge_tp_shards(shards: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    """tp shards of one stage → the unsharded stage state dict."""
+    out = {}
+    for name in shards[0]:
+        dim = partition_dim(name)
+        parts = [_np(s[name]) for s in shards]
+        if dim is None or parts[0].ndim == 0:
+            for p in parts[1:]:
+                if not np.allclose(parts[0], p):
+                    raise ValueError(f"replicated tensor {name} differs "
+                                     "across tp shards")
+            out[name] = parts[0]
+        else:
+            out[name] = np.concatenate(parts, axis=dim)
+    return out
+
+
+def split_tp_shards(full: Dict[str, np.ndarray], tp_degree: int) -> List[Dict]:
+    """Inverse of merge: the unsharded stage → tp_degree shards."""
+    shards = [dict() for _ in range(tp_degree)]
+    for name, arr in full.items():
+        arr = _np(arr)
+        dim = partition_dim(name)
+        if dim is None or arr.ndim == 0:
+            for s in shards:
+                s[name] = arr
+        else:
+            if arr.shape[dim] % tp_degree:
+                raise ValueError(f"{name} dim {dim} size {arr.shape[dim]} not "
+                                 f"divisible by tp {tp_degree}")
+            for t, piece in enumerate(np.split(arr, tp_degree, axis=dim)):
+                shards[t][name] = piece
+    return shards
+
+
+def reshape_meg_2d_parallel(old_pp: int, old_tp: int, new_pp: int, new_tp: int,
+                            get_shard: Callable[[int, int], Dict],
+                            layers_per_pp: Optional[List[List[str]]] = None):
+    """Reshape a (old_pp, old_tp) grid to (new_pp, new_tp).
+
+    ``get_shard(pp, tp)`` returns that coordinate's {name: array} state.
+    Stage contents are merged tp-wise, the pp dimension is re-chunked by
+    re-distributing the per-stage dicts (keys must be disjoint across pp,
+    as in Megatron layer files), and the result is re-split to new_tp.
+    Returns a new meg_2d_parallel_map whose payloads are state dicts.
+    """
+    if new_pp != old_pp:
+        if old_pp % new_pp and new_pp % old_pp:
+            raise ValueError(f"pp reshape {old_pp}→{new_pp} must nest")
+    merged_stages = []
+    for pp in range(old_pp):
+        merged_stages.append(merge_tp_shards(
+            [get_shard(pp, tp) for tp in range(old_tp)]))
+    # pp re-chunk: group or split whole stages (key-disjoint unions)
+    if new_pp == old_pp:
+        stages = merged_stages
+    elif old_pp % new_pp == 0:
+        k = old_pp // new_pp
+        stages = []
+        for i in range(new_pp):
+            d = {}
+            for j in range(k):
+                d.update(merged_stages[i * k + j])
+            stages.append(d)
+    else:
+        raise NotImplementedError(
+            f"pp split {old_pp}→{new_pp} needs per-layer file mapping; merge "
+            "to pp=1 then re-partition with the pipeline module instead")
+    out = meg_2d_parallel_map(new_pp, new_tp)
+    for pp, stage in enumerate(stages):
+        for tp, shard in enumerate(split_tp_shards(stage, new_tp)):
+            out.add_data(pp, tp, [shard])
+    return out

@@ -146,6 +146,24 @@ class InferenceEngine:
             if params is not None:
                 self.params = jax.jit(
                     lambda p: jax.tree.map(to_dtype, p), out_shardings=shardings)(params)
+            elif self._config.checkpoint:
+                # serve a TRAINING checkpoint at any tp: orbax restores the
+                # params subtree straight into the serving shardings (the
+                # reference's sharded-checkpoint loading / mp-reshard,
+                # inference/engine.py:336-506)
+                from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+                    load_inference_params
+
+                shapes = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+                abstract = jax.tree.map(
+                    lambda x, s: jax.ShapeDtypeStruct(
+                        x.shape,
+                        self.dtype if jnp.issubdtype(x.dtype, jnp.floating) else x.dtype,
+                        sharding=s),
+                    shapes, shardings)
+                self.params = load_inference_params(
+                    self._config.checkpoint, abstract,
+                    tag=self._config.checkpoint_config.get("tag"))
             else:
                 self.params = jax.jit(
                     lambda: jax.tree.map(to_dtype, model.init_params(jax.random.PRNGKey(0))),

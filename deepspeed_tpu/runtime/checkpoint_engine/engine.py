@@ -115,6 +115,10 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             "client_state": client_state or {},
             "zero_stage": engine.zero_stage,
             "dp_world_size": engine.dp_world_size,
+            # curriculum data sampler (reference ds_sampler state in
+            # client_sd): rng + draw order + position → mid-epoch resume
+            "data_sampler": (engine._data_sampler.state_dict()
+                             if getattr(engine, "_data_sampler", None) else None),
         }
         with open(os.path.join(path, "client_state.json"), "w") as f:
             json.dump(meta, f, default=str)
@@ -139,6 +143,51 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             _advance_latest()
     log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
     return True
+
+
+def load_inference_params(load_dir: str, abstract_params: Any,
+                          tag: Optional[str] = None) -> Any:
+    """Restore ONLY the params subtree of a training checkpoint, directly
+    into the SERVING shardings — the TP-reshard serving load (reference
+    inference/engine.py:336-506 loads pre-sharded checkpoints / re-slices
+    qkv+mlp for the serving mp world; here the reshard is orbax restoring
+    into whatever NamedShardings the inference engine computed, so a tp=4
+    training checkpoint serves at tp=2 or tp=1 unchanged).
+
+    ``load_dir``: a training save_dir (tag via ``tag`` or its 'latest'
+    file), or a tag directory itself. ``abstract_params``: pytree of
+    ShapeDtypeStruct carrying the serving shardings (dtype casts apply on
+    load). Returns the concrete params pytree.
+    """
+    wait_for_pending_saves()
+    import orbax.checkpoint as ocp
+
+    if os.path.isdir(os.path.join(load_dir, "state")):
+        path = os.path.abspath(load_dir)          # a tag dir directly
+    else:
+        if tag is None:
+            latest = os.path.join(os.path.abspath(load_dir), "latest")
+            if not os.path.isfile(latest):
+                raise FileNotFoundError(
+                    f"no 'latest' file in {load_dir}; pass tag= or a tag dir")
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = _ckpt_dir(load_dir, tag)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint {path} not found")
+
+    # same key scheme as _flatten_state (which prefixes TrainState fields):
+    # the params subtree's keys are exactly "params/<leaf path>"
+    flat_abs = {f"params/{k}": v
+                for k, v in _flatten_state(abstract_params).items()}
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored_flat = ckptr.restore(
+            os.path.join(path, "state"), item=dict(flat_abs), transforms={},
+            restore_args=ocp.checkpoint_utils.construct_restore_args(flat_abs))
+    log_dist(f"loaded serving params from {path}", ranks=[0])
+    return _unflatten_like(abstract_params,
+                           {k[len("params/"):]: v
+                            for k, v in restored_flat.items()})
 
 
 def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
@@ -185,6 +234,13 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.micro_steps = meta.get("micro_steps", 0)
         if engine.lr_scheduler is not None and meta.get("lr_scheduler"):
             engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        sampler_sd = meta.get("data_sampler")
+        if sampler_sd:
+            if getattr(engine, "_data_sampler", None) is not None:
+                engine._data_sampler.load_state_dict(sampler_sd)
+            else:
+                # loader not built yet: deepspeed_io applies it on creation
+                engine._pending_sampler_state = sampler_sd
     # host-side step counter drives curriculum difficulty + logging cadence:
     # resume it from the restored device step, or a resumed run would replay
     # the whole curriculum ramp from min difficulty

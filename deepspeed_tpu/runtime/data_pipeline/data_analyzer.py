@@ -1,0 +1,142 @@
+"""Offline per-sample metric analysis → curriculum index files.
+
+Counterpart of the reference's ``data_sampling/data_analyzer.py``
+(DataAnalyzer :417 LoC): a map step computes each metric over every sample
+(shardable across workers by sample range), a reduce step merges worker
+outputs and buckets samples by metric value. Output files per metric
+``<save>/<metric>/``:
+
+  <metric>_sample_to_metric     row i  = [metric value of sample i]
+  <metric>_index_to_metric      row k  = [k-th distinct metric value, ascending]
+  <metric>_index_to_sample      row k  = sample indices whose value is that
+
+which are exactly what the curriculum sampler consumes (value- or
+percentile-based difficulty). TPU-shaped: numpy end to end, no torch
+dataloader — a "sample" is whatever ``dataset[i]`` returns and metric fns
+map it to an integer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, find_fit_int_dtype)
+from deepspeed_tpu.utils.logging import logger
+
+
+def _metric_dir(save_path: str, name: str) -> str:
+    d = os.path.join(save_path, name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class DataAnalyzer:
+    """Map/reduce per-sample metric analysis.
+
+    ``metric_functions``: sample → int (non-negative). ``num_workers`` /
+    ``worker_id`` shard the map step by contiguous sample ranges; run_reduce
+    merges every worker's output (single-process is num_workers=1).
+    """
+
+    def __init__(self, dataset, metric_names: Sequence[str],
+                 metric_functions: Sequence[Callable],
+                 save_path: str, num_workers: int = 1, worker_id: int = 0,
+                 metric_types: Optional[Sequence[str]] = None):
+        assert len(metric_names) == len(metric_functions)
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.metric_types = list(metric_types or
+                                 ["single_value_per_sample"] * len(metric_names))
+        for t in self.metric_types:
+            if t != "single_value_per_sample":
+                raise NotImplementedError(
+                    f"metric_type {t!r}: only single_value_per_sample is "
+                    "built (the reference's accumulate_value reduces to a "
+                    "running total the curriculum never samples from)")
+        self.save_path = save_path
+        self.num_workers = int(num_workers)
+        self.worker_id = int(worker_id)
+
+    # ------------------------------------------------------------------- map
+    def _my_range(self):
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        lo = self.worker_id * per
+        return lo, min(n, lo + per)
+
+    def run_map(self) -> None:
+        lo, hi = self._my_range()
+        values = {m: np.zeros(hi - lo, dtype=np.int64) for m in self.metric_names}
+        for i in range(lo, hi):
+            sample = self.dataset[i]
+            for m, fn in zip(self.metric_names, self.metric_functions):
+                values[m][i - lo] = int(fn(sample))
+        for m in self.metric_names:
+            d = _metric_dir(self.save_path, m)
+            b = MMapIndexedDatasetBuilder(
+                os.path.join(d, f"worker{self.worker_id}_sample_to_metric"),
+                dtype=np.int64)
+            for v in values[m]:
+                b.add_item([v])
+            b.finalize()
+        logger.info(f"DataAnalyzer map: worker {self.worker_id} analyzed "
+                    f"samples [{lo}, {hi}) for {self.metric_names}")
+
+    # ---------------------------------------------------------------- reduce
+    def run_reduce(self) -> None:
+        n = len(self.dataset)
+        for m in self.metric_names:
+            d = _metric_dir(self.save_path, m)
+            vals = []
+            for w in range(self.num_workers):
+                ds = MMapIndexedDataset(os.path.join(d, f"worker{w}_sample_to_metric"))
+                vals.append(np.concatenate([ds[i] for i in range(len(ds))])
+                            if len(ds) else np.zeros(0, np.int64))
+            values = np.concatenate(vals)
+            assert values.size == n, f"{values.size} values for {n} samples"
+
+            s2m = MMapIndexedDatasetBuilder(
+                os.path.join(d, f"{m}_sample_to_metric"), dtype=np.int64)
+            for v in values:
+                s2m.add_item([v])
+            s2m.finalize()
+
+            # one argsort gives both the ascending distinct values and the
+            # per-value sample groups (an equality scan per distinct value
+            # would be O(n·distinct) — degenerate for high-cardinality
+            # metrics)
+            order = np.argsort(values, kind="stable")
+            sorted_vals = values[order]
+            distinct, starts = np.unique(sorted_vals, return_index=True)
+            bounds = np.append(starts, sorted_vals.size)
+            idx_dtype = find_fit_int_dtype(0, max(1, n - 1))
+            i2m = MMapIndexedDatasetBuilder(
+                os.path.join(d, f"{m}_index_to_metric"), dtype=np.int64)
+            i2s = MMapIndexedDatasetBuilder(
+                os.path.join(d, f"{m}_index_to_sample"), dtype=idx_dtype)
+            for k, v in enumerate(distinct):
+                i2m.add_item([v])
+                i2s.add_item(np.sort(order[bounds[k]:bounds[k + 1]]).astype(idx_dtype))
+            i2m.finalize()
+            i2s.finalize()
+            logger.info(f"DataAnalyzer reduce: metric {m}: {distinct.size} "
+                        f"distinct values over {n} samples → {d}")
+
+    def run(self) -> None:
+        """Single-process convenience: map then reduce."""
+        self.run_map()
+        self.run_reduce()
+
+
+def metric_paths(save_path: str, metric: str) -> Dict[str, str]:
+    d = os.path.join(save_path, metric)
+    return {
+        "sample_path": os.path.join(d, f"{metric}_index_to_sample"),
+        "metric_path": os.path.join(d, f"{metric}_index_to_metric"),
+        "sample_to_metric_path": os.path.join(d, f"{metric}_sample_to_metric"),
+    }

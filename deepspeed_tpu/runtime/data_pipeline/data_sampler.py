@@ -1,0 +1,175 @@
+"""Metric-based curriculum data sampler — difficulty-gated sample order.
+
+Counterpart of the reference's ``data_sampling/data_sampler.py``
+(DeepSpeedDataSampler :338 LoC): per-metric curriculum schedulers admit
+samples whose analyzed metric is within the current difficulty (value-based:
+metric ≤ difficulty; percentile-based: the easiest d% of the bucketed
+order), newly admitted samples are shuffled into the draw order, and the
+sampler is fully resumable (rng + positions in state_dict). TPU-shaped
+differences: the sampler yields GLOBAL batch index arrays — per-host
+slicing is the dataloader's job (make_array_from_process_local_data
+assembles the global batch), so there is no rank/group plumbing here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import MMapIndexedDataset
+from deepspeed_tpu.utils.logging import logger
+
+VALUE_BASED = "value"
+PERCENTILE_BASED = "percentile"
+SINGLE_CLUSTER = "single_cluster"
+SCHEDULE_BASED = "schedule_based"
+
+
+class _MetricState:
+    def __init__(self, name: str, cfg: Dict):
+        self.name = name
+        self.difficulty_type = cfg.get("difficulty_type", VALUE_BASED)
+        if self.difficulty_type not in (VALUE_BASED, PERCENTILE_BASED):
+            raise ValueError(f"difficulty_type {self.difficulty_type!r}")
+        self.clustering_type = cfg.get("clustering_type", SCHEDULE_BASED)
+        self.scheduler = CurriculumScheduler(cfg)
+        if self.clustering_type == SINGLE_CLUSTER:
+            self.index_to_sample = self.index_to_metric = None
+        else:
+            self.index_to_sample = MMapIndexedDataset(cfg["index_to_sample_path"])
+            self.index_to_metric = MMapIndexedDataset(cfg["index_to_metric_path"])
+
+    def admitted(self, difficulty: int, total: int) -> np.ndarray:
+        """Sample ids admitted at ``difficulty`` (ascending metric order)."""
+        if self.clustering_type == SINGLE_CLUSTER:
+            return np.arange(total, dtype=np.int64)
+        rows = len(self.index_to_sample)
+        if self.difficulty_type == VALUE_BASED:
+            take = [self.index_to_sample[k] for k in range(rows)
+                    if int(self.index_to_metric[k][0]) <= difficulty]
+        else:
+            # percentile d admits the easiest d% of samples in bucket order
+            n_admit = int(np.ceil(total * difficulty / 100.0))
+            take, count = [], 0
+            for k in range(rows):
+                row = self.index_to_sample[k]
+                if count + len(row) <= n_admit:
+                    take.append(row)
+                    count += len(row)
+                else:
+                    take.append(row[:max(0, n_admit - count)])
+                    break
+        return (np.concatenate(take).astype(np.int64) if take
+                else np.zeros(0, np.int64))
+
+
+class DeepSpeedDataSampler:
+    """Iterator of global-batch sample-index arrays under a metric curriculum."""
+
+    def __init__(self, data_efficiency_config: Dict, one_epoch_total_samples: int,
+                 global_batch_size: int, drop_last: bool = True):
+        self.total_samples = int(one_epoch_total_samples)
+        self.global_batch_size = int(global_batch_size)
+        self.drop_last = drop_last
+        cfg = data_efficiency_config
+        self.num_epochs = int(cfg.get("data_sampling", {}).get("num_epochs", 1))
+        self.np_rng = np.random.default_rng(int(cfg.get("seed", 1234)))
+        cl = cfg.get("data_sampling", {}).get("curriculum_learning", {})
+        self.curriculum_enabled = bool(cl.get("enabled"))
+        self.metrics: List[_MetricState] = []
+        if self.curriculum_enabled:
+            for name, mcfg in cl.get("curriculum_metrics", {}).items():
+                self.metrics.append(_MetricState(name, dict(mcfg)))
+        self.curriculum_step = 0
+        self.consumed_samples = 0
+        self._admitted = np.zeros(0, np.int64)   # draw order (shuffled)
+        self._pos = 0
+        self._in_order = set()
+        self._last_difficulties = None   # skip the index scan when unchanged
+
+    def __len__(self) -> int:
+        return self.total_samples * self.num_epochs
+
+    # ------------------------------------------------------------- curriculum
+    def _current_admitted(self, diffs) -> np.ndarray:
+        sets = None
+        for m, d in zip(self.metrics, diffs):
+            adm = m.admitted(d, self.total_samples)
+            sets = adm if sets is None else np.intersect1d(sets, adm,
+                                                           assume_unique=False)
+        if sets is None:
+            sets = np.arange(self.total_samples, dtype=np.int64)
+        return sets
+
+    def _advance_curriculum(self) -> None:
+        self.curriculum_step += 1
+        # the index scan is O(dataset): run it only when some metric's
+        # difficulty actually moved (after saturation every batch would
+        # otherwise re-read the whole mmap index) or everything is admitted
+        if len(self._in_order) >= self.total_samples:
+            for m in self.metrics:
+                m.scheduler.update_difficulty(self.curriculum_step)
+            return
+        diffs = tuple(m.scheduler.update_difficulty(self.curriculum_step)
+                      for m in self.metrics)
+        if diffs == self._last_difficulties and self._admitted.size:
+            return
+        self._last_difficulties = diffs
+        adm = self._current_admitted(diffs)
+        fresh = np.asarray([s for s in adm if int(s) not in self._in_order],
+                           dtype=np.int64)
+        if fresh.size:
+            self.np_rng.shuffle(fresh)
+            self._admitted = np.concatenate([self._admitted, fresh])
+            self._in_order.update(int(s) for s in fresh)
+
+    # --------------------------------------------------------------- iterator
+    def __iter__(self) -> Iterator[np.ndarray]:
+        return self
+
+    def __next__(self) -> np.ndarray:
+        if self.consumed_samples >= len(self):
+            raise StopIteration
+        self._advance_curriculum()
+        if self._admitted.size == 0:
+            raise RuntimeError("curriculum admitted zero samples at minimum "
+                               "difficulty; lower min_difficulty")
+        batch = []
+        need = self.global_batch_size
+        while need > 0:
+            if self._pos >= self._admitted.size:
+                # epoch over the admitted set: reshuffle and wrap
+                order = self._admitted.copy()
+                self.np_rng.shuffle(order)
+                self._admitted = order
+                self._pos = 0
+            take = min(need, self._admitted.size - self._pos)
+            batch.append(self._admitted[self._pos:self._pos + take])
+            self._pos += take
+            need -= take
+        self.consumed_samples += self.global_batch_size
+        return np.concatenate(batch)
+
+    # ------------------------------------------------------------------ state
+    def state_dict(self) -> Dict:
+        return {
+            "curriculum_step": self.curriculum_step,
+            "consumed_samples": self.consumed_samples,
+            "rng_state": self.np_rng.bit_generator.state,
+            "admitted_order": self._admitted.tolist(),
+            "position": self._pos,
+        }
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.curriculum_step = int(sd["curriculum_step"])
+        self.consumed_samples = int(sd["consumed_samples"])
+        self.np_rng.bit_generator.state = sd["rng_state"]
+        self._admitted = np.asarray(sd["admitted_order"], dtype=np.int64)
+        self._pos = int(sd["position"])
+        self._in_order = set(int(s) for s in self._admitted)
+        for m in self.metrics:
+            m.scheduler.update_difficulty(self.curriculum_step)
+        logger.info(f"DeepSpeedDataSampler resumed at curriculum step "
+                    f"{self.curriculum_step}, {self.consumed_samples} consumed")

@@ -59,15 +59,21 @@ def curriculum_config_from_ds(pd: Dict) -> Dict:
         # newer data_efficiency format nests per-metric configs; the seqlen
         # metric block carries the schedule (reference data_efficiency docs)
         metrics = cl.get("curriculum_metrics", {})
-        if "seqlen" in metrics:
+        # metrics carrying analyzer index files are SAMPLING metrics — they
+        # drive DeepSpeedDataSampler through deepspeed_io, not truncation
+        file_based = {n for n, m in metrics.items()
+                      if "index_to_sample_path" in m
+                      or m.get("clustering_type") == "single_cluster"}
+        if "seqlen" in metrics and "seqlen" not in file_based:
             m = dict(metrics["seqlen"])
             m.setdefault("curriculum_type", "seqlen")
             return {**m, "enabled": True}
-        if metrics:
+        if metrics and not file_based:
             from deepspeed_tpu.utils.logging import logger
 
             logger.warning(f"curriculum metrics {sorted(metrics)} unsupported "
-                           "on this build (only 'seqlen'); curriculum disabled")
+                           "for truncation (only 'seqlen'); curriculum "
+                           "truncation disabled")
             return {}
         if "min_difficulty" in cl:      # flat (non-metric) schedule block
             return cl

@@ -63,8 +63,11 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.epoch = 0
         self.data_sampler = data_sampler
-        self.len = len(dataset) // self.batch_size if drop_last else \
-            -(-len(dataset) // self.batch_size)
+        if data_sampler is not None:
+            self.len = len(data_sampler) // self.batch_size
+        else:
+            self.len = len(dataset) // self.batch_size if drop_last else \
+                -(-len(dataset) // self.batch_size)
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -73,13 +76,22 @@ class DeepSpeedDataLoader:
         return self.len
 
     def __iter__(self):
+        nproc = jax.process_count()
+        pid = jax.process_index()
+        if self.data_sampler is not None:
+            # curriculum sampler drives the GLOBAL index order (reference
+            # DeepSpeedDataSampler role); it is stateful and resumable, so
+            # iteration continues from its checkpointed position
+            for idx in self.data_sampler:
+                if nproc > 1:
+                    idx = idx[pid::nproc]
+                yield self.collate_fn([self.dataset[int(i)] for i in idx])
+            return
         n = len(self.dataset)
         order = np.arange(n)
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             rng.shuffle(order)
-        nproc = jax.process_count()
-        pid = jax.process_index()
         for b in range(self.len):
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
             if len(idx) < self.batch_size and self.drop_last:

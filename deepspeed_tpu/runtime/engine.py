@@ -1229,12 +1229,45 @@ class DeepSpeedEngine:
         return jax.tree.map(np.asarray, gathered)
 
     # ------------------------------------------------------------ dataloader
-    def deepspeed_io(self, dataset, batch_size=None, route=None, **kwargs):
+    def deepspeed_io(self, dataset, batch_size=None, route=None,
+                     data_sampler=None, **kwargs):
         from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
 
-        return DeepSpeedDataLoader(dataset,
-                                   batch_size=batch_size or self.train_batch_size(),
-                                   collate_fn=self.collate_fn)
+        bs = batch_size or self.train_batch_size()
+        if (data_sampler is None and route in (None, "train")
+                and getattr(self, "_data_sampler", None) is None):
+            # metric-based curriculum sampling (reference DeepSpeedDataSampler,
+            # data_sampling/data_sampler.py): engaged when the data_efficiency
+            # block carries curriculum metrics with analyzer index files —
+            # distinct from the seqlen-TRUNCATION curriculum, which has no
+            # per-sample index files. Eval loaders (route='eval') and repeat
+            # calls never build or overwrite the training sampler — its
+            # position is checkpointed state.
+            de = self._config.data_efficiency_config or {}
+            cl = de.get("data_sampling", {}).get("curriculum_learning", {})
+            metrics = cl.get("curriculum_metrics", {})
+            file_based = {n: m for n, m in metrics.items()
+                          if "index_to_sample_path" in m
+                          or m.get("clustering_type") == "single_cluster"}
+            if (de.get("enabled", True) and cl.get("enabled") and file_based
+                    and de.get("data_sampling", {}).get("enabled", True)):
+                from deepspeed_tpu.runtime.data_pipeline.data_sampler import \
+                    DeepSpeedDataSampler
+
+                cfg = dict(de)
+                cfg["data_sampling"] = dict(de["data_sampling"])
+                cfg["data_sampling"]["curriculum_learning"] = {
+                    **cl, "curriculum_metrics": file_based}
+                data_sampler = DeepSpeedDataSampler(cfg, len(dataset), bs)
+                pending = getattr(self, "_pending_sampler_state", None)
+                if pending:
+                    data_sampler.load_state_dict(pending)
+                    self._pending_sampler_state = None
+        if data_sampler is not None:
+            self._data_sampler = data_sampler
+        return DeepSpeedDataLoader(dataset, batch_size=bs,
+                                   collate_fn=self.collate_fn,
+                                   data_sampler=data_sampler)
 
     # ------------------------------------------------------------ checkpoint
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,

@@ -81,6 +81,44 @@ def test_inference_tp2_matches_tp1():
     np.testing.assert_array_equal(out1, out2)
 
 
+def test_serve_training_checkpoint_at_different_tp(tmp_path):
+    """Serving TP reshard (reference inference/engine.py:336-506): a
+    checkpoint SAVED at tp=4 must serve at tp=2 and tp=1 with identical
+    logits — init_inference loads the params subtree straight into the
+    serving shardings."""
+    from deepspeed_tpu.parallel.topology import build_mesh
+
+    comm.cdb = None
+    mesh4 = build_mesh(axis_dims={"pipe": 1, "data": 2, "expert": 1,
+                                  "seq": 1, "tensor": 4})
+    comm.init_distributed(mesh=mesh4, verbose=False)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2Model(TINY),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}, "steps_per_print": 0})
+    batch = synthetic_lm_batch(8, 16, TINY.vocab_size, seed=3)
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path), tag="tp4")
+
+    ids = np.asarray(synthetic_lm_batch(2, 12, TINY.vocab_size, seed=4)["input_ids"])
+    trained = jax.tree.map(np.asarray, engine.state.params)
+    base = np.asarray(GPT2Model(TINY).apply(trained, jnp.asarray(ids)))
+
+    for tp in (1, 2):
+        comm.cdb = None
+        mesh = build_mesh(axis_dims={"pipe": 1, "data": 8 // tp, "expert": 1,
+                                     "seq": 1, "tensor": tp})
+        comm.init_distributed(mesh=mesh, verbose=False)
+        eng = deepspeed_tpu.init_inference(
+            GPT2Model(TINY),
+            config={"dtype": "fp32", "checkpoint": str(tmp_path),
+                    "ckpt_config": {"tag": "tp4"}, "max_out_tokens": 64},
+            mesh=mesh)
+        out = np.asarray(eng.forward(ids))
+        np.testing.assert_allclose(out, base, rtol=1e-5, atol=1e-5)
+
+
 def test_max_out_tokens_guard():
     comm.cdb = None
     model = GPT2Model(TINY)

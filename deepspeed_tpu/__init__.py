@@ -74,6 +74,28 @@ def initialize(args=None,
                      "support it (no config.sparse_attention field); ignored",
                      ranks=[0])
 
+    # ZeRO-Infinity parameter offload: params + optimizer state live on NVMe
+    # and the step is layerwise — a different executor, not a DeepSpeedEngine
+    # config knob (reference swap_tensor/partitioned_param_swapper.py role)
+    off_param = ds_config.zero_config.offload_param
+    if off_param is not None and off_param.device == "nvme":
+        from deepspeed_tpu.runtime.zero.infinity import ZeroInfinityEngine
+
+        if optimizer is not None or lr_scheduler is not None:
+            raise ValueError(
+                "offload_param=nvme (layerwise ZeRO-Infinity) builds its own "
+                "NVMe-swapped optimizer; pass optimizer/scheduler via "
+                "ds_config, not as objects")
+        zengine = ZeroInfinityEngine(model, ds_config)
+        loader = None
+        if training_data is not None:
+            from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+            loader = DeepSpeedDataLoader(training_data,
+                                         batch_size=zengine.train_batch_size(),
+                                         collate_fn=collate_fn)
+        return zengine, zengine.optimizer, loader, zengine.lr_scheduler
+
     # RLHF actors get the hybrid train<->generate engine (reference
     # __init__.py:58 DeepSpeedHybridEngine branch on hybrid_engine.enabled)
     engine_cls = DeepSpeedEngine

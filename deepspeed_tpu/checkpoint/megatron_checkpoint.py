@@ -52,17 +52,20 @@ class DeepSpeedCheckpoint:
         if not files:
             raise FileNotFoundError(
                 f"no layer_XX-model_YY-model_states.pt files in {ckpt_dir}")
-        coords = [(int(m.group(1)), int(m.group(2)))
-                  for m in (_LAYER_RE.search(f) for f in files)]
-        self.layer_ids = sorted({l for l, _ in coords})
-        found_tp = len({t for _, t in coords})
+        # keep the REAL filenames keyed by (layer, tp): digit padding varies
+        # across Megatron-DeepSpeed forks (layer_01 vs layer_001)
+        self._files = {}
+        for f in files:
+            m = _LAYER_RE.search(f)
+            self._files[(int(m.group(1)), int(m.group(2)))] = f
+        self.layer_ids = sorted({l for l, _ in self._files})
+        found_tp = len({t for _, t in self._files})
         self.tp_degree = found_tp if tp_degree is None else tp_degree
         if self.tp_degree != found_tp:
             raise ValueError(f"tp_degree={tp_degree} but files show {found_tp}")
 
         def load(layer, tp):
-            path = os.path.join(
-                ckpt_dir, f"layer_{layer:02d}-model_{tp:02d}-model_states.pt")
+            path = os.path.join(ckpt_dir, self._files[(layer, tp)])
             sd = torch.load(path, map_location="cpu", weights_only=True)
             return {k: _np(v) for k, v in sd.items()}
 

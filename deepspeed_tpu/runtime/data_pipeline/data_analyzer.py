@@ -66,7 +66,7 @@ class DataAnalyzer:
     def _my_range(self):
         n = len(self.dataset)
         per = (n + self.num_workers - 1) // self.num_workers
-        lo = self.worker_id * per
+        lo = min(n, self.worker_id * per)
         return lo, min(n, lo + per)
 
     def run_map(self) -> None:

@@ -154,22 +154,38 @@ class DeepSpeedDataSampler:
 
     # ------------------------------------------------------------------ state
     def state_dict(self) -> Dict:
+        """Compact resumable state: the draw order is DETERMINISTIC given
+        (config seed, batch count) — admission sets come from the on-disk
+        index files and every shuffle consumes the seeded rng in a fixed
+        order — so only counters are stored (an explicit order list would be
+        O(dataset) ints in every checkpoint). ``position``/``admitted_size``
+        ride along as resume-time sanity checks."""
         return {
             "curriculum_step": self.curriculum_step,
             "consumed_samples": self.consumed_samples,
-            "rng_state": self.np_rng.bit_generator.state,
-            "admitted_order": self._admitted.tolist(),
             "position": self._pos,
+            "admitted_size": int(self._admitted.size),
         }
 
     def load_state_dict(self, sd: Dict) -> None:
-        self.curriculum_step = int(sd["curriculum_step"])
-        self.consumed_samples = int(sd["consumed_samples"])
-        self.np_rng.bit_generator.state = sd["rng_state"]
-        self._admitted = np.asarray(sd["admitted_order"], dtype=np.int64)
-        self._pos = int(sd["position"])
-        self._in_order = set(int(s) for s in self._admitted)
-        for m in self.metrics:
-            m.scheduler.update_difficulty(self.curriculum_step)
+        """Resume by dry-replaying the batch index stream (cheap: array ops
+        per batch, index-file scans only on difficulty changes). Custom
+        curriculum schedules must be installed before calling this."""
+        target = int(sd["consumed_samples"])
+        if target % self.global_batch_size:
+            raise ValueError(f"consumed_samples {target} not a multiple of "
+                             f"global_batch_size {self.global_batch_size}")
+        if self.consumed_samples:
+            raise RuntimeError("load_state_dict needs a freshly constructed "
+                               "sampler (replay starts from step 0)")
+        for _ in range(target // self.global_batch_size):
+            next(self)
+        assert self.curriculum_step == int(sd["curriculum_step"]), \
+            (self.curriculum_step, sd["curriculum_step"])
+        if "position" in sd and self._pos != int(sd["position"]):
+            raise ValueError(
+                f"sampler replay diverged (position {self._pos} != "
+                f"{sd['position']}): the dataset/index files or curriculum "
+                "config changed since the checkpoint")
         logger.info(f"DeepSpeedDataSampler resumed at curriculum step "
                     f"{self.curriculum_step}, {self.consumed_samples} consumed")

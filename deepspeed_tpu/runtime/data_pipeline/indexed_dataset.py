@@ -97,7 +97,13 @@ class MMapIndexedDataset:
             npz = np.load(f)
             self.dtype = np.dtype(_DTYPES[int(npz["dtype_code"])])
             self._offsets = npz["offsets"]
-        self._data = np.memmap(path_prefix + ".bin", dtype=self.dtype, mode="r")
+        if os.path.getsize(path_prefix + ".bin") == 0:
+            # empty dataset (e.g. a worker shard past the end): memmap
+            # refuses empty files
+            self._data = np.zeros(0, dtype=self.dtype)
+        else:
+            self._data = np.memmap(path_prefix + ".bin", dtype=self.dtype,
+                                   mode="r")
 
     def __len__(self) -> int:
         return len(self._offsets) - 1

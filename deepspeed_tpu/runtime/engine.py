@@ -63,6 +63,14 @@ class StepMetrics(NamedTuple):
     overflow: jnp.ndarray
 
 
+def _index_tag(index, shape) -> str:
+    """Stable string for a shard's global index range (slices normalized
+    against the array shape) — the NVMe swap-file key suffix."""
+    idx = tuple((s.start or 0, s.stop if s.stop is not None else dim)
+                for s, dim in zip(index, shape))
+    return "_".join(f"{a}-{b}" for a, b in idx) or "all"
+
+
 def _is_optax_like(opt) -> bool:
     return hasattr(opt, "init") and hasattr(opt, "update")
 
@@ -191,15 +199,15 @@ class DeepSpeedEngine:
             self._host_offload_opt = self._host_offload_param = False
         self._nvme_optimizer = None
         if self._nvme_offload:
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "NVMe optimizer offload is single-host for now: the host "
-                    "step materializes global grads (np.asarray) which is not "
-                    "fully-addressable on a multi-host mesh")
             from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import SwappedOptimizer
 
+            folder = off_opt.nvme_path or "/tmp/ds_tpu_nvme_swap"
+            if jax.process_count() > 1:
+                # each host swaps only its addressable shards; per-host
+                # subfolders keep shared-filesystem deployments collision-free
+                folder = f"{folder}/host{jax.process_index()}"
             self._nvme_optimizer = SwappedOptimizer(
-                swap_folder=off_opt.nvme_path or "/tmp/ds_tpu_nvme_swap",
+                swap_folder=folder,
                 optimizer_name=self._config.optimizer_name or "adamw",
                 optimizer_params=dict(self._config.optimizer_params or {}),
                 aio_config=self._config.aio_config.model_dump(),
@@ -406,9 +414,15 @@ class DeepSpeedEngine:
                                opt_sh))()
 
         if self._nvme_optimizer is not None:
-            flat, _ = jax.tree_util.tree_flatten_with_path(params)
-            named = {self._leaf_name(path): np.asarray(leaf, dtype=np.float32)
-                     for path, leaf in flat}
+            # seed the swap files from THIS HOST's shards of the params,
+            # decomposed the way the step keys them (grad placement)
+            with mesh:
+                grad_view = jax.device_put(params, self._nvme_grad_shardings())
+            named = {}
+            for path, leaf in jax.tree_util.tree_flatten_with_path(grad_view)[0]:
+                for key, slab, _ in self._host_shard_items(
+                        leaf, self._leaf_name(path)):
+                    named[key] = slab.astype(np.float32)
             self._nvme_optimizer.init_from_params(named)
 
         repl = NamedSharding(mesh, P())
@@ -913,33 +927,72 @@ class DeepSpeedEngine:
         return self._compiled_train_batch[key]
 
     # --------------------------------------------------- NVMe-offload stepping
+    # (module-level _index_tag builds the stable shard-range key suffix)
     @staticmethod
     def _leaf_name(path) -> str:
         return "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
                         for p in path)
 
     def _get_compiled_loss_grads(self, gas: int):
-        """(loss, mean grads) over the accumulation window — no optimizer."""
+        """(loss, mean grads, global grad norm) over the accumulation window —
+        no optimizer. The norm is computed IN-JIT over the global sharded
+        grads, so every host reads the same scalar (multi-host safe)."""
         if getattr(self, "_compiled_loss_grads", None) is None:
             self._compiled_loss_grads = {}
         if gas not in self._compiled_loss_grads:
             def fn(state: TrainState, batch):
-                return self._accumulated_loss_grads(state, batch, gas, jnp.float32(1.0))
+                loss, grads = self._accumulated_loss_grads(
+                    state, batch, gas, jnp.float32(1.0))
+                sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in jax.tree.leaves(grads))
+                return loss, grads, jnp.sqrt(sq)
 
-            self._compiled_loss_grads[gas] = jax.jit(fn)
+            # pin the grads to the plan's grad placement: the NVMe swap-file
+            # keys encode shard index ranges, so init and step must agree on
+            # the decomposition
+            self._compiled_loss_grads[gas] = jax.jit(
+                fn, out_shardings=(None, self._nvme_grad_shardings(), None))
         return self._compiled_loss_grads[gas]
+
+    @staticmethod
+    def _host_shard_items(leaf, name: str):
+        """This host's UNIQUE shards of a global array: [(key, slab, index)].
+
+        Multi-host NVMe decomposition: each host owns the shard index ranges
+        any of its devices hold (replicas dedupe by index; a range replicated
+        across hosts is updated identically on each — deterministic math, no
+        cross-host comm). The key encodes the index range so the swap files
+        of different ranges never collide.
+        """
+        seen = {}
+        for sh in leaf.addressable_shards:
+            tag = _index_tag(sh.index, leaf.shape)
+            if tag not in seen:
+                seen[tag] = sh
+        return [(f"{name}@{tag}", np.asarray(sh.data), sh.index)
+                for tag, sh in sorted(seen.items())]
+
+    def _nvme_grad_shardings(self):
+        """The decomposition the NVMe host step is keyed on (grad placement)."""
+        return self.plan.grad_shardings()
 
     def _train_batch_nvme(self, batch, gas: int) -> StepMetrics:
         """ZeRO-Infinity step: grads on device, Adam on host with NVMe-swapped
-        state (reference stage3 step + partitioned_optimizer_swapper roles)."""
+        state (reference stage3 step + partitioned_optimizer_swapper roles).
+        Multi-host: each host steps only its addressable grad shards and the
+        global params reassemble from per-device slabs — no host ever
+        materializes the full tree."""
         with self.mesh:
-            loss, grads = self._get_compiled_loss_grads(gas)(self.state, batch)
+            loss, grads, gnorm = self._get_compiled_loss_grads(gas)(self.state, batch)
+        grad_norm = float(gnorm)
+        named_grads = {}
+        shard_index = {}     # leaf name -> {index tag -> key}
         flat, _ = jax.tree_util.tree_flatten_with_path(grads)
-        named_grads = {self._leaf_name(path): np.asarray(leaf, dtype=np.float32)
-                       for path, leaf in flat}
-        # global-norm clip, host-side (reference clip_grad_norm_ semantics)
-        sq = sum(float(np.sum(np.square(g))) for g in named_grads.values())
-        grad_norm = float(np.sqrt(sq))
+        for path, leaf in flat:
+            name = self._leaf_name(path)
+            for key, slab, idx in self._host_shard_items(leaf, name):
+                named_grads[key] = slab.astype(np.float32)
+                shard_index.setdefault(name, {})[_index_tag(idx, leaf.shape)] = key
         clip = self._config.gradient_clipping
         scale = 1.0
         if clip and clip > 0 and grad_norm > clip:
@@ -947,9 +1000,22 @@ class DeepSpeedEngine:
         lr = float(self._lr_at(self.state.step))
         new_masters = self._nvme_optimizer.step(named_grads, lr=lr, grad_scale=scale)
 
+        # reassemble the global param tree: every LOCAL device contributes its
+        # grad-decomposition slab, then a plain device_put reshards to the
+        # param placement (collective copy; the step is disk-bound anyway)
         flat_p, treedef = jax.tree_util.tree_flatten_with_path(self.state.params)
-        new_leaves = [np.asarray(new_masters[self._leaf_name(path)], dtype=leaf.dtype)
-                      for path, leaf in flat_p]
+        flat_g = jax.tree_util.tree_flatten(grads)[0]
+        new_leaves = []
+        for (path, p_leaf), g_leaf in zip(flat_p, flat_g):
+            name = self._leaf_name(path)
+            per_dev = []
+            for sh in g_leaf.addressable_shards:
+                key = shard_index[name][_index_tag(sh.index, g_leaf.shape)]
+                slab = np.asarray(new_masters[key], dtype=p_leaf.dtype)
+                per_dev.append(jax.device_put(slab, sh.device))
+            garr = jax.make_array_from_single_device_arrays(
+                g_leaf.shape, g_leaf.sharding, per_dev)
+            new_leaves.append(garr)
         new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
         new_params = jax.device_put(new_params, self.state_shardings.params)
         self.state = self.state._replace(
@@ -1263,7 +1329,10 @@ class DeepSpeedEngine:
                 if pending:
                     data_sampler.load_state_dict(pending)
                     self._pending_sampler_state = None
-        if data_sampler is not None:
+        # only a TRAIN-route sampler becomes the engine's checkpointed
+        # curriculum state; explicit eval samplers ride the loader only
+        if (data_sampler is not None and route in (None, "train")
+                and getattr(self, "_data_sampler", None) is None):
             self._data_sampler = data_sampler
         return DeepSpeedDataLoader(dataset, batch_size=bs,
                                    collate_fn=self.collate_fn,

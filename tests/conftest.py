@@ -21,10 +21,28 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def _slow_nodeids():
+    """Measured-duration slow list (tests/slow_tests.txt, ≥5s on the 1-core
+    CI box; parameterized ids match by base name). Regenerate from
+    `pytest --durations=0` output when the suite's shape changes."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "slow_tests.txt")
+    try:
+        with open(path) as f:
+            return {line.strip() for line in f if line.strip()}
+    except OSError:
+        return set()
+
+
 def pytest_collection_modifyitems(config, items):
-    """Everything not marked slow is smoke: `pytest -m smoke` = the <2min
-    profile, `pytest -m slow` = the long tail, plain `pytest` = both."""
+    """Everything not slow is smoke: `pytest -m smoke` = the fast profile,
+    `pytest -m slow` = the measured long tail, plain `pytest` = both."""
+    slow = _slow_nodeids()
     for item in items:
+        base = item.nodeid.split("[", 1)[0]
+        if base in slow and "slow" not in item.keywords:
+            item.add_marker(pytest.mark.slow)
         if "slow" not in item.keywords:
             item.add_marker(pytest.mark.smoke)
 

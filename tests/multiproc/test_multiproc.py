@@ -23,3 +23,17 @@ def test_two_process_train_and_checkpoint(tmp_path):
 def test_cross_process_collectives(tmp_path):
     results = run_workers("comm_collectives", nproc=2)
     assert_all_ok(results, 2)
+
+
+def test_nvme_offload_two_process(tmp_path):
+    """Multi-host ZeRO-Infinity optimizer offload: numerics vs in-HBM inside
+    each worker, identical trajectories across controllers."""
+    results = run_workers("nvme_2proc", nproc=2, args=[str(tmp_path)],
+                          timeout=600)
+    assert_all_ok(results, 2)
+    losses = {}
+    for rc, log in results:
+        m = re.search(r"NVME_LOSSES (\d) (.+)", log)
+        assert m, log[-2000:]
+        losses[m.group(1)] = m.group(2)
+    assert losses["0"] == losses["1"], losses

@@ -250,3 +250,62 @@ class TestStreamedChunkedAdam:
         base = losses(False)
         chunked = losses(True)
         np.testing.assert_allclose(base, chunked, rtol=2e-3, atol=2e-4)
+
+
+class TestZeroInfinityParams:
+    def test_layerwise_nvme_matches_inhbm(self, tmp_path):
+        """ZeRO-Infinity param offload (params + Adam state on NVMe,
+        layerwise step) must match the in-HBM engine numerically (reference
+        partitioned_param_swapper.py + stage3 remote_device='nvme' role)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.comm import comm
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model, synthetic_lm_batch
+        from deepspeed_tpu.runtime.zero.infinity import ZeroInfinityEngine
+
+        cfg = GPT2Config(vocab_size=256, n_positions=32, n_embd=32, n_layer=4,
+                         n_head=4, dtype=jnp.float32, remat=False,
+                         use_flash_attention=False)
+        ds = {"train_batch_size": 8,
+              "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+              "zero_optimization": {
+                  "stage": 3,
+                  "offload_param": {"device": "nvme",
+                                    "nvme_path": str(tmp_path / "p")}},
+              "steps_per_print": 0}
+        batch = synthetic_lm_batch(8, 16, cfg.vocab_size, seed=2)
+
+        comm.cdb = None
+        zengine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(cfg),
+                                                    config=ds)
+        assert isinstance(zengine, ZeroInfinityEngine)
+        linf = [float(zengine.train_batch(batch)) for _ in range(4)]
+
+        comm.cdb = None
+        base_ds = {k: v for k, v in ds.items() if k != "zero_optimization"}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(cfg),
+                                                   config=base_ds)
+        lbase = [float(engine.train_batch(batch)) for _ in range(4)]
+        np.testing.assert_allclose(lbase, linf, rtol=2e-4, atol=2e-5)
+
+        # export round trip: the gathered tree runs the plain model
+        params = zengine.gather_params()
+        import jax.numpy as jnp2
+        logits = GPT2Model(cfg).apply(
+            {k: (jnp2.asarray(v) if not isinstance(v, dict) else
+                 {kk: jnp2.asarray(vv) for kk, vv in v.items()})
+             for k, v in params.items()},
+            jnp2.asarray(batch["input_ids"][:, :8]))
+        assert np.isfinite(np.asarray(logits)).all()
+
+        # checkpoint round trip: snapshot NVMe state, drift, restore, verify
+        zengine.save_checkpoint(str(tmp_path / "ck"), tag="t")
+        shared_before = {n: np.asarray(v) for n, v in zengine.shared.items()}
+        drift = float(zengine.train_batch(batch))
+        zengine.load_checkpoint(str(tmp_path / "ck"), tag="t")
+        assert zengine.global_steps == 4
+        for n, v in zengine.shared.items():
+            np.testing.assert_array_equal(np.asarray(v), shared_before[n])
+        resumed = float(zengine.train_batch(batch))
+        np.testing.assert_allclose(resumed, drift, rtol=1e-5)

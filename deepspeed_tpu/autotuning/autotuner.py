@@ -54,6 +54,14 @@ class AutotuningConfig:
     mbs_list: Optional[List[int]] = None
     zero_stage_list: Optional[List[int]] = None
     remat_list: Optional[List[str]] = None   # TPU extra: none|full|dots|attn
+    gas_list: Optional[List[int]] = None     # gradient accumulation steps
+    tp_list: Optional[List[int]] = None      # tensor-parallel degrees
+    offload_list: Optional[List[bool]] = None  # host-offload optimizer on/off
+    flash_block_list: Optional[List[Optional[int]]] = None  # kernel tile edges
+    # first-order HBM model: candidates predicted over this fraction of HBM
+    # are pruned BEFORE compiling (compile-time OOM stays the exact check
+    # for the rest); 0 disables
+    hbm_prune_fraction: float = 0.92
 
     @classmethod
     def from_ds_config(cls, pd: Dict) -> "AutotuningConfig":
@@ -110,16 +118,84 @@ class Autotuner:
         zero_list = t.zero_stage_list if t.zero_stage_list is not None else \
             ([1] if n_dev == 1 else [1, 2, 3])
         remat_list = t.remat_list or ["attn", "full"]
+        # no gas axis ⇒ keep the user's base accumulation, don't reset to 1
+        gas_list = t.gas_list or [
+            int(self.base_config.get("gradient_accumulation_steps", 1))]
+        tp_list = t.tp_list or [1]
+        tp_list = [tp for tp in tp_list if n_dev % tp == 0]
+        off_list = t.offload_list or [False]
+        fb_list = t.flash_block_list or [None]
         out = []
-        for mbs, stage, remat in itertools.product(mbs_list, zero_list, remat_list):
+        for mbs, stage, remat, gas, tp, off, fb in itertools.product(
+                mbs_list, zero_list, remat_list, gas_list, tp_list, off_list,
+                fb_list):
             cfg = json.loads(json.dumps(self.base_config))   # deep copy
-            cfg["train_batch_size"] = mbs * n_dev * \
-                cfg.get("gradient_accumulation_steps", 1)
+            dp = n_dev // tp
+            cfg["train_batch_size"] = mbs * dp * gas
             cfg["train_micro_batch_size_per_gpu"] = mbs
-            cfg.setdefault("zero_optimization", {})["stage"] = stage
-            cfg["_tune"] = {"remat": remat, "micro_batch": mbs, "zero": stage}
+            cfg["gradient_accumulation_steps"] = gas
+            zc = cfg.setdefault("zero_optimization", {})
+            zc["stage"] = stage
+            if off:
+                zc["offload_optimizer"] = {"device": "cpu"}
+            if tp > 1:
+                cfg.setdefault("tpu", {})["tensor"] = tp
+            if gas > 1:
+                cfg.setdefault("data_types", {}).setdefault(
+                    "grad_accum_dtype", "bf16")
+            cfg["_tune"] = {"remat": remat, "micro_batch": mbs, "zero": stage,
+                            "gas": gas, "tp": tp, "offload": off,
+                            "flash_block": fb}
             out.append(cfg)
         return out
+
+    # --------------------------------------------------------- HBM cost model
+    def estimate_hbm_bytes(self, tune: Dict[str, Any],
+                           n_dev: int) -> Optional[int]:
+        """First-order per-device HBM for a candidate: params + grads +
+        optimizer state (placement-aware) + activations (remat-aware).
+        Needs a model config exposing num_params/n_layer/n_embd; returns
+        None (no pruning) otherwise."""
+        mc = getattr(self._probe_model(), "config", None)
+        if mc is None or not hasattr(mc, "num_params"):
+            return None
+        n = mc.num_params()
+        seq = self.seq_len or getattr(mc, "n_positions", 1024)
+        d = getattr(mc, "n_embd", 1024)
+        L = getattr(mc, "n_layer", 12)
+        tp = tune.get("tp", 1)
+        dp = max(1, n_dev // tp)
+        stage = tune.get("zero", 1)
+        mbs = tune["micro_batch"]
+        bt = mbs * seq
+        params = 2 * n // tp                               # bf16 compute copy
+        opt = 12 * n // tp                                 # fp32 master+mu+nu
+        if stage >= 1:
+            opt //= dp
+        if tune.get("offload"):
+            opt = 0                                        # pinned_host
+        grads = 2 * n // tp                                # bf16
+        if stage >= 2:
+            grads //= dp
+        acc = 2 * n // tp if tune.get("gas", 1) > 1 else 0  # bf16 accumulator
+        # activation bytes per layer per token (bf16), by remat policy:
+        # 'full' keeps boundaries only (~1d); 'attn' + attention outs (~2d);
+        # 'dots' keeps matmul outs (~14d); 'none' everything (~20d)
+        per_tok_d = {"full": 1.5, "attn": 3, "dots": 14,
+                     "none": 20, False: 20}.get(tune.get("remat", "attn"), 14)
+        acts = int(2 * bt * d * per_tok_d * L) // tp
+        return params + opt + grads + acc + acts
+
+    _probe_cache = None
+
+    def _probe_model(self):
+        """One throwaway model instance for config introspection."""
+        if self._probe_cache is None:
+            try:
+                self._probe_cache = self.model_factory()
+            except TypeError:
+                self._probe_cache = self.model_factory(remat="attn")
+        return self._probe_cache
 
     def _order(self, cands: List[Dict]) -> List[Dict]:
         t = self.tuning
@@ -128,11 +204,17 @@ class Autotuner:
             random.Random(0).shuffle(cands)
             return cands[: t.tuner_num_trials]
         if t.tuner_type == "model_based":
-            # prior: bigger micro-batches first (better MXU util) but cheaper
-            # remat later (more memory) — order by (mbs desc, remat memory asc)
+            # prior: in-HBM before offload (offload trades speed for
+            # capacity), bigger micro-batches first (better MXU util),
+            # cheaper remat later (more memory), small gas first (same math,
+            # faster experiments)
             memory_rank = {"full": 0, "attn": 1, "dots": 2, "none": 3}
-            cands = sorted(cands, key=lambda c: (-c["_tune"]["micro_batch"],
-                                                 memory_rank.get(c["_tune"]["remat"], 9)))
+            cands = sorted(cands, key=lambda c: (
+                1 if c["_tune"].get("offload") else 0,
+                -c["_tune"]["micro_batch"],
+                memory_rank.get(c["_tune"]["remat"], 9),
+                c["_tune"].get("gas", 1),
+                c["_tune"].get("tp", 1)))
             return cands[: t.tuner_num_trials]
         return list(cands)[: t.tuner_num_trials]   # gridsearch
 
@@ -145,7 +227,18 @@ class Autotuner:
         tune = exp.ds_config.get("_tune", {})
         refs = {}   # explicit slot so `finally` can drop device buffers
         try:
-            model = self.model_factory(**({"remat": tune["remat"]} if "remat" in tune else {}))
+            import inspect
+
+            kw = {}
+            try:
+                accepted = set(inspect.signature(self.model_factory).parameters)
+            except (TypeError, ValueError):
+                accepted = {"remat"}
+            if "remat" in tune and "remat" in accepted:
+                kw["remat"] = tune["remat"]
+            if tune.get("flash_block") and "flash_block" in accepted:
+                kw["flash_block"] = tune["flash_block"]
+            model = self.model_factory(**kw)
             refs["model"] = model
             engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
             refs["engine"] = engine
@@ -217,11 +310,36 @@ class Autotuner:
         cands = self._order(self.candidate_space())
         logger.info(f"autotuner: {len(cands)} candidates "
                     f"({t.tuner_type}, metric={t.metric})")
+        hbm = None
+        if t.hbm_prune_fraction:
+            try:
+                import jax
+
+                hbm = int(jax.local_devices()[0].memory_stats()["bytes_limit"])
+            except Exception:
+                hbm = None
         best: Optional[Experiment] = None
         since_improved = 0
         for i, cfg in enumerate(cands):
             exp = Experiment(exp_id=i, ds_config=cfg)
             self.experiments.append(exp)
+            if hbm is not None:
+                import jax
+
+                est = self.estimate_hbm_bytes(cfg.get("_tune", {}),
+                                              len(jax.devices()))
+                if est is not None and est > t.hbm_prune_fraction * hbm:
+                    # hopeless by the first-order model: skip the compile
+                    exp.status = "pruned"
+                    exp.error = (f"estimated {est/2**30:.1f}G > "
+                                 f"{t.hbm_prune_fraction:.0%} of "
+                                 f"{hbm/2**30:.1f}G HBM")
+                    exp.extras["hbm_estimate"] = est
+                    with open(os.path.join(t.exps_dir, f"exp_{i}.json"), "w") as f:
+                        json.dump(exp.record(), f, indent=2)
+                    logger.info(f"autotuner exp {i}: pruned "
+                                f"(tune={cfg.get('_tune')}, {exp.error})")
+                    continue
             self._run_one(exp)
             with open(os.path.join(t.exps_dir, f"exp_{i}.json"), "w") as f:
                 json.dump(exp.record(), f, indent=2)

@@ -93,7 +93,8 @@ def apply_rope(x, cos, sin, interleaved: bool = False):
 
 
 def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None,
-                           causal: bool = True, key_padding_mask=None):
+                           causal: bool = True, key_padding_mask=None,
+                           flash_block=None):
     """Self-attention on local (unsharded-sequence) q, k, v with equal head
     counts (B, T, H, Dh): Pallas flash kernel when available, XLA einsum
     otherwise (CPU tests, unsupported shapes). Causal by default;
@@ -113,7 +114,9 @@ def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None,
         try:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=causal)
+            kw = ({"block_q": int(flash_block), "block_k": int(flash_block)}
+                  if flash_block else {})
+            return flash_attention(q, k, v, causal=causal, **kw)
         except Exception as e:
             if not _warned_flash_fallback[0]:
                 _warned_flash_fallback[0] = True
@@ -190,7 +193,7 @@ def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False,
 
 
 def causal_attention(q, k, v, use_flash: bool = True, sequence_parallel=False,
-                     alibi=None):
+                     alibi=None, flash_block=None):
     """The full causal-attention dispatch shared by the model families:
     sequence-parallel (ring / Ulysses over the 'seq' mesh axis) when enabled
     and the mesh has a seq axis, else ``local_causal_attention``."""
@@ -206,10 +209,14 @@ def causal_attention(q, k, v, use_flash: bool = True, sequence_parallel=False,
         if mesh.shape.get("seq", 1) > 1:
             if sequence_parallel == "ulysses":
                 return seq_par.ulysses_attention(
-                    lambda q, k, v: local_causal_attention(q, k, v, use_flash),
+                    lambda q, k, v: local_causal_attention(
+                        q, k, v, use_flash, flash_block=flash_block),
                     q, k, v, mesh)
+            # ring attention schedules its own per-shard blocks; the flash
+            # tile knob does not apply there
             return seq_par.ring_attention(q, k, v, mesh, causal=True)
-    return local_causal_attention(q, k, v, use_flash, alibi=alibi)
+    return local_causal_attention(q, k, v, use_flash, alibi=alibi,
+                                  flash_block=flash_block)
 
 
 def parse_lm_batch(batch):

@@ -48,6 +48,10 @@ class GPT2Config:
     # right trade on TPU where HBM, not FLOPs, is the binding constraint)
     remat: Any = True
     use_flash_attention: bool = True
+    # flash kernel tile edge (block_q == block_k); None = kernel default
+    # (512). An autotuner axis: smaller tiles fit tighter VMEM at long
+    # head_dim, larger amortize the grid
+    flash_block: Optional[int] = None
     # Pallas streaming decode kernel for generate(); opt-in — wins when the
     # KV cache is preallocated longer than the generated length (see
     # models/common.py cached_decode_attention for measured numbers)
@@ -269,7 +273,8 @@ class GPT2Model:
             return self._sparse_attention(q, k, v)
         return causal_attention(q, k, v, use_flash=c.use_flash_attention,
                                 sequence_parallel=c.sequence_parallel,
-                                alibi=self._alibi())
+                                alibi=self._alibi(),
+                                flash_block=c.flash_block)
 
     def _attention_local(self, q, k, v):
         from deepspeed_tpu.models.common import local_causal_attention

@@ -180,8 +180,11 @@ class DeepSpeedDataSampler:
                                "sampler (replay starts from step 0)")
         for _ in range(target // self.global_batch_size):
             next(self)
-        assert self.curriculum_step == int(sd["curriculum_step"]), \
-            (self.curriculum_step, sd["curriculum_step"])
+        if self.curriculum_step != int(sd["curriculum_step"]):
+            raise ValueError(
+                f"sampler replay diverged (curriculum_step "
+                f"{self.curriculum_step} != {sd['curriculum_step']}): the "
+                "curriculum schedule config changed since the checkpoint")
         if "position" in sd and self._pos != int(sd["position"]):
             raise ValueError(
                 f"sampler replay diverged (position {self._pos} != "

@@ -140,9 +140,7 @@ class ZeroInfinityEngine:
             named[f"shared/{n}"] = np.asarray(v, dtype=np.float32)
         self.optimizer.init_from_params(named)
         del named
-        n_params = sum(int(np.prod(s.shape))
-                       for s in jax.tree.leaves(full_shapes))
-        log_dist(f"ZeRO-Infinity: {n_params/1e6:.1f}M params + Adam state on "
+        log_dist(f"ZeRO-Infinity: {n_elems/1e6:.1f}M params + Adam state on "
                  f"NVMe ({folder}); layerwise execution, peak HBM ≈ 1 layer",
                  ranks=[0])
 
@@ -157,8 +155,9 @@ class ZeroInfinityEngine:
         out = {}
         for k in self._blk_shapes:
             n = f"layer{l:03d}/{k}"
-            out[k] = jnp.asarray(sw.retrieve(f"{n}#w"),
-                                 dtype=jnp.float32)
+            # upload in the COMPUTE dtype: fp32 would double the per-layer
+            # HBM + link traffic on the path whose point is one-layer peak
+            out[k] = jnp.asarray(sw.retrieve(f"{n}#w"), dtype=self.config.dtype)
             sw.release(f"{n}#w")
         return out
 

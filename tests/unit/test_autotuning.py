@@ -81,3 +81,76 @@ class TestAutotuner:
         assert best is not None
         ok = [e for e in at.experiments if e.status == "ok"]
         assert all(e.metric_val <= 0 for e in ok)   # latency metric = -step_time
+
+
+class TestAutotunerAxes:
+    def test_gas_tp_offload_flash_axes(self, tmp_path):
+        """The widened space (reference tuner sweeps ZeRO sub-knobs too):
+        gas/tp/offload/flash-block multiply the candidate set and land in the
+        generated ds_configs."""
+        t = _tuning(tmp_path, gas_list=[1, 2], tp_list=[1, 2],
+                    offload_list=[False, True], flash_block_list=[None, 256])
+        at = Autotuner(_model_factory, _batch_factory, BASE, t)
+        cands = at.candidate_space()
+        # 2 mbs x 2 stages x 1 remat x 2 gas x 2 tp x 2 offload x 2 fb
+        assert len(cands) == 64
+        got = {(c["_tune"]["gas"], c["_tune"]["tp"], c["_tune"]["offload"],
+                c["_tune"]["flash_block"]) for c in cands}
+        assert (2, 2, True, 256) in got
+        gas2 = next(c for c in cands if c["_tune"]["gas"] == 2
+                    and c["_tune"]["tp"] == 2)
+        assert gas2["gradient_accumulation_steps"] == 2
+        assert gas2["tpu"]["tensor"] == 2
+        # tp not dividing the device count is dropped
+        t2 = _tuning(tmp_path, tp_list=[1, 3])
+        at2 = Autotuner(_model_factory, _batch_factory, BASE, t2)
+        assert all(c["_tune"]["tp"] == 1 for c in at2.candidate_space())
+
+    def test_hbm_cost_model_prunes_hopeless(self, tmp_path, monkeypatch):
+        """A candidate whose first-order HBM estimate exceeds the budget is
+        recorded as 'pruned' without compiling."""
+        import dataclasses
+
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model, synthetic_lm_batch
+
+        cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                         n_head=4, use_flash_attention=False)
+
+        def model_factory(remat="attn", flash_block=None):
+            return GPT2Model(dataclasses.replace(
+                cfg, remat=remat if remat != "none" else False))
+
+        def batch_factory(bs):
+            return synthetic_lm_batch(bs, 32, cfg.vocab_size)
+
+        t = AutotuningConfig(enabled=True, start_profile_step=1,
+                             end_profile_step=2,
+                             results_dir=str(tmp_path / "results"),
+                             exps_dir=str(tmp_path / "exps"),
+                             mbs_list=[1], zero_stage_list=[0],
+                             remat_list=["none"])
+        at = Autotuner(model_factory, batch_factory, BASE, t, seq_len=32)
+        est = at.estimate_hbm_bytes({"micro_batch": 1, "zero": 0,
+                                     "remat": "none", "gas": 1, "tp": 1},
+                                    n_dev=1)
+        assert est is not None and est > 0
+        # pretend the chip is tiny: everything prunes, nothing compiles
+        class FakeDev:
+            def memory_stats(self):
+                return {"bytes_limit": 1024}
+        import jax
+        monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
+        ran = {"n": 0}
+        monkeypatch.setattr(at, "_run_one",
+                            lambda exp: ran.__setitem__("n", ran["n"] + 1))
+        at.tune()
+        assert ran["n"] == 0
+        assert all(e.status == "pruned" for e in at.experiments)
+
+    def test_model_based_order_prefers_inhbm_over_offload(self, tmp_path):
+        t = _tuning(tmp_path, offload_list=[True, False])
+        at = Autotuner(_model_factory, _batch_factory, BASE, t)
+        ordered = at._order(at.candidate_space())
+        first_off = next(i for i, c in enumerate(ordered)
+                         if c["_tune"]["offload"])
+        assert all(not c["_tune"]["offload"] for c in ordered[:first_off])

@@ -122,7 +122,15 @@ class Autotuner:
         gas_list = t.gas_list or [
             int(self.base_config.get("gradient_accumulation_steps", 1))]
         tp_list = t.tp_list or [1]
+        bad_tp = [tp for tp in tp_list if n_dev % tp]
         tp_list = [tp for tp in tp_list if n_dev % tp == 0]
+        if bad_tp:
+            logger.warning(f"autotuner: tp degrees {bad_tp} do not divide "
+                           f"the device count {n_dev}; dropped")
+        if not tp_list:
+            raise ValueError(
+                f"no usable tensor-parallel degree: tp_list={t.tp_list} vs "
+                f"{n_dev} devices")
         off_list = t.offload_list or [False]
         fb_list = t.flash_block_list or [None]
         out = []
@@ -140,9 +148,9 @@ class Autotuner:
                 zc["offload_optimizer"] = {"device": "cpu"}
             if tp > 1:
                 cfg.setdefault("tpu", {})["tensor"] = tp
-            if gas > 1:
-                cfg.setdefault("data_types", {}).setdefault(
-                    "grad_accum_dtype", "bf16")
+            # NOTE: gas>1 candidates keep the user's grad_accum_dtype — a
+            # perf tuner must not silently switch accumulation to bf16
+            # (convergence-affecting); pass it in base_config to tune with it
             cfg["_tune"] = {"remat": remat, "micro_batch": mbs, "zero": stage,
                             "gas": gas, "tp": tp, "offload": off,
                             "flash_block": fb}
@@ -169,6 +177,8 @@ class Autotuner:
         mbs = tune["micro_batch"]
         bt = mbs * seq
         params = 2 * n // tp                               # bf16 compute copy
+        if stage >= 3:
+            params //= dp                                  # dp-sharded params
         opt = 12 * n // tp                                 # fp32 master+mu+nu
         if stage >= 1:
             opt //= dp
@@ -177,7 +187,9 @@ class Autotuner:
         grads = 2 * n // tp                                # bf16
         if stage >= 2:
             grads //= dp
-        acc = 2 * n // tp if tune.get("gas", 1) > 1 else 0  # bf16 accumulator
+        acc = 2 * n // tp if tune.get("gas", 1) > 1 else 0  # accumulator
+        if stage >= 2:
+            acc //= dp
         # activation bytes per layer per token (bf16), by remat policy:
         # 'full' keeps boundaries only (~1d); 'attn' + attention outs (~2d);
         # 'dots' keeps matmul outs (~14d); 'none' everything (~20d)
@@ -231,7 +243,11 @@ class Autotuner:
 
             kw = {}
             try:
-                accepted = set(inspect.signature(self.model_factory).parameters)
+                sig = inspect.signature(self.model_factory).parameters
+                accepted = set(sig)
+                if any(p.kind is inspect.Parameter.VAR_KEYWORD
+                       for p in sig.values()):
+                    accepted |= {"remat", "flash_block"}   # **kwargs factory
             except (TypeError, ValueError):
                 accepted = {"remat"}
             if "remat" in tune and "remat" in accepted:

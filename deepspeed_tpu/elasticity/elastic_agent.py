@@ -41,12 +41,22 @@ class DSElasticAgent:
                  checkpoint_interval: int = 100,
                  max_restarts: int = 3,
                  install_signal_handlers: bool = True,
-                 tag: Optional[str] = None):
+                 tag: Optional[str] = None,
+                 preempt_sync_interval: Optional[int] = None):
         self.engine_factory = engine_factory
         self.save_dir = save_dir
         self.checkpoint_interval = int(checkpoint_interval)
         self.max_restarts = int(max_restarts)
         self.tag = tag
+        # cross-host flag sync cadence: a per-step blocking allgather would
+        # sit in the hot loop for an event with a tens-of-seconds grace
+        # window; default = every min(checkpoint_interval, 10) steps (all
+        # hosts sync at the SAME deterministic steps — the collective must
+        # line up)
+        self.preempt_sync_interval = int(
+            preempt_sync_interval
+            if preempt_sync_interval is not None
+            else max(1, min(int(checkpoint_interval) or 10, 10)))
         self._preempted = False
         self.restart_count = 0
         self.engine = None
@@ -71,6 +81,26 @@ class DSElasticAgent:
     def preempt(self):
         """Programmatic preemption (tests / external watchers)."""
         self._preempted = True
+
+    def _preempt_sync(self, step: int) -> bool:
+        """Cross-host preemption coordination: GCE delivers the notice to ONE
+        host of a pod slice, but the orbax checkpoint (and a coherent stop
+        step) needs EVERY controller — so the flag is max-reduced across
+        processes at every ``preempt_sync_interval``-th step boundary
+        (torch-elastic's rendezvous plays this role in the reference
+        agent). Hosts only act on the SYNCED flag so they stop together."""
+        import jax
+
+        if jax.process_count() == 1:
+            return self._preempted
+        if step % self.preempt_sync_interval:
+            return False
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.int32(1 if self._preempted else 0))
+        return bool(np.max(flags))
 
     # ---------------------------------------------------------- lifecycle
     def _bring_up(self, resume: bool) -> Any:
@@ -108,7 +138,7 @@ class DSElasticAgent:
                     step = start_step + local_i
                     if step >= num_steps:
                         break
-                    if self._preempted:
+                    if self._preempt_sync(step):
                         raise PreemptionSignal()
                     loss = engine.train_batch(batch)
                     if step_callback is not None:

@@ -25,6 +25,23 @@ def test_cross_process_collectives(tmp_path):
     assert_all_ok(results, 2)
 
 
+def test_elastic_preemption_one_host(tmp_path):
+    """Preempting ONE host of the slice (the realistic TPU failure): the
+    agent's cross-host flag sync stops both controllers coherently, the
+    checkpoint commits collectively, and a restart resumes on both."""
+    results = run_workers("elastic_2proc", nproc=2, args=[str(tmp_path)],
+                          timeout=600)
+    assert_all_ok(results, 2)
+    steps = set()
+    for rc, log in results:
+        m = re.search(r"PREEMPT (\d) step=(\d+)", log)
+        assert m, log[-2000:]
+        steps.add(m.group(2))
+        assert re.search(r"ELASTIC_DONE \d resumed_from=\d+ final=8", log), \
+            log[-2000:]
+    assert len(steps) == 1, f"hosts stopped at different steps: {steps}"
+
+
 def test_nvme_offload_two_process(tmp_path):
     """Multi-host ZeRO-Infinity optimizer offload: numerics vs in-HBM inside
     each worker, identical trajectories across controllers."""

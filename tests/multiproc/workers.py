@@ -133,8 +133,57 @@ def nvme_2proc(rank: int, nproc: int, tmpdir: str):
     print(f"NVME_LOSSES {rank} {' '.join(f'{l:.6f}' for l in nvme)}", flush=True)
 
 
+def elastic_2proc(rank: int, nproc: int, tmpdir: str):
+    """Multi-host elastic preemption: ONE host (rank 1) receives the
+    preemption notice mid-run; the agent's cross-host flag sync stops BOTH
+    controllers at the same step boundary, the multihost checkpoint commits
+    collectively, and a restarted agent resumes to completion on both."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    from deepspeed_tpu.models.simple import SimpleModel
+
+    HIDDEN = 16
+    batch = _local_batch(rank, 8, nproc, HIDDEN)
+
+    def engine_factory():
+        comm.cdb = None
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "steps_per_print": 0})
+        return engine
+
+    agent = DSElasticAgent(engine_factory, save_dir=f"{tmpdir}/elastic",
+                           checkpoint_interval=2, max_restarts=1,
+                           install_signal_handlers=False)
+
+    def cb(step, loss):
+        if rank == 1 and step == 2:     # the "preempted host"
+            agent.preempt()
+
+    r1 = agent.run(lambda: iter([batch] * 100), num_steps=8, step_callback=cb)
+    assert r1["status"] == "preempted", r1
+    print(f"PREEMPT {rank} step={r1['final_step']}", flush=True)
+
+    # restart: a fresh agent on BOTH hosts resumes from the collective
+    # checkpoint and completes
+    agent2 = DSElasticAgent(engine_factory, save_dir=f"{tmpdir}/elastic",
+                            checkpoint_interval=4, max_restarts=1,
+                            install_signal_handlers=False)
+    r2 = agent2.run(lambda: iter([batch] * 100), num_steps=8)
+    assert r2["status"] == "complete", r2
+    assert r2["final_step"] == 8, r2
+    print(f"ELASTIC_DONE {rank} resumed_from={r1['final_step']} "
+          f"final={r2['final_step']}", flush=True)
+
+
 WORKERS = {"train_2proc": train_2proc, "comm_collectives": comm_collectives,
-           "nvme_2proc": nvme_2proc}
+           "nvme_2proc": nvme_2proc, "elastic_2proc": elastic_2proc}
 
 
 def main():

@@ -157,10 +157,19 @@ def main():
     model_name = os.environ.get("BENCH_MODEL")
     if model_name is None:
         model_name = "gpt2-760m" if on_tpu else "gpt2-tiny"
-        # BASELINE ladder: the 1.5B north star + 1.3B (offload-backed),
-        # headline last so the driver's tail-line parse records gpt2-760m
+        # BASELINE ladder: headline FIRST (so a driver timeout mid-ladder
+        # still leaves its line as the most recent JSON), then the 1.5B
+        # north star + 1.3B (offload-backed), then the headline REPEATED
+        # last for the tail-line parse.
         suite = ("gpt2-xl", "gpt2-1.3b") if (
             on_tpu and os.environ.get("BENCH_SUITE", "1") != "0") else ()
+        try:
+            headline = run_one(model_name, on_tpu, n_dev)
+        except Exception as e:   # extras must still record their lines
+            headline = {"metric": f"{model_name} FAILED: {type(e).__name__} "
+                                  f"{str(e)[:120]}",
+                        "value": 0.0, "unit": "MFU", "vs_baseline": 0.0}
+        print(json.dumps(headline), flush=True)
         for extra in suite:
             try:
                 print(json.dumps(run_one(extra, on_tpu, n_dev)), flush=True)
@@ -169,6 +178,9 @@ def main():
                                             f"{str(e)[:120]}",
                                   "value": 0.0, "unit": "MFU",
                                   "vs_baseline": 0.0}), flush=True)
+        if suite:
+            print(json.dumps(headline), flush=True)
+        return
     print(json.dumps(run_one(model_name, on_tpu, n_dev)), flush=True)
 
 

@@ -159,6 +159,18 @@ class DSElasticAgent:
                         "final_step": int(self.engine.state.step),
                         "restarts": self.restart_count}
             except Exception as e:
+                import jax
+
+                if jax.process_count() > 1:
+                    # a host-LOCAL failure cannot be healed by an in-process
+                    # restart on one controller: the surviving hosts keep
+                    # issuing collectives (preempt sync, train step) that the
+                    # restarting host's load_checkpoint would mismatch —
+                    # multi-host recovery is the launcher's restart-all job
+                    logger.error(f"elastic agent: step failure on a "
+                                 f"multi-host mesh ({e}); re-raising for the "
+                                 "launcher to restart the whole job")
+                    raise
                 self.restart_count += 1
                 logger.warning(f"elastic agent: step failure ({e}); "
                                f"restart {self.restart_count}/{self.max_restarts}")

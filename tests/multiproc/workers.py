@@ -138,8 +138,6 @@ def elastic_2proc(rank: int, nproc: int, tmpdir: str):
     preemption notice mid-run; the agent's cross-host flag sync stops BOTH
     controllers at the same step boundary, the multihost checkpoint commits
     collectively, and a restarted agent resumes to completion on both."""
-    import numpy as np
-
     import deepspeed_tpu
     from deepspeed_tpu.comm import comm
     from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent

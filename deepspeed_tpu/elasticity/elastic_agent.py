@@ -8,16 +8,22 @@ babysit — a slice is a single SPMD program — and the failure modes are (a)
 host preemption (Cloud TPU sends SIGTERM well before reclaim) and (b) step
 failures. So the agent is a supervision loop around the training engine:
 
-* **preemption watch** — SIGTERM/SIGINT handlers set a flag; the step loop
-  checkpoints and exits cleanly at the next boundary (the reference's
-  scale-down signal).
+* **preemption watch** — SIGTERM/SIGINT handlers set a flag; on multi-host
+  meshes the flag is max-reduced across processes at deterministic step
+  boundaries (``preempt_sync_interval``) so every controller stops at the
+  SAME step and the collective checkpoint lines up; the step loop then
+  checkpoints and exits cleanly (the reference's scale-down signal).
 * **periodic + exit checkpoints** — through the engine's checkpoint engine
   (orbax, ``latest`` tag), whose reshard-on-load already handles a DIFFERENT
   mesh shape at resume — the TPU analogue of a new rendezvous world size.
-* **failure retry** — a failing step triggers save-state-free restart from
-  the last checkpoint via a fresh ``engine_factory()`` (which may build a
-  different mesh — elasticity.compute_elastic_config gives the batch
-  re-solve), up to ``max_restarts`` (reference agent's restart budget).
+* **failure retry (single-host only)** — a failing step triggers
+  save-state-free restart from the last checkpoint via a fresh
+  ``engine_factory()`` (which may build a different mesh —
+  elasticity.compute_elastic_config gives the batch re-solve), up to
+  ``max_restarts``. On a MULTI-host mesh a local failure re-raises instead:
+  one controller restarting in-process would mismatch the surviving hosts'
+  collectives, so whole-job restart is the launcher's responsibility (the
+  reference agent's torchelastic rendezvous plays that role).
 """
 
 from __future__ import annotations

@@ -59,9 +59,13 @@ class AutotuningConfig:
     offload_list: Optional[List[bool]] = None  # host-offload optimizer on/off
     flash_block_list: Optional[List[Optional[int]]] = None  # kernel tile edges
     # first-order HBM model: candidates predicted over this fraction of HBM
-    # are pruned BEFORE compiling (compile-time OOM stays the exact check
-    # for the rest); 0 disables
-    hbm_prune_fraction: float = 0.92
+    # are pruned BEFORE compiling; 0 disables. Default 1.5 (= only prune
+    # candidates 50% past HBM) because the model omits real contributors
+    # (grad-accum buffers, streamed-offload working set, fragmentation) and
+    # guesses activation bytes per remat policy — near the boundary the
+    # compile-time exact-OOM check must stay the arbiter, so only clearly
+    # hopeless configs are skipped without ever compiling.
+    hbm_prune_fraction: float = 1.5
 
     @classmethod
     def from_ds_config(cls, pd: Dict) -> "AutotuningConfig":

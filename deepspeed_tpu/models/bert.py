@@ -33,6 +33,20 @@ from jax.sharding import PartitionSpec as P
 
 IGNORE_INDEX = -100
 
+_mlm_overflow_warned = False
+
+
+def _warn_mlm_overflow_once(overflow, maxp):
+    global _mlm_overflow_warned
+    if bool(overflow) and not _mlm_overflow_warned:
+        _mlm_overflow_warned = True
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            f"MLM batch has rows with more than max_predictions_per_seq="
+            f"{maxp} labels; the gathered head drops the excess from the "
+            "loss. Cap masking in the data pipeline (the original BERT "
+            "builder's max_predictions_per_seq truncation) or raise the knob.")
+
 
 @dataclasses.dataclass
 class BertConfig:
@@ -60,8 +74,12 @@ class BertConfig:
     # gather_indexes: at 15% masking the vocab projection+CE runs on ~1/6 of
     # the tokens). Static shape: positions are padded/truncated to
     # max_predictions_per_seq; None = project every position. Loss value is
-    # identical (unmasked positions carry zero weight either way) as long as
-    # no row has more than max_predictions_per_seq labels.
+    # identical (unmasked positions carry zero weight either way) ONLY if the
+    # data pipeline guarantees no row carries more labels than the cap — the
+    # original BERT data builder truncates masking at exactly this knob; rows
+    # over the cap silently train on a truncated loss. Set DS_DEBUG_MLM=1 to
+    # assert the invariant at runtime (one warning per process, adds a small
+    # host sync per step).
     max_predictions_per_seq: Optional[int] = None
 
     VALID_REMAT = (False, None, "none", True, "full", "dots", "attn")
@@ -280,6 +298,13 @@ class BertModel:
         mask = (labels != IGNORE_INDEX)
         maxp = self.config.max_predictions_per_seq
         if maxp is not None and maxp < ids.shape[1]:
+            from deepspeed_tpu.utils import env_flag
+            if env_flag("DS_DEBUG_MLM"):
+                # data-side invariant check: the gathered head silently drops
+                # labels past the cap, so a pipeline that masks more than
+                # max_predictions_per_seq per row trains on a different loss
+                overflow = jnp.max(jnp.sum(mask, axis=1)) > maxp
+                jax.debug.callback(_warn_mlm_overflow_once, overflow, maxp)
             # gather_indexes (original BERT run_pretraining): transform +
             # vocab projection only at the (padded-static) masked positions.
             # top_k on the mask is stable, so real positions come first; rows

@@ -105,6 +105,16 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             ckptr.save(os.path.join(path, "state"), _flatten_state(state), force=True)
 
     if jax.process_index() == 0:
+        sampler_sd = (engine._data_sampler.state_dict()
+                      if getattr(engine, "_data_sampler", None) else None)
+        if sampler_sd is not None and isinstance(
+                sampler_sd.get("admitted"), np.ndarray):
+            # the admitted draw order is O(admitted-samples) int64 — sidecar
+            # it as .npy (the reference's on-disk data_cluster files role)
+            # instead of bloating client_state.json
+            np.save(os.path.join(path, "data_sampler_admitted.npy"),
+                    sampler_sd.pop("admitted"))
+            sampler_sd["admitted_file"] = "data_sampler_admitted.npy"
         meta = {
             "tag": tag,
             "global_steps": int(state.step),
@@ -117,8 +127,7 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             "dp_world_size": engine.dp_world_size,
             # curriculum data sampler (reference ds_sampler state in
             # client_sd): rng + draw order + position → mid-epoch resume
-            "data_sampler": (engine._data_sampler.state_dict()
-                             if getattr(engine, "_data_sampler", None) else None),
+            "data_sampler": sampler_sd,
         }
         with open(os.path.join(path, "client_state.json"), "w") as f:
             json.dump(meta, f, default=str)
@@ -236,6 +245,9 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         sampler_sd = meta.get("data_sampler")
         if sampler_sd:
+            adm_file = sampler_sd.pop("admitted_file", None)
+            if adm_file:
+                sampler_sd["admitted"] = np.load(os.path.join(path, adm_file))
             if getattr(engine, "_data_sampler", None) is not None:
                 engine._data_sampler.load_state_dict(sampler_sd)
             else:

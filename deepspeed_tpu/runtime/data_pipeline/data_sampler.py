@@ -154,41 +154,97 @@ class DeepSpeedDataSampler:
 
     # ------------------------------------------------------------------ state
     def state_dict(self) -> Dict:
-        """Compact resumable state: the draw order is DETERMINISTIC given
-        (config seed, batch count) — admission sets come from the on-disk
-        index files and every shuffle consumes the seeded rng in a fixed
-        order — so only counters are stored (an explicit order list would be
-        O(dataset) ints in every checkpoint). ``position``/``admitted_size``
-        ride along as resume-time sanity checks."""
+        """Resumable state (reference DeepSpeedDataSampler state_dict role).
+
+        Carries the rng bit-generator state plus the admitted draw order
+        (``admitted``, an int64 array — the checkpoint engine sidecars it to
+        an .npy next to client_state.json, mirroring the reference's
+        on-disk data_cluster files) so resume is O(admitted) — NOT a
+        counter-replay, which re-scanned the full mmap index once per
+        replayed step while the difficulty was still ramping
+        (O(consumed_steps × dataset) for schedules that move every step).
+        ``total_samples`` rides along so resume against a different dataset
+        is refused instead of silently replayed."""
         return {
             "curriculum_step": self.curriculum_step,
             "consumed_samples": self.consumed_samples,
             "position": self._pos,
             "admitted_size": int(self._admitted.size),
+            "total_samples": self.total_samples,
+            "global_batch_size": self.global_batch_size,
+            "rng_state": self.np_rng.bit_generator.state,
+            "last_difficulties": (list(self._last_difficulties)
+                                  if self._last_difficulties is not None else None),
+            # current per-metric difficulty: update_difficulty is a pure
+            # function of step, so a restore that lands on a different value
+            # means the schedule config changed — caught at load
+            "difficulties": [m.scheduler.get_current_difficulty()
+                             for m in self.metrics],
+            "admitted": self._admitted.copy(),
         }
 
     def load_state_dict(self, sd: Dict) -> None:
-        """Resume by dry-replaying the batch index stream (cheap: array ops
-        per batch, index-file scans only on difficulty changes). Custom
-        curriculum schedules must be installed before calling this."""
-        target = int(sd["consumed_samples"])
-        if target % self.global_batch_size:
-            raise ValueError(f"consumed_samples {target} not a multiple of "
-                             f"global_batch_size {self.global_batch_size}")
+        """Restore directly from rng state + admitted order when present;
+        fall back to dry-replaying the batch index stream for legacy
+        counter-only state dicts. Custom curriculum schedules must be
+        installed before calling this."""
         if self.consumed_samples:
             raise RuntimeError("load_state_dict needs a freshly constructed "
-                               "sampler (replay starts from step 0)")
-        for _ in range(target // self.global_batch_size):
-            next(self)
-        if self.curriculum_step != int(sd["curriculum_step"]):
+                               "sampler")
+        if "total_samples" in sd and int(sd["total_samples"]) != self.total_samples:
             raise ValueError(
-                f"sampler replay diverged (curriculum_step "
-                f"{self.curriculum_step} != {sd['curriculum_step']}): the "
-                "curriculum schedule config changed since the checkpoint")
-        if "position" in sd and self._pos != int(sd["position"]):
+                f"sampler checkpoint was taken over a dataset of "
+                f"{sd['total_samples']} samples but this sampler wraps "
+                f"{self.total_samples} — refusing to resume the curriculum "
+                "against a different dataset (is an eval loader being built "
+                "with route='train'?)")
+        if ("global_batch_size" in sd
+                and int(sd["global_batch_size"]) != self.global_batch_size):
             raise ValueError(
-                f"sampler replay diverged (position {self._pos} != "
-                f"{sd['position']}): the dataset/index files or curriculum "
-                "config changed since the checkpoint")
+                f"sampler checkpoint was taken at global_batch_size="
+                f"{sd['global_batch_size']} but this sampler runs at "
+                f"{self.global_batch_size} — consumed-sample and curriculum "
+                "accounting would silently diverge")
+        if sd.get("rng_state") is not None and sd.get("admitted") is not None:
+            adm = np.asarray(sd["admitted"], dtype=np.int64)
+            if adm.size != int(sd.get("admitted_size", adm.size)):
+                raise ValueError("sampler state corrupt: admitted array size "
+                                 f"{adm.size} != recorded {sd['admitted_size']}")
+            self.np_rng.bit_generator.state = sd["rng_state"]
+            self._admitted = adm
+            self._in_order = {int(s) for s in adm}
+            self._pos = int(sd["position"])
+            self.curriculum_step = int(sd["curriculum_step"])
+            self.consumed_samples = int(sd["consumed_samples"])
+            ld = sd.get("last_difficulties")
+            self._last_difficulties = tuple(ld) if ld is not None else None
+            for m in self.metrics:
+                m.scheduler.update_difficulty(self.curriculum_step)
+            saved = sd.get("difficulties")
+            if saved is not None:
+                now = [m.scheduler.get_current_difficulty() for m in self.metrics]
+                if list(saved) != now:
+                    raise ValueError(
+                        f"sampler restore diverged: per-metric difficulties "
+                        f"at step {self.curriculum_step} are {now} but the "
+                        f"checkpoint recorded {list(saved)} — the curriculum "
+                        "schedule config changed since the checkpoint")
+        else:
+            target = int(sd["consumed_samples"])
+            if target % self.global_batch_size:
+                raise ValueError(f"consumed_samples {target} not a multiple of "
+                                 f"global_batch_size {self.global_batch_size}")
+            for _ in range(target // self.global_batch_size):
+                next(self)
+            if self.curriculum_step != int(sd["curriculum_step"]):
+                raise ValueError(
+                    f"sampler replay diverged (curriculum_step "
+                    f"{self.curriculum_step} != {sd['curriculum_step']}): the "
+                    "curriculum schedule config changed since the checkpoint")
+            if "position" in sd and self._pos != int(sd["position"]):
+                raise ValueError(
+                    f"sampler replay diverged (position {self._pos} != "
+                    f"{sd['position']}): the dataset/index files or curriculum "
+                    "config changed since the checkpoint")
         logger.info(f"DeepSpeedDataSampler resumed at curriculum step "
                     f"{self.curriculum_step}, {self.consumed_samples} consumed")

@@ -295,7 +295,7 @@ class DeepSpeedEngine:
 
         self.dataloader = None
         if training_data is not None:
-            self.dataloader = self.deepspeed_io(training_data)
+            self.dataloader = self.deepspeed_io(training_data, route="train")
 
         # arm compression-aware training when ds_config carries a
         # compression_training block (clients may also call
@@ -1297,18 +1297,28 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------ dataloader
     def deepspeed_io(self, dataset, batch_size=None, route=None,
                      data_sampler=None, **kwargs):
+        """Build a DeepSpeedDataLoader over ``dataset``.
+
+        ``route`` must be ``"train"`` for the loader that feeds training:
+        only then does the metric-based curriculum sampler AUTO-construct and
+        become the engine's checkpointed curriculum state. Loaders built with
+        ``route=None`` or ``route="eval"`` never auto-construct one — so a
+        validation loader built first can't silently bind the curriculum (and
+        its checkpointed position) to the wrong dataset. An explicitly passed
+        ``data_sampler`` still binds on route=None (passing one is already
+        intentional); route="eval" keeps even explicit samplers loader-local.
+        (The engine's own ``training_data`` loader passes route="train".)
+        """
         from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
 
         bs = batch_size or self.train_batch_size()
-        if (data_sampler is None and route in (None, "train")
-                and getattr(self, "_data_sampler", None) is None):
+
+        def _file_based_curriculum():
             # metric-based curriculum sampling (reference DeepSpeedDataSampler,
-            # data_sampling/data_sampler.py): engaged when the data_efficiency
-            # block carries curriculum metrics with analyzer index files —
-            # distinct from the seqlen-TRUNCATION curriculum, which has no
-            # per-sample index files. Eval loaders (route='eval') and repeat
-            # calls never build or overwrite the training sampler — its
-            # position is checkpointed state.
+            # data_sampling/data_sampler.py): configured when the
+            # data_efficiency block carries curriculum metrics with analyzer
+            # index files — distinct from the seqlen-TRUNCATION curriculum,
+            # which has no per-sample index files
             de = self._config.data_efficiency_config or {}
             cl = de.get("data_sampling", {}).get("curriculum_learning", {})
             metrics = cl.get("curriculum_metrics", {})
@@ -1317,6 +1327,17 @@ class DeepSpeedEngine:
                           or m.get("clustering_type") == "single_cluster"}
             if (de.get("enabled", True) and cl.get("enabled") and file_based
                     and de.get("data_sampling", {}).get("enabled", True)):
+                return de, cl, file_based
+            return None
+
+        if (data_sampler is None and route == "train"
+                and getattr(self, "_data_sampler", None) is None):
+            # Eval loaders (route='eval') and repeat calls never build or
+            # overwrite the training sampler — its position is checkpointed
+            # state.
+            found = _file_based_curriculum()
+            if found:
+                de, cl, file_based = found
                 from deepspeed_tpu.runtime.data_pipeline.data_sampler import \
                     DeepSpeedDataSampler
 
@@ -1329,8 +1350,27 @@ class DeepSpeedEngine:
                 if pending:
                     data_sampler.load_state_dict(pending)
                     self._pending_sampler_state = None
-        # only a TRAIN-route sampler becomes the engine's checkpointed
-        # curriculum state; explicit eval samplers ride the loader only
+        elif (route is None and data_sampler is None
+                and getattr(self, "_data_sampler", None) is None
+                and (getattr(self, "_pending_sampler_state", None) is not None
+                     or _file_based_curriculum() is not None)):
+            # a metric curriculum is configured (or its checkpoint state is
+            # pending) but this loader's route is ambiguous — a caller from
+            # before the route narrowing building its training loader without
+            # route= would otherwise silently train on uniform sampling (or
+            # restart the curriculum from sample 0). route='eval' is an
+            # explicit choice and stays silent.
+            logger.warning(
+                "a metric-based curriculum is configured but this loader was "
+                "built with route=None, which does NOT engage the curriculum "
+                "sampler; pass route='train' on the training loader (or "
+                "route='eval' to silence this for eval loaders)")
+        # A sampler becomes the engine's checkpointed curriculum state when
+        # the route says train. An EXPLICITLY passed sampler also binds on
+        # route=None (the pre-narrowing contract — passing one is already an
+        # intentional act); only the AUTO-construction above requires the
+        # explicit route, because that is what could silently bind to the
+        # wrong dataset. route='eval' samplers ride the loader only.
         if (data_sampler is not None and route in (None, "train")
                 and getattr(self, "_data_sampler", None) is None):
             self._data_sampler = data_sampler

@@ -131,23 +131,65 @@ class ShardingPlan:
     def map_opt_state_specs(self, opt_state_shapes: Any, master_shapes: Any):
         """Build specs for the optimizer state given abstract shapes.
 
-        optax states mirror the param tree inside NamedTuples; we map: leaf
-        shape == some master-param shape at the same tree position → master
-        spec, else replicate. We exploit that optax moment trees have the SAME
-        treedef as params, so tree_map against masters works when structures
-        align; otherwise fall back to shape-matching per leaf.
+        optax states embed copies of the param tree inside NamedTuples (e.g.
+        ScaleByAdamState.mu/.nu), so an optimizer-state leaf's key path ends
+        with the key path of the param it shadows. Matching by that PATH
+        SUFFIX (plus a shape check) — not by shape alone — keeps two
+        same-shaped but differently-sharded params (a tp-sharded and a
+        replicated square matrix, say) from silently swapping their moment
+        placements. Leaves that shadow no param (step counters, EmptyState)
+        replicate; a shape-only fallback remains for exotic states but
+        refuses to guess when two candidate specs conflict.
         """
-        master_leaves = jax.tree.leaves(master_shapes)
-        spec_leaves = jax.tree.leaves(self.master_specs, is_leaf=lambda x: isinstance(x, P))
+        def key_of(path):
+            return tuple(str(p) for p in path)
+
+        spec_by_path = {}
+        shape_by_path = {}
+        # BOTH flattens must keep None leaves (None is an empty pytree node a
+        # default flatten drops) or the zip below shifts from the first None
+        # onward and every spec pairs with the wrong shape
+        keep_none = lambda x: x is None
+        spec_flat = jax.tree_util.tree_flatten_with_path(
+            self.master_specs, is_leaf=lambda x: isinstance(x, P) or x is None)[0]
+        shapes_flat = jax.tree_util.tree_flatten_with_path(
+            master_shapes, is_leaf=keep_none)[0]
+        for (p_sp, sp), (p_sh, sh) in zip(spec_flat, shapes_flat):
+            if sh is None:
+                continue
+            spec_by_path[key_of(p_sp)] = sp
+            shape_by_path[key_of(p_sp)] = tuple(sh.shape)
+
+        # shape fallback: only unambiguous (all same-shaped masters agree)
         shape_index = {}
-        for lf, sp in zip(master_leaves, spec_leaves):
-            shape_index.setdefault(tuple(lf.shape), sp)
+        for k, shape in shape_by_path.items():
+            shape_index.setdefault(shape, set()).add(
+                tuple(spec_by_path[k]) if spec_by_path[k] is not None else None)
 
-        def leaf_spec(leaf):
-            sp = shape_index.get(tuple(leaf.shape))
-            return sp if sp is not None else P()
+        def leaf_spec(path, leaf):
+            k = key_of(path)
+            shape = tuple(leaf.shape)
+            # longest path suffix that names a master param of the same shape
+            for i in range(len(k)):
+                sp = spec_by_path.get(k[i:])
+                if sp is not None and shape_by_path[k[i:]] == shape:
+                    return sp
+            cands = shape_index.get(shape)
+            if cands is not None and len(cands) == 1:
+                only = next(iter(cands))
+                return P(*only) if only is not None else P()
+            if cands is not None and len(cands) > 1:
+                logger.warning(
+                    f"optimizer-state leaf at {'/'.join(k)} (shape {shape}) "
+                    f"matches no master param by path and {len(cands)} "
+                    "conflicting specs by shape — replicating it. If this "
+                    "leaf shadows a sharded param, its memory savings are "
+                    "lost; wire an explicit spec.")
+            return P()
 
-        return jax.tree.map(leaf_spec, opt_state_shapes)
+        flat = jax.tree_util.tree_flatten_with_path(opt_state_shapes)
+        leaves = [leaf_spec(path, leaf) for path, leaf in flat[0]]
+        return jax.tree_util.tree_unflatten(flat[1], leaves)
 
 
 def plan_sharding(param_shapes: Any,
@@ -220,6 +262,42 @@ def plan_sharding(param_shapes: Any,
     param_specs = jax.tree.map(param_spec, param_shapes, tp_specs)
     master_specs = jax.tree.map(master_spec, param_shapes, tp_specs)
     grad_specs = jax.tree.map(grad_spec, param_shapes, tp_specs)
+
+    # Surface silent sharding failures: _shard_over_dp degrades to replicated
+    # when no dim is divisible by the dp world — correct, but a LARGE leaf
+    # that fails is exactly how a model quietly loses its ZeRO memory
+    # savings (e.g. a vocab padded to a size coprime with dp). One warning
+    # per offending leaf, threshold = the stage-3 persistence threshold
+    # (smaller leaves are intentionally kept whole).
+    if dp_axes and stage >= 1:
+        thresh = max(int(zc.param_persistence_threshold), 1)
+        # keep None leaves on both sides so the zip can't shift (see
+        # map_opt_state_specs)
+        shapes_flat = jax.tree_util.tree_flatten_with_path(
+            param_shapes, is_leaf=lambda x: x is None)[0]
+        check = param_specs if stage >= 3 else master_specs
+        what = "params+optimizer" if stage >= 3 else "optimizer state"
+        specs_flat = jax.tree_util.tree_flatten_with_path(check, is_leaf=is_p)[0]
+        for (path, sh), (_, sp) in zip(shapes_flat, specs_flat):
+            if sh is None:
+                continue
+            n = int(np.prod(sh.shape))
+            if n < thresh:
+                continue
+            axes = set()
+            for e in _spec_tuple(sp, len(sh.shape)):
+                axes.update(_axes_of(e))
+            if not any(a in dp_axes for a in axes):
+                name = "/".join(str(p) for p in path)
+                placement = (f"keeps only its tp sharding {sp}" if axes
+                             else "stays fully REPLICATED")
+                logger.warning(
+                    f"ZeRO stage {stage}: {what} for param {name} "
+                    f"(shape {tuple(sh.shape)}, {n/1e6:.1f}M elements) "
+                    f"{placement} — no dim is divisible by the dp world "
+                    f"{[f'{a}={mesh.shape[a]}' for a in dp_axes]}. Pad the "
+                    "offending dim to a multiple of the dp world to recover "
+                    "the ZeRO sharding memory savings.")
 
     if batch_spec is None:
         batch_axes = tuple(a for a in (DATA_AXIS, MICS_AXIS, EXPERT_AXIS)

@@ -120,6 +120,38 @@ class TestBertNative:
         g = dataclasses.replace(cfg, max_predictions_per_seq=20)
         assert g.flops_per_token(64) < cfg.flops_per_token(64)
 
+    def test_mlm_overflow_debug_warning(self, monkeypatch):
+        """DS_DEBUG_MLM=1 asserts the data-side invariant: a row carrying
+        more labels than max_predictions_per_seq warns once (the gathered
+        head silently drops the excess — ADVICE r3)."""
+        import deepspeed_tpu.models.bert as bert_mod
+
+        monkeypatch.setenv("DS_DEBUG_MLM", "1")
+        monkeypatch.setattr(bert_mod, "_mlm_overflow_warned", False)
+        warnings = []
+        from deepspeed_tpu.utils.logging import logger as ds_logger
+        monkeypatch.setattr(ds_logger, "warning",
+                            lambda msg, *a: warnings.append(msg))
+        cfg = dataclasses.replace(PRESETS["bert-tiny"], dtype=jnp.float32,
+                                  use_flash_attention=False,
+                                  max_predictions_per_seq=4)
+        batch = synthetic_mlm_batch(2, 64, cfg.vocab_size, seed=3)
+        assert int((batch["labels"] != IGNORE_INDEX).sum(axis=1).max()) > 4
+        params = BertModel(cfg).init_params(jax.random.PRNGKey(0))
+        loss = BertModel(cfg).loss(params, batch)
+        jax.block_until_ready(loss)
+        jax.effects_barrier()
+        assert any("max_predictions_per_seq" in w for w in warnings)
+        # capped batch: no warning
+        warnings.clear()
+        monkeypatch.setattr(bert_mod, "_mlm_overflow_warned", False)
+        ok = synthetic_mlm_batch(2, 64, cfg.vocab_size, seed=3,
+                                 max_predictions=4)
+        loss = BertModel(cfg).loss(params, ok)
+        jax.block_until_ready(loss)
+        jax.effects_barrier()
+        assert warnings == []
+
     def test_num_params_matches_tree(self):
         cfg = PRESETS["bert-tiny"]
         params = BertModel(cfg).init_params(jax.random.PRNGKey(0))

@@ -306,6 +306,72 @@ class TestDataEfficiencySampling:
             last = next(s)
         assert any(data[int(i)]["seqlen"] > 16 for i in last)
 
+    def test_sampler_resume_is_direct_not_replay(self, tmp_path, monkeypatch):
+        """Resume restores rng + draw order directly — it must NOT re-scan
+        the mmap index per consumed batch (ADVICE r3: counter-replay was
+        O(consumed_steps x dataset) while the difficulty ramps). Legacy
+        counter-only state dicts still take the replay path."""
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            DataAnalyzer, metric_paths)
+        from deepspeed_tpu.runtime.data_pipeline.data_sampler import \
+            DeepSpeedDataSampler
+
+        data = self._dataset()
+        DataAnalyzer(data, ["seqlen"], [lambda s: s["seqlen"]],
+                     save_path=str(tmp_path)).run()
+        p = metric_paths(str(tmp_path), "seqlen")
+        de = {"seed": 11, "data_sampling": {"num_epochs": 4,
+              "curriculum_learning": {"enabled": True, "curriculum_metrics": {
+                  "seqlen": {"index_to_sample_path": p["sample_path"],
+                             "index_to_metric_path": p["metric_path"],
+                             "difficulty_type": "value",
+                             "min_difficulty": 8, "max_difficulty": 32,
+                             "schedule_type": "fixed_linear",
+                             "schedule_config": {"total_curriculum_step": 10,
+                                                 "difficulty_step": 4}}}}}}
+        s = DeepSpeedDataSampler(dict(de), len(data), global_batch_size=8)
+        for _ in range(5):
+            next(s)
+        sd = s.state_dict()
+        expect = [next(s) for _ in range(3)]
+
+        s2 = DeepSpeedDataSampler(dict(de), len(data), global_batch_size=8)
+        scans = []
+        orig = DeepSpeedDataSampler._current_admitted
+        monkeypatch.setattr(DeepSpeedDataSampler, "_current_admitted",
+                            lambda self, d: (scans.append(d), orig(self, d))[1])
+        s2.load_state_dict({k: v for k, v in sd.items()})
+        assert scans == []          # direct restore: zero index scans
+        for a, b in zip(expect, [next(s2) for _ in range(3)]):
+            np.testing.assert_array_equal(a, b)
+
+        # legacy counter-only dict: replay fallback still lands on the stream
+        legacy = {k: sd[k] for k in ("curriculum_step", "consumed_samples",
+                                     "position", "admitted_size")}
+        s3 = DeepSpeedDataSampler(dict(de), len(data), global_batch_size=8)
+        for a, b in zip(expect, (s3.load_state_dict(legacy),
+                                 *[next(s3) for _ in range(3)])[1:]):
+            np.testing.assert_array_equal(a, b)
+
+        # a checkpoint from a different dataset is refused, not replayed
+        s4 = DeepSpeedDataSampler(dict(de), len(data) + 8, global_batch_size=8)
+        with pytest.raises(ValueError, match="different dataset"):
+            s4.load_state_dict(dict(sd))
+
+        # a changed global batch size is refused
+        s5 = DeepSpeedDataSampler(dict(de), len(data), global_batch_size=16)
+        with pytest.raises(ValueError, match="global_batch_size"):
+            s5.load_state_dict(dict(sd))
+
+        # a changed curriculum schedule is refused by the direct restore too
+        import copy
+        de2 = copy.deepcopy(de)
+        de2["data_sampling"]["curriculum_learning"]["curriculum_metrics"][
+            "seqlen"]["schedule_config"]["total_curriculum_step"] = 40
+        s6 = DeepSpeedDataSampler(de2, len(data), global_batch_size=8)
+        with pytest.raises(ValueError, match="schedule config changed"):
+            s6.load_state_dict(dict(sd))
+
     def test_trains_through_deepspeed_io_and_resumes(self, tmp_path):
         from deepspeed_tpu.comm import comm
         from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
@@ -351,7 +417,10 @@ class TestDataEfficiencySampling:
         e2, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(cfg),
                                                config=ds_cfg)
         e2.load_checkpoint(str(tmp_path / "ckpt"), tag="mid")
-        loader2 = e2.deepspeed_io(samples)
+        # an eval loader built FIRST must not bind the curriculum state
+        eval_loader = e2.deepspeed_io(samples[:8], route="eval")
+        assert getattr(e2, "_data_sampler", None) is None
+        loader2 = e2.deepspeed_io(samples, route="train")
         assert e2._data_sampler is not None
         got = e2._data_sampler.state_dict()
         assert got["consumed_samples"] == expected_next["consumed_samples"]

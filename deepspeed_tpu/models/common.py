@@ -94,7 +94,7 @@ def apply_rope(x, cos, sin, interleaved: bool = False):
 
 def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None,
                            causal: bool = True, key_padding_mask=None,
-                           flash_block=None):
+                           flash_block=None, window=None):
     """Self-attention on local (unsharded-sequence) q, k, v with equal head
     counts (B, T, H, Dh): Pallas flash kernel when available, XLA einsum
     otherwise (CPU tests, unsupported shapes). Causal by default;
@@ -106,11 +106,16 @@ def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None,
     exactly HF BLOOM's ``build_alibi_tensor`` under a full attention mask.
     ``key_padding_mask``: optional (B, T) True=attend. Biased or masked
     attention takes the einsum path (the flash kernel carries neither).
+    ``window``: optional sliding window (GPT-Neo local attention, reference
+    containers/gptneo.py): position i attends to j with 0 <= i-j < window.
+    May be a TRACED scalar so one scanned layer loop can mix global and
+    local layers; <=0 means global. Windowed attention takes the einsum
+    path.
     """
     # the backend gate matters: off-TPU the Mosaic kernel fails at LOWERING
     # time (inside jit compilation), where no try/except here could catch it
     if use_flash and alibi is None and key_padding_mask is None \
-            and jax.default_backend() == "tpu":
+            and window is None and jax.default_backend() == "tpu":
         try:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -133,6 +138,12 @@ def local_causal_attention(q, k, v, use_flash: bool = True, alibi=None,
     if causal:
         mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
         logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
+    if window is not None:
+        assert causal, "windowed attention is causal-only"
+        w = jnp.asarray(window, jnp.int32)
+        ij = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]   # i - j
+        wmask = (ij < w) | (w <= 0)                            # w<=0 → global
+        logits = jnp.where(wmask[None, None], logits, NEG_INF_ATTN)
     if key_padding_mask is not None:
         keep = jnp.asarray(key_padding_mask).astype(jnp.bool_)
         logits = jnp.where(keep[:, None, None, :], logits, NEG_INF_ATTN)
@@ -145,7 +156,7 @@ _warned_decode_alibi = [False]
 
 
 def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False,
-                            alibi=None):
+                            alibi=None, window=None):
     """Single-token decode attention over a KV cache, shared by the model
     families. q: (B, H, Dh) — the new token's queries; caches (B, S, KV, Dh)
     valid through index ``pos``; KV may divide H (GQA); ``alibi``: optional
@@ -166,7 +177,7 @@ def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False,
         logger.warning("use_flash_decode is set but ALiBi is active; the "
                        "decode kernel has no bias input — using XLA einsum "
                        "decode for this model")
-    if use_flash_decode and alibi is None:
+    if use_flash_decode and alibi is None and window is None:
         try:
             from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
 
@@ -187,13 +198,18 @@ def cached_decode_attention(q, k_cache, v_cache, pos, use_flash_decode=False,
         s = s + (alibi.reshape(KV, H // KV)[None, :, :, None]
                  * jnp.arange(S, dtype=jnp.float32)[None, None, None, :])
     valid = (jnp.arange(S) <= pos)[None, None, None]
+    if window is not None:
+        # GPT-Neo local attention: the new token (position `pos`) sees only
+        # the last `window` cache slots; window<=0 (traced) means global
+        w = jnp.asarray(window, jnp.int32)
+        valid = valid & (((jnp.arange(S) > pos - w) | (w <= 0))[None, None, None])
     s = jnp.where(valid, s, NEG_INF_ATTN)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bgrk,bkgd->bgrd", p, v_cache).reshape(B, H, Dh)
 
 
 def causal_attention(q, k, v, use_flash: bool = True, sequence_parallel=False,
-                     alibi=None, flash_block=None):
+                     alibi=None, flash_block=None, window=None):
     """The full causal-attention dispatch shared by the model families:
     sequence-parallel (ring / Ulysses over the 'seq' mesh axis) when enabled
     and the mesh has a seq axis, else ``local_causal_attention``."""
@@ -216,7 +232,7 @@ def causal_attention(q, k, v, use_flash: bool = True, sequence_parallel=False,
             # tile knob does not apply there
             return seq_par.ring_attention(q, k, v, mesh, causal=True)
     return local_causal_attention(q, k, v, use_flash, alibi=alibi,
-                                  flash_block=flash_block)
+                                  flash_block=flash_block, window=window)
 
 
 def parse_lm_batch(batch):

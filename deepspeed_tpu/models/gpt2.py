@@ -78,6 +78,13 @@ class GPT2Config:
     # sequence parallelism over the 'seq' mesh axis: False | 'ring' | 'ulysses'
     # (parallel/sequence.py — long-context support beyond the reference)
     sequence_parallel: Any = False
+    # GPT-Neo variant (reference module_inject/containers/gptneo.py): per-layer
+    # 'global' | 'local' attention; local = causal sliding window of
+    # window_size. The window rides the layer scan as a traced per-layer
+    # scalar (0 = global), so mixed patterns compile to ONE scanned program;
+    # windowed layers take the einsum path (the flash kernel has no window).
+    attention_layers: Optional[tuple] = None
+    window_size: int = 256
 
     VALID_REMAT = (False, None, "none", True, "full", "dots", "attn")
 
@@ -104,6 +111,24 @@ class GPT2Config:
             if self.alibi:
                 raise NotImplementedError(
                     "sparse_attention does not carry ALiBi biases")
+        if self.attention_layers is not None:
+            object.__setattr__(self, "attention_layers",
+                               tuple(self.attention_layers))
+            if len(self.attention_layers) != self.n_layer:
+                raise ValueError(
+                    f"attention_layers has {len(self.attention_layers)} "
+                    f"entries for n_layer={self.n_layer}")
+            bad = set(self.attention_layers) - {"global", "local"}
+            if bad:
+                raise ValueError(f"attention_layers entries {bad} not in "
+                                 "('global', 'local')")
+            if "local" in self.attention_layers:
+                if self.window_size <= 0:
+                    raise ValueError("local attention needs window_size > 0")
+                if self.sparse_attention is not None or self.sequence_parallel:
+                    raise NotImplementedError(
+                        "GPT-Neo local attention does not compose with "
+                        "sparse_attention or sequence parallelism")
 
     @property
     def head_dim(self) -> int:
@@ -262,10 +287,20 @@ class GPT2Model:
 
         return alibi_slopes(self.config.n_head)
 
-    def _attention(self, q, k, v):
+    def _layer_windows(self):
+        """(L,) int32 per-layer attention window (0 = global) when the
+        GPT-Neo 'local' pattern is configured, else None."""
+        c = self.config
+        if not c.attention_layers or "local" not in c.attention_layers:
+            return None
+        return jnp.asarray([c.window_size if a == "local" else 0
+                            for a in c.attention_layers], jnp.int32)
+
+    def _attention(self, q, k, v, window=None):
         """q,k,v: (B, T, H, Dh). Causal self-attention (block-sparse when
         configured, else the models/common.py dispatch: sequence-parallel →
-        flash → einsum)."""
+        flash → einsum). ``window``: traced per-layer sliding window
+        (GPT-Neo local layers; 0/None = global)."""
         from deepspeed_tpu.models.common import causal_attention
 
         c = self.config
@@ -274,13 +309,13 @@ class GPT2Model:
         return causal_attention(q, k, v, use_flash=c.use_flash_attention,
                                 sequence_parallel=c.sequence_parallel,
                                 alibi=self._alibi(),
-                                flash_block=c.flash_block)
+                                flash_block=c.flash_block, window=window)
 
-    def _attention_local(self, q, k, v):
+    def _attention_local(self, q, k, v, window=None):
         from deepspeed_tpu.models.common import local_causal_attention
 
         return local_causal_attention(q, k, v, self.config.use_flash_attention,
-                                      alibi=self._alibi())
+                                      alibi=self._alibi(), window=window)
 
     def _embed(self, params, input_ids):
         """Token (+ learned position, unless ALiBi) embedding, with BLOOM's
@@ -301,9 +336,9 @@ class GPT2Model:
         keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
         return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
 
-    def _block(self, x, blk, rng, rope=None):
+    def _block(self, x, blk, rng, rope=None, window=None):
         q, k, v = self._block_kv(x, blk, rope)
-        attn = self._attention(q, k, v)
+        attn = self._attention(q, k, v, window=window)
         # named so remat='attn' can save exactly this tensor (the only one
         # whose recompute re-runs the flash kernel) while rematerializing
         # the cheap-to-recompute matmul/elementwise chain
@@ -324,6 +359,24 @@ class GPT2Model:
         """input_ids (B, T) int32 → logits (B, T, V) fp32."""
         return self._lm_logits(params, self._trunk(params, input_ids, rng))
 
+    def _remat_wrap(self, fn):
+        """Apply the configured activation-checkpoint policy to a per-layer
+        function (reference activation_checkpointing/checkpointing.py role).
+        'attn' saves per-layer attention outputs only (~1×d per token): the
+        backward re-runs the qkv/mlp matmuls but never the flash attention
+        kernel — the best flops/HBM trade when full 'dots' saving doesn't
+        fit."""
+        c = self.config
+        if c.remat in (True, "full"):
+            return jax.checkpoint(fn, policy=jax.checkpoint_policies.nothing_saveable)
+        if c.remat == "dots":
+            return jax.checkpoint(
+                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        if c.remat == "attn":
+            return jax.checkpoint(
+                fn, policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
+        return fn
+
     def _trunk(self, params, input_ids, rng=None):
         c = self.config
         B, T = input_ids.shape
@@ -332,30 +385,19 @@ class GPT2Model:
             rng, emb_key = jax.random.split(rng)
             x = self._dropout(x, emb_key)
 
-        block_fn = self._block
-        if c.remat in (True, "full"):
-            block_fn = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.nothing_saveable)
-        elif c.remat == "dots":
-            block_fn = jax.checkpoint(
-                block_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-        elif c.remat == "attn":
-            # save per-layer attention outputs only (~1×d per token): the
-            # backward re-runs the qkv/mlp matmuls but never the flash
-            # attention kernel — the best flops/HBM trade when full 'dots'
-            # saving doesn't fit
-            block_fn = jax.checkpoint(
-                block_fn,
-                policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
+        block_fn = self._remat_wrap(self._block)
 
         layer_rngs = jax.random.split(rng, c.n_layer) if (rng is not None and c.dropout > 0.0) else None
         rope = self._rope_tables(jnp.arange(T))
+        windows = self._layer_windows()   # None (empty pytree leaf) or (L,)
 
         def scan_body(carry, xs):
-            blk, lrng = xs
-            x = block_fn(carry, blk, lrng, rope)
+            blk, lrng, w = xs
+            x = block_fn(carry, blk, lrng, rope, w)
             return x, None
 
-        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+        x, _ = jax.lax.scan(scan_body, x,
+                            (params["blocks"], layer_rngs, windows))
         return self._layer_norm(x, params["lnf_g"], params["lnf_b"])
 
     def hidden_states(self, params, input_ids, rng=None):
@@ -475,10 +517,13 @@ class GPT2Model:
         x = self._embed(params, input_ids)
         rope = self._rope_tables(jnp.arange(T))
 
-        def body(carry, blk):
+        windows = self._layer_windows()
+
+        def body(carry, xs):
+            blk, w = xs
             x = carry
             q, k, v = self._block_kv(x, blk, rope)
-            attn = self._attention_local(q, k, v)
+            attn = self._attention_local(q, k, v, window=w)
             x = self._block_finish(x, blk, attn)
             k_pad = jnp.zeros((B, max_len, c.n_head, c.head_dim), c.dtype)
             k_pad = jax.lax.dynamic_update_slice(k_pad, k, (0, 0, 0, 0))
@@ -486,43 +531,53 @@ class GPT2Model:
             v_pad = jax.lax.dynamic_update_slice(v_pad, v, (0, 0, 0, 0))
             return x, (k_pad, v_pad)
 
-        x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], windows))
         x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
         logits = self._lm_logits(params, x[:, -1])
         cache = {"k": ks, "v": vs, "pos": jnp.int32(T)}
         return logits, cache
 
-    def decode_step(self, params, token, cache):
-        """One token for every sequence: (B,) → logits (B, V), cache advanced.
-        The jitted equivalent of the reference's per-token softmax_context
-        path (csrc/transformer/inference/pt_binding.cpp qkv_gemm_/softmax_context_)."""
+    def _decode_embed(self, params, token, pos):
+        """(B,) token + scalar position → embedded (B, 1, D) — the decode
+        counterpart of _embed, shared with the MoE decode path."""
         c = self.config
-        B = token.shape[0]
-        pos = cache["pos"]
         x = params["wte"].astype(c.dtype)[token][:, None]  # (B, 1, D)
         if not c.alibi and not c.rotary_pct:
             x = x + jax.lax.dynamic_slice_in_dim(
                 params["wpe"].astype(c.dtype), pos, 1, 0)[None]
         if c.embed_layernorm:
             x = self._layer_norm(x, params["emb_ln_g"], params["emb_ln_b"])
+        return x
+
+    def decode_step(self, params, token, cache):
+        """One token for every sequence: (B,) → logits (B, V), cache advanced.
+        The jitted equivalent of the reference's per-token softmax_context
+        path (csrc/transformer/inference/pt_binding.cpp qkv_gemm_/softmax_context_)."""
+        c = self.config
+        pos = cache["pos"]
+        x = self._decode_embed(params, token, pos)
 
         from deepspeed_tpu.models.common import cached_decode_attention
 
         rope = self._rope_tables(pos[None])
 
+        windows = self._layer_windows()
+
         def body(carry, xs):
             x = carry
-            blk, k_cache, v_cache = xs
+            blk, k_cache, v_cache, w = xs
             q, k, v = self._block_kv(x, blk, rope)     # (B, 1, H, Dh)
             k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
             attn = cached_decode_attention(q[:, 0], k_cache, v_cache, pos,
                                            c.use_flash_decode,
-                                           alibi=self._alibi())[:, None]
+                                           alibi=self._alibi(),
+                                           window=w)[:, None]
             x = self._block_finish(x, blk, attn)
             return x, (k_cache, v_cache)
 
-        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"],
+                                             cache["v"], windows))
         x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
         logits = self._lm_logits(params, x[:, 0])
         return logits, {"k": ks, "v": vs, "pos": pos + 1}

@@ -780,6 +780,170 @@ def load_opt(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
     return config, params
 
 
+# ---------------------------------------------------------------- GPT-Neo
+def load_gptneo(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``GPTNeoForCausalLM`` (gpt-neo-125M/1.3B/2.7B) → (GPT2Config,
+    params) for GPT2Model.
+
+    GPT-Neo switches (the reference's separate policy container,
+    module_inject/containers/gptneo.py — NOT NeoX): alternating global/LOCAL
+    sliding-window attention per ``config.attention_layers`` (window_size
+    256), NO 1/sqrt(dh) attention scaling — folded into the bias-free q
+    projection here (q_w·√dh, then our kernels' 1/√dh restores the identity),
+    bias-free q/k/v with a biased out_proj, learned positions, gelu_new MLP,
+    tied head.
+    """
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    cfg = getattr(model_or_sd, "config", None)
+    n_head = int(getattr(cfg, "num_heads", 0) or 0)
+    attn_layers = getattr(cfg, "attention_layers", None)
+    if not n_head or attn_layers is None:
+        raise ValueError("load_gptneo needs the HF model (config carries "
+                         "num_heads and attention_layers), not a bare state dict")
+
+    sd = hf_state_dict(model_or_sd)
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    g = lambda name: sd[prefix + name].astype(dtype)
+    n_layer = _layer_count(sd, prefix, "h")
+
+    wte = g("wte.weight")
+    vocab, d = wte.shape
+    dh = d // n_head
+
+    def qkv_w(i):
+        p = f"h.{i}.attn.attention."
+        # GPT-Neo computes attention WITHOUT the 1/sqrt(dh) scale; our
+        # kernels always apply it, so pre-scale q by sqrt(dh) (exact: q_proj
+        # has no bias, so the fold is a pure weight transform)
+        q = g(p + "q_proj.weight").T * np.sqrt(dh).astype(dtype)
+        return np.concatenate(
+            [q, g(p + "k_proj.weight").T, g(p + "v_proj.weight").T], axis=1)
+
+    stack_w, stack_b, stack_t = _stackers(g, n_layer, "h.{i}.")
+    params = {
+        "wte": wte,
+        "wpe": g("wpe.weight"),
+        "blocks": {
+            "ln1_g": stack_w("ln_1"),
+            "ln1_b": stack_b("ln_1"),
+            "qkv_w": np.stack([qkv_w(i) for i in range(n_layer)]),
+            "qkv_b": np.zeros((n_layer, 3 * d), dtype),  # q/k/v: bias-free
+            "proj_w": stack_t("attn.attention.out_proj"),
+            "proj_b": stack_b("attn.attention.out_proj"),
+            "ln2_g": stack_w("ln_2"),
+            "ln2_b": stack_b("ln_2"),
+            "fc_w": stack_t("mlp.c_fc"),
+            "fc_b": stack_b("mlp.c_fc"),
+            "fc2_w": stack_t("mlp.c_proj"),
+            "fc2_b": stack_b("mlp.c_proj"),
+        },
+        "lnf_g": g("ln_f.weight"),
+        "lnf_b": g("ln_f.bias"),
+    }
+    if not _detect_tied(sd, prefix + "wte.weight"):
+        raise NotImplementedError("untied GPT-Neo lm_head not supported")
+
+    config = GPT2Config(
+        vocab_size=vocab,
+        n_positions=int(getattr(cfg, "max_position_embeddings", 2048) or 2048),
+        n_embd=d, n_layer=n_layer, n_head=n_head,
+        activation=str(getattr(cfg, "activation_function", "gelu_new") or "gelu_new"),
+        attention_layers=tuple(attn_layers),
+        window_size=int(getattr(cfg, "window_size", 256) or 256),
+        dtype=_compute_dtype(dtype))
+    n_local = sum(1 for a in config.attention_layers if a == "local")
+    logger.info(f"load_gptneo: {n_layer} layers ({n_local} local, window="
+                f"{config.window_size}), d={d}, vocab={vocab}, heads={n_head}")
+    return config, params
+
+
+# ------------------------------------------------------------- DistilBERT
+def load_distilbert(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``DistilBertForMaskedLM`` → (BertConfig, params) for BertModel.
+
+    DistilBERT rides the BERT trunk (reference counterpart:
+    module_inject/containers/distil_bert.py): same post-LN encoder with
+    separate q/k/v linears (q_lin/k_lin/v_lin here), NO token-type
+    embeddings (converted as a 1-row zero type table — the trunk adds
+    wtype[0] when token_type_ids is None), and an MLM head of
+    vocab_transform + vocab_layer_norm + tied vocab_projector.
+    """
+    from deepspeed_tpu.models.bert import BertConfig
+
+    cfg = getattr(model_or_sd, "config", None)
+    n_head = int(getattr(cfg, "n_heads", 0) or 0)
+    if not n_head:
+        raise ValueError("load_distilbert needs the HF model (config carries "
+                         "n_heads), not a bare state dict")
+
+    sd = hf_state_dict(model_or_sd)
+    if "vocab_transform.weight" not in sd:
+        raise NotImplementedError(
+            "load_distilbert converts DistilBertForMaskedLM checkpoints "
+            "(needs the vocab_transform MLM head)")
+    prefix = "distilbert." if any(k.startswith("distilbert.") for k in sd) else ""
+    g = lambda name: sd[prefix + name].astype(dtype)
+    n_layer = _layer_count(sd, prefix, "transformer.layer")
+
+    wte = g("embeddings.word_embeddings.weight")
+    vocab, d = wte.shape
+
+    def qkv_w(i):
+        p = f"transformer.layer.{i}.attention."
+        return np.concatenate([g(p + f"{n}_lin.weight").T
+                               for n in ("q", "k", "v")], axis=1)
+
+    def qkv_b(i):
+        p = f"transformer.layer.{i}.attention."
+        return np.concatenate([g(p + f"{n}_lin.bias") for n in ("q", "k", "v")])
+
+    stack_w, stack_b, stack_t = _stackers(g, n_layer, "transformer.layer.{i}.")
+    params = {
+        "wte": wte,
+        "wpe": g("embeddings.position_embeddings.weight"),
+        "wtype": np.zeros((1, d), dtype),     # DistilBERT has no token types
+        "emb_ln_g": g("embeddings.LayerNorm.weight"),
+        "emb_ln_b": g("embeddings.LayerNorm.bias"),
+        "blocks": {
+            "qkv_w": np.stack([qkv_w(i) for i in range(n_layer)]),
+            "qkv_b": np.stack([qkv_b(i) for i in range(n_layer)]),
+            "proj_w": stack_t("attention.out_lin"),
+            "proj_b": stack_b("attention.out_lin"),
+            "attn_ln_g": stack_w("sa_layer_norm"),
+            "attn_ln_b": stack_b("sa_layer_norm"),
+            "fc_w": stack_t("ffn.lin1"),
+            "fc_b": stack_b("ffn.lin1"),
+            "fc2_w": stack_t("ffn.lin2"),
+            "fc2_b": stack_b("ffn.lin2"),
+            "mlp_ln_g": stack_w("output_layer_norm"),
+            "mlp_ln_b": stack_b("output_layer_norm"),
+        },
+        "mlm_w": sd["vocab_transform.weight"].astype(dtype).T,
+        "mlm_b": sd["vocab_transform.bias"].astype(dtype),
+        "mlm_ln_g": sd["vocab_layer_norm.weight"].astype(dtype),
+        "mlm_ln_b": sd["vocab_layer_norm.bias"].astype(dtype),
+        "decoder_b": sd["vocab_projector.bias"].astype(dtype),
+    }
+    if "vocab_projector.weight" in sd and not np.array_equal(
+            sd["vocab_projector.weight"],
+            sd[prefix + "embeddings.word_embeddings.weight"]):
+        raise NotImplementedError("untied DistilBERT vocab_projector not supported")
+
+    act = str(getattr(cfg, "activation", "gelu") or "gelu")
+    if act not in ("relu", "gelu", "gelu_new"):
+        raise NotImplementedError(f"DistilBERT activation {act!r} not supported")
+    config = BertConfig(
+        vocab_size=vocab,
+        n_positions=int(getattr(cfg, "max_position_embeddings", 512) or 512),
+        n_embd=d, n_layer=n_layer, n_head=n_head,
+        intermediate_size=int(getattr(cfg, "hidden_dim", 4 * d) or 4 * d),
+        type_vocab_size=1, activation=act, dtype=_compute_dtype(dtype))
+    logger.info(f"load_distilbert: {n_layer} layers, d={d}, vocab={vocab}, "
+                f"heads={n_head}")
+    return config, params
+
+
 def _gpt2_model(config):
     from deepspeed_tpu.models.gpt2 import GPT2Model
 
@@ -798,8 +962,10 @@ _LOADERS = {"gpt2": (load_gpt2, _gpt2_model),
             "opt": (load_opt, _gpt2_model),
             "bloom": (load_bloom, _gpt2_model),
             "gpt_neox": (load_gptneox, _gpt2_model),
+            "gpt_neo": (load_gptneo, _gpt2_model),
             "gptj": (load_gptj, _gpt2_model),
-            "bert": (load_bert, _bert_model)}
+            "bert": (load_bert, _bert_model),
+            "distilbert": (load_distilbert, _bert_model)}
 
 
 def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,

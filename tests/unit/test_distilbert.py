@@ -1,0 +1,68 @@
+"""DistilBERT conversion: the BERT trunk minus token-type embeddings, with
+the vocab_transform/vocab_projector MLM head (reference:
+module_inject/containers/distil_bert.py)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.bert import BertModel, synthetic_mlm_batch
+from deepspeed_tpu.module_inject.hf import load_hf_model
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+VOCAB = 128
+
+
+@pytest.fixture(scope="module")
+def hf_distilbert():
+    from transformers import DistilBertConfig, DistilBertForMaskedLM
+
+    torch.manual_seed(0)
+    cfg = DistilBertConfig(vocab_size=VOCAB, dim=64, n_layers=2, n_heads=4,
+                           hidden_dim=256, max_position_embeddings=64,
+                           dropout=0.0, attention_dropout=0.0)
+    return DistilBertForMaskedLM(cfg).eval()
+
+
+@pytest.fixture()
+def ids():
+    rng = np.random.RandomState(0)
+    return rng.randint(4, VOCAB - 4, size=(2, 16)).astype(np.int32)
+
+
+class TestDistilBertConversion:
+    def test_mlm_logits_match_torch(self, hf_distilbert, ids):
+        model, params = load_hf_model(hf_distilbert)
+        c = model.config
+        assert c.type_vocab_size == 1
+        assert params["wtype"].shape == (1, c.n_embd)
+        model = BertModel(dataclasses.replace(c, dtype=jnp.float32,
+                                              use_flash_attention=False,
+                                              remat=False))
+        ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+        with torch.no_grad():
+            theirs = hf_distilbert(
+                torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+    def test_train_through_initialize(self, hf_distilbert):
+        model, params = load_hf_model(hf_distilbert)
+        model = BertModel(dataclasses.replace(model.config,
+                                              use_flash_attention=False))
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "bf16": {"enabled": True},
+                    "zero_optimization": {"stage": 1},
+                    "steps_per_print": 0})
+        batch = synthetic_mlm_batch(8, 32, VOCAB, seed=2)
+        losses = [float(engine.train_batch(batch)) for _ in range(4)]
+        assert np.isfinite(losses[-1]) and losses[-1] < losses[0]

@@ -57,6 +57,12 @@ class AutotuningConfig:
     gas_list: Optional[List[int]] = None     # gradient accumulation steps
     tp_list: Optional[List[int]] = None      # tensor-parallel degrees
     offload_list: Optional[List[bool]] = None  # host-offload optimizer on/off
+    # streamed-offload scheduling: False = strict-serial leaf chain, True =
+    # double-buffered (pull chains on the write TWO leaves back). Hardware-
+    # dependent (serial wins through a slow host link, overlap should win on
+    # real TPU-VM PCIe) — a MEASURED axis, not a baked default. Only expands
+    # candidates that offload.
+    offload_overlap_list: Optional[List[bool]] = None
     flash_block_list: Optional[List[Optional[int]]] = None  # kernel tile edges
     # first-order HBM model: candidates predicted over this fraction of HBM
     # are pruned BEFORE compiling; 0 disables. Default 1.5 (= only prune
@@ -136,11 +142,14 @@ class Autotuner:
                 f"no usable tensor-parallel degree: tp_list={t.tp_list} vs "
                 f"{n_dev} devices")
         off_list = t.offload_list or [False]
+        ov_list = t.offload_overlap_list or [False]
         fb_list = t.flash_block_list or [None]
         out = []
-        for mbs, stage, remat, gas, tp, off, fb in itertools.product(
+        for mbs, stage, remat, gas, tp, off, ov, fb in itertools.product(
                 mbs_list, zero_list, remat_list, gas_list, tp_list, off_list,
-                fb_list):
+                ov_list, fb_list):
+            if ov and not off:
+                continue   # overlap only exists on the offload path
             cfg = json.loads(json.dumps(self.base_config))   # deep copy
             dp = n_dev // tp
             cfg["train_batch_size"] = mbs * dp * gas
@@ -157,13 +166,13 @@ class Autotuner:
             # (convergence-affecting); pass it in base_config to tune with it
             cfg["_tune"] = {"remat": remat, "micro_batch": mbs, "zero": stage,
                             "gas": gas, "tp": tp, "offload": off,
-                            "flash_block": fb}
+                            "offload_overlap": ov, "flash_block": fb}
             out.append(cfg)
         return out
 
     # --------------------------------------------------------- HBM cost model
     def estimate_hbm_bytes(self, tune: Dict[str, Any],
-                           n_dev: int) -> Optional[int]:
+                           n_dev: int, hbm: Optional[int] = None) -> Optional[int]:
         """First-order per-device HBM for a candidate: params + grads +
         optimizer state (placement-aware) + activations (remat-aware).
         Needs a model config exposing num_params/n_layer/n_embd; returns
@@ -186,11 +195,23 @@ class Autotuner:
         opt = 12 * n // tp                                 # fp32 master+mu+nu
         if stage >= 1:
             opt //= dp
-        if tune.get("offload"):
-            opt = 0                                        # pinned_host
         grads = 2 * n // tp                                # bf16
         if stage >= 2:
             grads //= dp
+        if tune.get("offload"):
+            # the engine's moments-only auto policy (runtime/engine.py) keeps
+            # the fp32 MASTER resident when (master+params+grads) fits 0.55
+            # of HBM — mirror it so offload candidates are not underestimated
+            opt = 0                                        # mu/nu pinned_host
+            master = 4 * n // tp
+            if stage >= 1:
+                master //= dp
+            if hbm is not None and (master + params + grads) <= 0.55 * hbm \
+                    and os.environ.get("DS_TPU_OFFLOAD_MASTER",
+                                       "auto").lower() not in ("host",
+                                                               "pinned",
+                                                               "cpu"):
+                opt = master
         acc = 2 * n // tp if tune.get("gas", 1) > 1 else 0  # accumulator
         if stage >= 2:
             acc //= dp
@@ -242,6 +263,12 @@ class Autotuner:
         cfg = {k: v for k, v in exp.ds_config.items() if k != "_tune"}
         tune = exp.ds_config.get("_tune", {})
         refs = {}   # explicit slot so `finally` can drop device buffers
+        # streamed-offload scheduling knob: read (env_flag) inside the step
+        # trace, so setting it before the engine compiles is sufficient
+        prev_overlap = os.environ.get("DS_TPU_OFFLOAD_OVERLAP")
+        if tune.get("offload"):
+            os.environ["DS_TPU_OFFLOAD_OVERLAP"] = \
+                "1" if tune.get("offload_overlap") else "0"
         try:
             import inspect
 
@@ -301,6 +328,11 @@ class Autotuner:
                 if hasattr(eng, "invalidate_compiled"):
                     eng.invalidate_compiled()
             refs.clear()
+            if tune.get("offload"):
+                if prev_overlap is None:
+                    os.environ.pop("DS_TPU_OFFLOAD_OVERLAP", None)
+                else:
+                    os.environ["DS_TPU_OFFLOAD_OVERLAP"] = prev_overlap
             try:
                 import jax
 
@@ -347,7 +379,7 @@ class Autotuner:
                 import jax
 
                 est = self.estimate_hbm_bytes(cfg.get("_tune", {}),
-                                              len(jax.devices()))
+                                              len(jax.devices()), hbm=hbm)
                 if est is not None and est > t.hbm_prune_fraction * hbm:
                     # hopeless by the first-order model: skip the compile
                     exp.status = "pruned"

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import inspect
 import math
+import os
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -197,6 +198,45 @@ class DeepSpeedEngine:
             log_dist("offload to host memory requires the TPU backend; running "
                      "without offload (CPU backend has one memory space)", ranks=[0])
             self._host_offload_opt = self._host_offload_param = False
+        # Moments-only offload: when the fp32 MASTER fits HBM next to the
+        # bf16 params + grads (+ remat activations), keep it resident and
+        # stream only mu/nu — cuts the per-step host traffic by a third (the
+        # reference's offload_optimizer.ratio partial-offload role, decided
+        # by capacity instead of a fraction knob). DS_TPU_OFFLOAD_MASTER=
+        # host|hbm overrides the capacity heuristic.
+        self._offload_master_host = self._host_offload_opt
+        if self._host_offload_opt:
+            mode = os.environ.get("DS_TPU_OFFLOAD_MASTER", "auto").lower()
+            if mode in ("hbm", "device", "resident"):
+                self._offload_master_host = False
+            elif mode in ("host", "pinned", "cpu"):
+                self._offload_master_host = True
+            else:
+                n = sum(int(np.prod(l.shape))
+                        for l in jax.tree.leaves(param_shapes))
+                shards = max(1, int(np.prod([mesh.shape[a]
+                                             for a in self.plan.dp_axes] or [1])))
+                try:
+                    hbm = int(jax.local_devices()[0].memory_stats()["bytes_limit"])
+                except Exception:
+                    hbm = 16 << 30
+                # resident set with master in HBM ≈ fp32 master (4n,
+                # dp-sharded at stage>=1) + bf16 params (2n, sharded only at
+                # stage 3) + bf16 grads (2n, sharded at stage>=2) + the
+                # whole-leaf mu/nu transients + the NEW master tree until XLA
+                # aliases it onto the donated old one (measured: it does not,
+                # 19.2G at 1.3B on 15.75G) — so auto only keeps the master
+                # resident when the margin is wide; force with
+                # DS_TPU_OFFLOAD_MASTER=hbm to experiment past the heuristic
+                stage = self.plan.zero_stage
+                resident = (4 * n / shards
+                            + 2 * n / (shards if stage >= 3 else 1)
+                            + 2 * n / (shards if stage >= 2 else 1))
+                self._offload_master_host = resident > 0.55 * hbm
+            if not self._offload_master_host:
+                log_dist("ZeRO-Offload: fp32 master stays in HBM; streaming "
+                         "moments only (DS_TPU_OFFLOAD_MASTER=host to force "
+                         "full offload)", ranks=[0])
         self._nvme_optimizer = None
         if self._nvme_offload:
             from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import SwappedOptimizer
@@ -374,7 +414,9 @@ class DeepSpeedEngine:
         param_sh = plan.param_shardings()
         if self._host_offload_param:
             param_sh = jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), param_sh)
-        master_sh = plan.master_shardings("pinned_host" if self._host_offload_opt else None)
+        master_sh = plan.master_shardings(
+            "pinned_host" if (self._host_offload_opt
+                              and self._offload_master_host) else None)
 
         def build():
             raw = init_fn()
@@ -610,14 +652,16 @@ class DeepSpeedEngine:
             hbm = int(jax.local_devices()[0].memory_stats()["bytes_limit"])
         except Exception:
             hbm = 16 << 30
-        # master+mu+nu fp32 = 12 bytes/param streamed in at once, next to
-        # bf16 params, grads, and activations
-        per_dev = 12 * n / shards
-        self._offload_streamed_cached = per_dev > 0.6 * hbm
+        # host-resident fp32 streamed in at once: master+mu+nu = 12
+        # bytes/param, or mu+nu = 8 when the master stays in HBM (which also
+        # shrinks the budget the stream-in must fit into)
+        stream_bytes = (12 if self._offload_master_host else 8) * n / shards
+        budget = hbm - (0 if self._offload_master_host else 4 * n / shards)
+        self._offload_streamed_cached = stream_bytes > 0.6 * budget
         if self._offload_streamed_cached:
             log_dist("ZeRO-Offload: leaf-streamed optimizer update "
-                     f"({per_dev / 2**30:.1f}G fp32 state/device vs "
-                     f"{hbm / 2**30:.1f}G HBM)", ranks=[0])
+                     f"({stream_bytes / 2**30:.1f}G streamed fp32/device vs "
+                     f"{budget / 2**30:.1f}G free HBM)", ranks=[0])
         return self._offload_streamed_cached
 
     def _apply_grads_streamed_adam(self, state: TrainState, grads, loss,
@@ -703,7 +747,13 @@ class DeepSpeedEngine:
             # outside the (8,128) tile so host-DMA slices stay tile-aligned;
             # slicing a 2D table's row dim (e.g. a 50257-row vocab embedding)
             # hits sublane misalignment in the TPU DUS emitter
-            if leaf.ndim >= 3:
+            # chunking exists to bound the HOST-pull working set of m+mu+nu.
+            # With a DEVICE-resident master (moments-only offload) the chunked
+            # path is a net LOSS: per-chunk DUS re-assembly double-buffers the
+            # full fp32 leaf on device (observed 2x1.5G on the fc stacks),
+            # while whole-leaf mu/nu pulls stay bounded by the serial token
+            # chain at ~2 leaf-sizes.
+            if leaf.ndim >= 3 and self._offload_master_host:
                 want = max(1, math.ceil(leaf.size * 4 / chunk_budget))
                 # only equal chunks (static shapes)
                 n_chunks = next((c for c in range(min(want, leaf.shape[0]),
@@ -716,9 +766,16 @@ class DeepSpeedEngine:
                 folds in the ordering token (a scalar read chained off a
                 previous update): without the data dependency the scheduler
                 is free to prefetch all moment leaves at once, defeating the
-                bounded-peak guarantee."""
+                bounded-peak guarantee. A DEVICE-resident master (moments-only
+                offload) takes no pull, no token fold, and no write-back —
+                the chain arithmetic on a resident leaf materializes a full
+                copy (observed: six 392M temps on the unchunkable 2D vocab
+                embedding, the difference between fitting and OOM at 1.3B)."""
                 chain = lambda x: x + token_prev.astype(x.dtype) * 0
-                m = jax.device_put(chain(sl(m_leaves[i])), dev(msh[i]))
+                if self._offload_master_host:
+                    m = jax.device_put(chain(sl(m_leaves[i])), dev(msh[i]))
+                else:
+                    m = sl(m_leaves[i])
                 mu = jax.device_put(chain(sl(mu_leaves[i])), dev(mush[i]))
                 nu = jax.device_put(chain(sl(nu_leaves[i])), dev(nush[i]))
                 m_n, mu_n, nu_n = adam_leaf_update(
@@ -729,7 +786,9 @@ class DeepSpeedEngine:
                 nu_n = keep(nu_n, nu)
                 p_n = m_n.astype(p_leaves[i].dtype)
                 advance(dev_token(m_n))
-                return (jax.device_put(m_n, msh[i]), jax.device_put(mu_n, mush[i]),
+                m_out = (jax.device_put(m_n, msh[i]) if self._offload_master_host
+                         else m_n)
+                return (m_out, jax.device_put(mu_n, mush[i]),
                         jax.device_put(nu_n, nush[i]), jax.device_put(p_n, psh[i]))
 
             if n_chunks == 1:

@@ -151,3 +151,29 @@ class TestActivationCheckpointing:
         with tracker.fork():
             pass
         assert tracker.get_states()
+
+
+class TestScalingEvidence:
+    def test_solve_breakdown_exact(self):
+        from deepspeed_tpu.profiling.scaling import solve_breakdown
+
+        # synthetic t(g) = 0.5g + 2.0
+        bd = solve_breakdown(4 * 0.5 + 2.0, 4, 16 * 0.5 + 2.0, 16)
+        assert abs(bd["t_micro_s"] - 0.5) < 1e-9
+        assert abs(bd["t_update_s"] - 2.0) < 1e-9
+
+    def test_project_northstar_bounds(self):
+        from deepspeed_tpu.profiling.scaling import project_northstar
+
+        p = project_northstar(n_params=1_557_000_000,
+                              tokens_per_chip_step=8 * 1024 * 16,
+                              flops_per_token=9.3e9,
+                              measured_mfu_1chip=0.45,
+                              peak_flops=197e12, n_chips=64)
+        # full overlap recovers the single-chip MFU; exposure only lowers it
+        assert p["projected_mfu_full_overlap"] == 0.45
+        assert p["projected_mfu_no_overlap"] <= p["projected_mfu_mid_overlap"] \
+            <= p["projected_mfu_full_overlap"]
+        assert p["comm_bytes_per_chip_step"] == int(
+            6 * 1_557_000_000 * 63 / 64)
+        assert "ZeRO-3" in p["assumptions"]

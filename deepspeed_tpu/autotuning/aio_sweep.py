@@ -1,0 +1,121 @@
+"""NVMe AIO performance sweep.
+
+Counterpart of the reference's ``csrc/aio/py_test/aio_bench_perf_sweep.py``
+(:348): measure read/write bandwidth of the native aio layer (csrc/aio via
+ops/aio.py) across block_size x thread_count x queue_depth, and recommend
+the ds_config ``aio`` block that the ZeRO-Infinity SwappedOptimizer
+(runtime/swap_tensor/optimizer_swapper.py) should run with — instead of
+shipping defaults tuned for no machine in particular.
+
+Scoring mirrors the swapper's actual traffic: one optimizer step reads AND
+writes every tensor once, so the recommendation maximizes the harmonic mean
+of read and write bandwidth.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_BLOCK_SIZES = (128 << 10, 1 << 20, 8 << 20)
+DEFAULT_THREAD_COUNTS = (1, 4, 8, 16)
+DEFAULT_QUEUE_DEPTHS = (32,)
+
+
+def _bandwidth_gbps(nbytes: int, seconds: float) -> float:
+    return nbytes / max(seconds, 1e-9) / 1e9
+
+
+def sweep_aio(folder: str,
+              file_mb: int = 64,
+              block_sizes: Sequence[int] = DEFAULT_BLOCK_SIZES,
+              thread_counts: Sequence[int] = DEFAULT_THREAD_COUNTS,
+              queue_depths: Sequence[int] = DEFAULT_QUEUE_DEPTHS,
+              repeats: int = 2) -> Optional[Dict]:
+    """Run the sweep in ``folder`` (should live on the NVMe device the
+    swapper will use). Returns {"results": [...], "recommended_aio": {...}}
+    or None when the native aio module is unavailable."""
+    from deepspeed_tpu.ops.aio import AsyncIOHandle, aio_available
+
+    if not aio_available():
+        logger.warning("aio sweep: native aio module unavailable "
+                       "(csrc/aio build failed?)")
+        return None
+    os.makedirs(folder, exist_ok=True)
+    path = os.path.join(folder, "_aio_sweep.bin")
+    nbytes = int(file_mb) << 20
+    buf = np.random.default_rng(0).integers(
+        0, 255, size=nbytes, dtype=np.uint8)
+    out = np.empty_like(buf)
+
+    results: List[Dict] = []
+    try:
+        for bs, tc, qd in itertools.product(block_sizes, thread_counts,
+                                            queue_depths):
+            h = AsyncIOHandle(block_size=int(bs), queue_depth=int(qd),
+                              thread_count=int(tc))
+            wr, rd = [], []
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                h.sync_pwrite(buf, path)
+                wr.append(_bandwidth_gbps(nbytes, time.perf_counter() - t0))
+                out[:] = 0
+                t0 = time.perf_counter()
+                h.sync_pread(out, path)
+                rd.append(_bandwidth_gbps(nbytes, time.perf_counter() - t0))
+                if not np.array_equal(out, buf):   # per-point integrity
+                    raise RuntimeError(
+                        f"aio sweep read back corrupted data at "
+                        f"block_size={bs} thread_count={tc}")
+            r = {"block_size": int(bs), "thread_count": int(tc),
+                 "queue_depth": int(qd),
+                 "write_gbps": round(max(wr), 3),
+                 "read_gbps": round(max(rd), 3)}
+            # the swapper reads and writes every tensor once per step
+            r["score"] = round(2.0 / (1.0 / max(r["read_gbps"], 1e-9)
+                                      + 1.0 / max(r["write_gbps"], 1e-9)), 3)
+            results.append(r)
+            logger.info(f"aio sweep: bs={bs} threads={tc} qd={qd}: "
+                        f"read {r['read_gbps']}GB/s write {r['write_gbps']}GB/s")
+            del h
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    best = max(results, key=lambda r: r["score"])
+    return {
+        "results": results,
+        "recommended_aio": {
+            "block_size": best["block_size"],
+            "thread_count": best["thread_count"],
+            "queue_depth": best["queue_depth"],
+            "single_submit": False,
+            "overlap_events": True,
+        },
+        "best_read_gbps": best["read_gbps"],
+        "best_write_gbps": best["write_gbps"],
+    }
+
+
+def sweep_and_save(folder: str, output_json: Optional[str] = None,
+                   **kwargs) -> Optional[Dict]:
+    """Sweep and optionally persist the result; the ``recommended_aio``
+    object drops straight into ds_config as the ``"aio"`` block (consumed by
+    SwappedOptimizer via aio_config)."""
+    res = sweep_aio(folder, **kwargs)
+    if res is not None and output_json:
+        with open(output_json, "w") as f:
+            json.dump(res, f, indent=2)
+        logger.info(f"aio sweep: wrote {output_json}; paste "
+                    f"{{\"aio\": {json.dumps(res['recommended_aio'])}}} "
+                    "into ds_config")
+    return res

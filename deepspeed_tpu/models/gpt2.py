@@ -91,9 +91,9 @@ class GPT2Config:
     def __post_init__(self):
         if self.remat not in self.VALID_REMAT:
             raise ValueError(f"remat={self.remat!r} not in {self.VALID_REMAT}")
-        if self.activation not in ("gelu", "gelu_new", "relu"):
+        if self.activation not in ("gelu", "gelu_new", "relu", "quick_gelu"):
             raise ValueError(f"activation {self.activation!r} not in "
-                             "('gelu', 'gelu_new', 'relu')")
+                             "('gelu', 'gelu_new', 'relu', 'quick_gelu')")
         if not 0.0 <= self.rotary_pct <= 1.0:
             raise ValueError(f"rotary_pct {self.rotary_pct} not in [0, 1]")
         if self.alibi and self.rotary_pct:
@@ -102,7 +102,7 @@ class GPT2Config:
         if self.sparse_attention is not None:
             mode = dict(self.sparse_attention).get("mode", "fixed")
             if mode not in ("dense", "fixed", "variable", "bigbird",
-                            "bslongformer"):
+                            "bslongformer", "localslidingwindow"):
                 raise ValueError(f"sparse_attention mode {mode!r} unknown")
             if self.sequence_parallel:
                 raise NotImplementedError(
@@ -187,7 +187,8 @@ class GPT2Model:
                    "fixed": sa.FixedSparsityConfig,
                    "variable": sa.VariableSparsityConfig,
                    "bigbird": sa.BigBirdSparsityConfig,
-                   "bslongformer": sa.BSLongformerSparsityConfig}[mode]
+                   "bslongformer": sa.BSLongformerSparsityConfig,
+                   "localslidingwindow": sa.LocalSlidingWindowSparsityConfig}[mode]
             self._sparse = sa.SparseSelfAttention(
                 cls(num_heads=self.config.n_head, **d))
         from deepspeed_tpu.utils import env_flag
@@ -492,6 +493,8 @@ class GPT2Model:
         act = self.config.activation
         if act == "relu":
             h = jax.nn.relu(h)
+        elif act == "quick_gelu":      # CLIP text encoder: x·sigmoid(1.702x)
+            h = h * jax.nn.sigmoid(1.702 * h)
         else:
             h = jax.nn.gelu(h, approximate=(act == "gelu_new"))
         return h @ blk["fc2_w"].astype(h.dtype) + blk["fc2_b"].astype(h.dtype)

@@ -8,7 +8,8 @@ from deepspeed_tpu.ops.pallas.flash_attention import (flash_attention_sparse,
                                                       sparse_mha_reference)
 from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
     BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
-    FixedSparsityConfig, SparsityConfig, VariableSparsityConfig)
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig, SparsityConfig,
+    VariableSparsityConfig)
 
 
 class SparseSelfAttention:
@@ -64,5 +65,6 @@ class SparseSelfAttention:
 
 __all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
            "VariableSparsityConfig", "BigBirdSparsityConfig",
-           "BSLongformerSparsityConfig", "SparseSelfAttention",
-           "flash_attention_sparse", "sparse_mha_reference"]
+           "BSLongformerSparsityConfig", "LocalSlidingWindowSparsityConfig",
+           "SparseSelfAttention", "flash_attention_sparse",
+           "sparse_mha_reference"]

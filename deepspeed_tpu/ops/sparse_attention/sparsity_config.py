@@ -157,6 +157,28 @@ class BigBirdSparsityConfig(SparsityConfig):
         return layout
 
 
+class LocalSlidingWindowSparsityConfig(SparsityConfig):
+    """Pure sliding window, no global blocks (reference :674 — the last
+    layout in the reference zoo). ``attention='unidirectional'`` (its
+    default) keeps only the causal half of the window."""
+
+    def __init__(self, num_heads, block=16, num_sliding_window_blocks=3,
+                 attention="unidirectional"):
+        super().__init__(num_heads, block)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[0]
+        w = self.num_sliding_window_blocks // 2
+        for i in range(n):
+            lo = max(0, i - w)
+            hi = min(n, i + w + 1) if self.attention == "bidirectional" else i + 1
+            layout[i, lo:hi] = True
+        return layout
+
+
 class BSLongformerSparsityConfig(SparsityConfig):
     """sliding window + selected global blocks (reference :546)."""
 

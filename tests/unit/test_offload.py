@@ -58,6 +58,28 @@ class TestAio:
         with pytest.raises(IOError):
             h.async_pread(np.zeros(16, np.uint8), str(tmp_path / "nope.bin"))
 
+    def test_perf_sweep_recommends_config(self, tmp_path):
+        """aio bench sweep (reference csrc/aio/py_test/
+        aio_bench_perf_sweep.py:348 role): measures every point, verifies
+        data integrity, and recommends a ds_config 'aio' block."""
+        _aio_or_skip()
+        from deepspeed_tpu.autotuning.aio_sweep import sweep_and_save
+
+        out = str(tmp_path / "sweep.json")
+        res = sweep_and_save(str(tmp_path / "nvme"), output_json=out,
+                             file_mb=1, block_sizes=(1 << 16, 1 << 20),
+                             thread_counts=(2, 4), repeats=1)
+        assert res is not None
+        assert len(res["results"]) == 4
+        rec = res["recommended_aio"]
+        assert rec["block_size"] in (1 << 16, 1 << 20)
+        assert rec["thread_count"] in (2, 4)
+        assert all(r["read_gbps"] > 0 and r["write_gbps"] > 0
+                   for r in res["results"])
+        import json as _json
+        with open(out) as f:
+            assert _json.load(f)["recommended_aio"] == rec
+
 
 class TestSwapper:
     def test_roundtrip_and_stats(self, tmp_path):

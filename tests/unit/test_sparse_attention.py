@@ -62,6 +62,23 @@ class TestLayouts:
         assert lay[:, 0].all() and lay[0, :].all()
         assert lay[4, 3] and lay[4, 5] and not lay[4, 6]
 
+    def test_local_sliding_window(self):
+        from deepspeed_tpu.ops.sparse_attention import \
+            LocalSlidingWindowSparsityConfig
+
+        # unidirectional (the reference default): causal half-window only
+        cfg = LocalSlidingWindowSparsityConfig(num_heads=4, block=16,
+                                               num_sliding_window_blocks=3)
+        lay = cfg.make_layout(128)
+        assert lay[4, 3] and lay[4, 4]
+        assert not lay[4, 5]                   # future blocked
+        assert not lay[4, 2]                   # past the window
+        assert not lay[:, 0].all()             # NO global columns
+        bi = LocalSlidingWindowSparsityConfig(
+            num_heads=4, block=16, num_sliding_window_blocks=3,
+            attention="bidirectional").make_layout(128)
+        assert bi[4, 5] and not bi[4, 6]
+
     def test_variable(self):
         cfg = VariableSparsityConfig(num_heads=4, block=16,
                                      local_window_blocks=[2, 3],

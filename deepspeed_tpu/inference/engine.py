@@ -219,14 +219,18 @@ class InferenceEngine:
 
     # ----------------------------------------------------------------- forward
     def forward(self, input_ids, *args, **kwargs):
-        """Full-sequence logits (HF-style forward)."""
-        key = ("fwd",)
+        """HF-style forward. Extra positional arrays pass through to the
+        module's apply — the diffusers surface (UNet takes (sample,
+        timestep, encoder_hidden_states), reference
+        model_implementations/diffusers/unet.py wrapper role)."""
+        key = ("fwd", len(args))
         if key not in self._compiled:
             dq = self._dequant or (lambda p: p)
-            self._compiled[key] = jax.jit(lambda p, ids: self.module.apply(dq(p), ids))
-        ids = jnp.asarray(np.asarray(input_ids))
+            self._compiled[key] = jax.jit(
+                lambda p, *xs: self.module.apply(dq(p), *xs))
+        xs = [jnp.asarray(np.asarray(a)) for a in (input_ids, *args)]
         with self.mesh:
-            return self._compiled[key](self.params, ids)
+            return self._compiled[key](self.params, *xs)
 
     __call__ = forward
 

@@ -1,0 +1,47 @@
+"""CLIP text encoder — the conditioning tower of the stable-diffusion stack.
+
+Counterpart of the reference's CLIP injection policy
+(module_inject/containers/clip.py) and the model_implementations clip
+wrapper. The HF ``CLIPTextTransformer`` is architecturally a GPT-2-style
+pre-LN causal decoder trunk (x += attn(ln1(x)); x += mlp(ln2(x)); final LN)
+with the quick-gelu activation — so it rides GPT2Model unchanged: TP specs,
+flash attention, remat, and init_inference all apply. What CLIP adds is the
+output convention: no LM head; ``apply`` returns the final hidden states and
+``pooled`` gathers the EOT-token feature (the text embedding SD conditions
+on).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+
+class CLIPTextEncoder(GPT2Model):
+    """HF CLIPTextModel-equivalent forward on converted weights."""
+
+    def __init__(self, config: GPT2Config, eos_token_id: int = None):
+        super().__init__(config)
+        self.eos_token_id = eos_token_id
+
+    def apply(self, params, input_ids, rng=None):
+        """(B, T) → last_hidden_state (B, T, D) (after final_layer_norm)."""
+        return self.hidden_states(params, input_ids)
+
+    def pooled(self, params, input_ids):
+        """EOT-token feature (B, D) — HF pooler_output: the hidden state at
+        the eos position (argmax of input_ids when eos_token_id is the
+        largest vocab id, HF's pre-1.5 convention, else first eos match)."""
+        x = self.apply(params, input_ids)
+        if self.eos_token_id is None:
+            eot = jnp.argmax(input_ids, axis=-1)
+        else:
+            is_eos = (input_ids == self.eos_token_id).astype(jnp.int32)
+            eot = jnp.argmax(is_eos, axis=-1)
+        return jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+
+    def loss(self, params, batch, rng=None):
+        raise NotImplementedError(
+            "CLIPTextEncoder is a serving-side conditioning tower; "
+            "contrastive pretraining is out of scope")

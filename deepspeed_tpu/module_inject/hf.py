@@ -858,6 +858,88 @@ def load_gptneo(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]
     return config, params
 
 
+# ------------------------------------------------------------------- CLIP
+def load_clip_text(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
+    """HF ``CLIPTextModel`` (or the text tower of a ``CLIPModel``) →
+    (GPT2Config, params) for CLIPTextEncoder.
+
+    The stable-diffusion conditioning tower (reference counterpart:
+    module_inject/containers/clip.py). CLIP's text transformer is a pre-LN
+    causal trunk with quick-gelu; separate q/k/v fuse into the GPT-2 qkv
+    matrix, final_layer_norm lands in the lnf slots. The vision tower and
+    projection heads are not converted (the reference policy shards the text
+    block reached through the diffusers pipeline too).
+    """
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    cfg = getattr(model_or_sd, "config", None)
+    if cfg is not None and hasattr(cfg, "text_config"):   # full CLIPModel
+        cfg = cfg.text_config
+    n_head = int(getattr(cfg, "num_attention_heads", 0) or 0)
+    if not n_head:
+        raise ValueError("load_clip_text needs the HF model (config carries "
+                         "num_attention_heads), not a bare state dict")
+
+    sd = hf_state_dict(model_or_sd)
+    prefix = "text_model." if any(k.startswith("text_model.") for k in sd) else ""
+    g = lambda name: sd[prefix + name].astype(dtype)
+    n_layer = _layer_count(sd, prefix, "encoder.layers")
+
+    wte = g("embeddings.token_embedding.weight")
+    vocab, d = wte.shape
+
+    def qkv_w(i):
+        p = f"encoder.layers.{i}.self_attn."
+        return np.concatenate([g(p + f"{n}_proj.weight").T
+                               for n in ("q", "k", "v")], axis=1)
+
+    def qkv_b(i):
+        p = f"encoder.layers.{i}.self_attn."
+        return np.concatenate([g(p + f"{n}_proj.bias") for n in ("q", "k", "v")])
+
+    stack_w, stack_b, stack_t = _stackers(g, n_layer, "encoder.layers.{i}.")
+    params = {
+        "wte": wte,
+        "wpe": g("embeddings.position_embedding.weight"),
+        "blocks": {
+            "ln1_g": stack_w("layer_norm1"),
+            "ln1_b": stack_b("layer_norm1"),
+            "qkv_w": np.stack([qkv_w(i) for i in range(n_layer)]),
+            "qkv_b": np.stack([qkv_b(i) for i in range(n_layer)]),
+            "proj_w": stack_t("self_attn.out_proj"),
+            "proj_b": stack_b("self_attn.out_proj"),
+            "ln2_g": stack_w("layer_norm2"),
+            "ln2_b": stack_b("layer_norm2"),
+            "fc_w": stack_t("mlp.fc1"),
+            "fc_b": stack_b("mlp.fc1"),
+            "fc2_w": stack_t("mlp.fc2"),
+            "fc2_b": stack_b("mlp.fc2"),
+        },
+        "lnf_g": g("final_layer_norm.weight"),
+        "lnf_b": g("final_layer_norm.bias"),
+    }
+
+    act = str(getattr(cfg, "hidden_act", "quick_gelu") or "quick_gelu")
+    if act not in ("gelu", "quick_gelu"):
+        raise NotImplementedError(f"CLIP hidden_act {act!r} not supported")
+    # NOTE: no intermediate_size knob on GPT2Config — the matmuls take their
+    # shapes from the converted fc weights, so non-4d CLIP MLPs work as-is
+    config = GPT2Config(
+        vocab_size=vocab,
+        n_positions=int(getattr(cfg, "max_position_embeddings", 77) or 77),
+        n_embd=d, n_layer=n_layer, n_head=n_head,
+        activation=act, dtype=_compute_dtype(dtype))
+    logger.info(f"load_clip_text: {n_layer} layers, d={d}, vocab={vocab}, "
+                f"heads={n_head}")
+    return config, params
+
+
+def _clip_model(config):
+    from deepspeed_tpu.models.clip import CLIPTextEncoder
+
+    return CLIPTextEncoder(config)
+
+
 # ------------------------------------------------------------- DistilBERT
 def load_distilbert(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, Any]]:
     """HF ``DistilBertForMaskedLM`` → (BertConfig, params) for BertModel.
@@ -944,6 +1026,93 @@ def load_distilbert(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, 
     return config, params
 
 
+# -------------------------------------------------------- diffusers (vision)
+def load_unet(model_or_sd: Any, dtype=np.float32, config=None):
+    """diffusers ``UNet2DConditionModel`` (or its state dict) →
+    (UNetConfig, params) for models/diffusion.UNet2DConditionModel.
+
+    The param tree IS the diffusers state dict tree-ified (torch layouts
+    kept; the jax forward indexes the same key names), so this is a dtype
+    cast + nesting — reference counterpart: module_inject/containers/
+    unet.py + model_implementations/diffusers/unet.py. ``config`` may be
+    passed explicitly when the source is a bare state dict.
+    """
+    from deepspeed_tpu.models.diffusion import UNetConfig
+
+    sd = hf_state_dict(model_or_sd)
+    params = state_dict_to_tree({k: v.astype(dtype) for k, v in sd.items()})
+    if config is None:
+        hf = getattr(model_or_sd, "config", None)
+        if hf is None:
+            raise ValueError("load_unet needs a diffusers model (its config "
+                             "carries the block layout) or an explicit "
+                             "UNetConfig")
+        # diffusers' attention_head_dim is really the head COUNT (possibly
+        # per down block, SD-2.x) — UNetConfig keeps the name and semantics
+        hd = getattr(hf, "attention_head_dim", 8)
+        hd = tuple(hd) if isinstance(hd, (list, tuple)) else int(hd)
+        config = UNetConfig(
+            in_channels=int(hf.in_channels),
+            out_channels=int(hf.out_channels),
+            block_out_channels=tuple(hf.block_out_channels),
+            layers_per_block=int(hf.layers_per_block),
+            down_block_types=tuple(hf.down_block_types),
+            up_block_types=tuple(hf.up_block_types),
+            cross_attention_dim=int(hf.cross_attention_dim),
+            attention_head_dim=hd,
+            norm_num_groups=int(getattr(hf, "norm_num_groups", 32) or 32),
+            use_linear_projection=bool(getattr(hf, "use_linear_projection",
+                                               False)),
+            dtype=_compute_dtype(dtype))
+    logger.info(f"load_unet: blocks={config.block_out_channels}, "
+                f"ctx={config.cross_attention_dim}")
+    return config, params
+
+
+def load_vae(model_or_sd: Any, dtype=np.float32, config=None):
+    """diffusers ``AutoencoderKL`` → (VAEConfig, params) for
+    models/diffusion.AutoencoderKL (reference containers/vae.py role).
+    Same tree-ify conversion as load_unet."""
+    from deepspeed_tpu.models.diffusion import VAEConfig
+
+    sd = hf_state_dict(model_or_sd)
+    params = state_dict_to_tree({k: v.astype(dtype) for k, v in sd.items()})
+    if config is None:
+        hf = getattr(model_or_sd, "config", None)
+        if hf is None:
+            raise ValueError("load_vae needs a diffusers model or an "
+                             "explicit VAEConfig")
+        config = VAEConfig(
+            in_channels=int(hf.in_channels),
+            out_channels=int(hf.out_channels),
+            latent_channels=int(hf.latent_channels),
+            block_out_channels=tuple(hf.block_out_channels),
+            layers_per_block=int(hf.layers_per_block),
+            norm_num_groups=int(getattr(hf, "norm_num_groups", 32) or 32),
+            scaling_factor=float(getattr(hf, "scaling_factor", 0.18215)
+                                 or 0.18215),
+            dtype=_compute_dtype(dtype))
+    logger.info(f"load_vae: blocks={config.block_out_channels}, "
+                f"latent={config.latent_channels}")
+    return config, params
+
+
+def export_vision_params(params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Nested diffusers-layout tree → flat dotted state dict (the inverse of
+    state_dict_to_tree; usable to hand weights back to diffusers)."""
+    flat = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}.{k}" if prefix else str(k))
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk(params, "")
+    return flat
+
+
 def _gpt2_model(config):
     from deepspeed_tpu.models.gpt2 import GPT2Model
 
@@ -965,7 +1134,21 @@ _LOADERS = {"gpt2": (load_gpt2, _gpt2_model),
             "gpt_neo": (load_gptneo, _gpt2_model),
             "gptj": (load_gptj, _gpt2_model),
             "bert": (load_bert, _bert_model),
-            "distilbert": (load_distilbert, _bert_model)}
+            "distilbert": (load_distilbert, _bert_model),
+            "clip": (load_clip_text, _clip_model),
+            "clip_text_model": (load_clip_text, _clip_model),
+            "unet": (load_unet, None),
+            "vae": (load_vae, None)}
+
+
+def _vision_factory(architecture):
+    def make(config):
+        from deepspeed_tpu.models.diffusion import (AutoencoderKL,
+                                                    UNet2DConditionModel)
+
+        return (UNet2DConditionModel(config) if architecture == "unet"
+                else AutoencoderKL(config))
+    return make
 
 
 def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,
@@ -980,11 +1163,20 @@ def load_hf_model(model_or_sd: Any, architecture: Optional[str] = None,
     if architecture is None:
         cfg = getattr(model_or_sd, "config", None)
         architecture = getattr(cfg, "model_type", None)
+        if not architecture and cfg is not None:
+            # diffusers configs carry _class_name instead of model_type
+            cls_name = getattr(cfg, "_class_name", None)
+            if cls_name is None and isinstance(cfg, dict):
+                cls_name = cfg.get("_class_name")
+            architecture = {"UNet2DConditionModel": "unet",
+                            "AutoencoderKL": "vae"}.get(cls_name)
     if architecture not in _LOADERS:
         raise NotImplementedError(
             f"no TPU repack for architecture {architecture!r} (have: "
             f"{sorted(_LOADERS)}); use state_dict_to_tree + AutoTP.apply_tp "
             "for spec-only TP placement of the raw tree")
     loader, model_factory = _LOADERS[architecture]
+    if model_factory is None:
+        model_factory = _vision_factory(architecture)
     config, params = loader(model_or_sd, dtype=dtype)
     return model_factory(config), params

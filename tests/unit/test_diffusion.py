@@ -1,0 +1,207 @@
+"""Stable-diffusion vision serving: CLIP text encoder (parity vs
+transformers), UNet2DCondition + AutoencoderKL forwards, diffusers
+state-dict conversion roundtrip, and TP sharding (reference:
+module_inject/containers/{clip,unet,vae}.py +
+model_implementations/diffusers/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models.diffusion import (AutoencoderKL, UNetConfig,
+                                            UNet2DConditionModel, VAEConfig)
+from deepspeed_tpu.module_inject.hf import (export_vision_params,
+                                            load_hf_model, load_unet,
+                                            load_vae)
+
+TINY_UNET = UNetConfig(
+    in_channels=4, out_channels=4, block_out_channels=(32, 64),
+    layers_per_block=1,
+    down_block_types=("CrossAttnDownBlock2D", "DownBlock2D"),
+    up_block_types=("UpBlock2D", "CrossAttnUpBlock2D"),
+    cross_attention_dim=48, attention_head_dim=8, norm_num_groups=8)
+
+TINY_VAE = VAEConfig(in_channels=3, out_channels=3, latent_channels=4,
+                     block_out_channels=(16, 32), layers_per_block=1,
+                     norm_num_groups=8)
+
+
+class TestCLIPText:
+    @pytest.fixture(scope="class")
+    def hf_clip(self):
+        torch = pytest.importorskip("torch")
+        from transformers import CLIPTextConfig, CLIPTextModel
+
+        torch.manual_seed(0)
+        cfg = CLIPTextConfig(vocab_size=128, hidden_size=64,
+                             intermediate_size=128, num_hidden_layers=2,
+                             num_attention_heads=4,
+                             max_position_embeddings=32)
+        return CLIPTextModel(cfg).eval()
+
+    def test_hidden_states_match_torch(self, hf_clip):
+        torch = pytest.importorskip("torch")
+        import dataclasses
+
+        model, params = load_hf_model(hf_clip)
+        model = type(model)(dataclasses.replace(
+            model.config, dtype=jnp.float32, use_flash_attention=False,
+            remat=False))
+        rng = np.random.RandomState(0)
+        ids = rng.randint(4, 124, size=(2, 16)).astype(np.int32)
+        ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+        with torch.no_grad():
+            theirs = hf_clip(torch.tensor(ids, dtype=torch.long))
+        np.testing.assert_allclose(ours, theirs.last_hidden_state.numpy(),
+                                   rtol=2e-3, atol=2e-3)
+        # pooled = EOT feature (argmax convention for this toy vocab)
+        pooled = np.asarray(model.pooled(params, jnp.asarray(ids)))
+        eot = ids.argmax(-1)
+        np.testing.assert_allclose(pooled, ours[np.arange(2), eot], atol=1e-6)
+
+    def test_clip_serves_tp2_matches_tp1(self, hf_clip):
+        import dataclasses
+
+        model, params = load_hf_model(hf_clip)
+        model = type(model)(dataclasses.replace(
+            model.config, dtype=jnp.float32, use_flash_attention=False,
+            remat=False))
+        rng = np.random.RandomState(1)
+        ids = rng.randint(4, 124, size=(2, 16)).astype(np.int32)
+        comm.cdb = None
+        e1 = deepspeed_tpu.init_inference(model, config={"dtype": "float32"},
+                                          params=params)
+        out1 = np.asarray(e1.forward(ids))
+        comm.cdb = None
+        e2 = deepspeed_tpu.init_inference(
+            model, config={"dtype": "float32",
+                           "tensor_parallel": {"tp_size": 2}}, params=params)
+        out2 = np.asarray(e2.forward(ids))
+        np.testing.assert_allclose(out1, out2, rtol=1e-4, atol=1e-4)
+
+
+class TestVAE:
+    def test_encode_decode_shapes_and_roundtrip(self):
+        vae = AutoencoderKL(TINY_VAE)
+        params = vae.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 16, 16))
+        z = vae.encode(params, x)
+        # one downsample (2 blocks) → H/2; latent channels from config
+        assert z.shape == (2, 4, 8, 8)
+        y = vae.decode(params, z)
+        assert y.shape == (2, 3, 16, 16)
+        assert np.isfinite(np.asarray(y)).all()
+
+        # diffusers state-dict conversion roundtrip: export to the flat
+        # dotted layout, re-load through the converter, outputs identical
+        sd = export_vision_params(params)
+        assert "encoder.down_blocks.0.resnets.0.conv1.weight" in sd
+        assert "quant_conv.weight" in sd
+        cfg2, params2 = load_vae(sd, config=TINY_VAE)
+        y2 = AutoencoderKL(cfg2).decode(params2, z)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y2), atol=0)
+
+    def test_vae_through_init_inference(self):
+        comm.cdb = None
+        vae = AutoencoderKL(TINY_VAE)
+        params = vae.init_params(jax.random.PRNGKey(0))
+        eng = deepspeed_tpu.init_inference(vae, config={"dtype": "float32"},
+                                           params=params)
+        x = np.random.RandomState(0).randn(1, 3, 16, 16).astype(np.float32)
+        y = np.asarray(eng.forward(x))
+        assert y.shape == (1, 3, 16, 16)
+
+
+class TestUNet:
+    def test_forward_shapes_and_conversion_roundtrip(self):
+        unet = UNet2DConditionModel(TINY_UNET)
+        params = unet.init_params(jax.random.PRNGKey(0))
+        sample = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 16, 16))
+        ctx = jax.random.normal(jax.random.PRNGKey(2), (2, 7, 48))
+        t = jnp.asarray([3, 500])
+        out = unet.apply(params, sample, t, ctx)
+        assert out.shape == (2, 4, 16, 16)
+        assert np.isfinite(np.asarray(out)).all()
+        # timestep changes the output (the time embedding is live)
+        out2 = unet.apply(params, sample, jnp.asarray([900, 10]), ctx)
+        assert np.abs(np.asarray(out) - np.asarray(out2)).max() > 1e-6
+        # context changes the output (cross-attention is live)
+        out3 = unet.apply(params, sample, t, ctx * 2.0)
+        assert np.abs(np.asarray(out) - np.asarray(out3)).max() > 1e-6
+
+        sd = export_vision_params(params)
+        assert "down_blocks.0.attentions.0.transformer_blocks.0.attn2.to_k.weight" in sd
+        assert "time_embedding.linear_1.weight" in sd
+        cfg2, params2 = load_unet(sd, config=TINY_UNET)
+        o2 = UNet2DConditionModel(cfg2).apply(params2, sample, t, ctx)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(o2), atol=0)
+
+    def test_unet_serves_tp2_matches_tp1(self):
+        unet = UNet2DConditionModel(TINY_UNET)
+        params = unet.init_params(jax.random.PRNGKey(0))
+        sample = np.random.RandomState(0).randn(1, 4, 16, 16).astype(np.float32)
+        ctx = np.random.RandomState(1).randn(1, 7, 48).astype(np.float32)
+        t = np.asarray([42])
+        comm.cdb = None
+        e1 = deepspeed_tpu.init_inference(unet, config={"dtype": "float32"},
+                                          params=params)
+        out1 = np.asarray(e1.forward(sample, t, ctx))
+        comm.cdb = None
+        e2 = deepspeed_tpu.init_inference(
+            unet, config={"dtype": "float32",
+                          "tensor_parallel": {"tp_size": 2}}, params=params)
+        # the cross-attn projections are genuinely tp-sharded
+        w = e2.params["down_blocks"]["0"]["attentions"]["0"][
+            "transformer_blocks"]["0"]["attn1"]["to_q"]["weight"]
+        assert w.addressable_shards[0].data.shape[0] == w.shape[0] // 2
+        out2 = np.asarray(e2.forward(sample, t, ctx))
+        np.testing.assert_allclose(out1, out2, rtol=1e-4, atol=1e-4)
+
+    def test_per_block_head_counts(self):
+        """SD-2.x style per-down-block attention_head_dim list (diffusers'
+        misnamed head COUNT, upstream #2011); up blocks read it reversed."""
+        import dataclasses
+
+        cfg = dataclasses.replace(TINY_UNET, attention_head_dim=(4, 8))
+        assert cfg.heads_for(0) == 4 and cfg.heads_for(1) == 8
+        unet = UNet2DConditionModel(cfg)
+        params = unet.init_params(jax.random.PRNGKey(0))
+        out = unet.apply(params,
+                         jax.random.normal(jax.random.PRNGKey(1), (1, 4, 16, 16)),
+                         jnp.asarray([7]),
+                         jax.random.normal(jax.random.PRNGKey(2), (1, 7, 48)))
+        assert out.shape == (1, 4, 16, 16)
+        with pytest.raises(ValueError, match="per-block"):
+            dataclasses.replace(TINY_UNET, attention_head_dim=(4, 8, 16))
+
+    def test_load_hf_model_dispatches_diffusers_class_name(self):
+        """A diffusers-style object (config._class_name) routes to the
+        vision loaders without an explicit architecture."""
+        unet = UNet2DConditionModel(TINY_UNET)
+        params = unet.init_params(jax.random.PRNGKey(0))
+        sd = export_vision_params(params)
+
+        class FakeDiffusers:
+            class config:
+                _class_name = "UNet2DConditionModel"
+                in_channels = TINY_UNET.in_channels
+                out_channels = TINY_UNET.out_channels
+                block_out_channels = TINY_UNET.block_out_channels
+                layers_per_block = TINY_UNET.layers_per_block
+                down_block_types = TINY_UNET.down_block_types
+                up_block_types = TINY_UNET.up_block_types
+                cross_attention_dim = TINY_UNET.cross_attention_dim
+                attention_head_dim = TINY_UNET.attention_head_dim
+                norm_num_groups = TINY_UNET.norm_num_groups
+                use_linear_projection = False
+
+            def state_dict(self):
+                return sd
+
+        model, params2 = load_hf_model(FakeDiffusers())
+        assert isinstance(model, UNet2DConditionModel)
+        assert model.config.block_out_channels == TINY_UNET.block_out_channels

@@ -15,16 +15,27 @@ Set BENCH_MODEL to bench exactly one preset (gpt2-*/gpt2-moe-*/llama-*/
 bert-*), BENCH_SUITE=0 to skip the extra presets.
 
 Env knobs: BENCH_MODEL, BENCH_BS (per-chip microbatch), BENCH_SEQ,
-BENCH_STEPS, BENCH_GAS, BENCH_REMAT (none|full|dots|attn; default attn for
-decoders, none for bert), BENCH_OFFLOAD (none|cpu). Measured per-family
+BENCH_STEPS, BENCH_GAS, BENCH_REMAT (none|full|dots|attn|attn_mlp; default
+attn for decoders, none for bert), BENCH_OFFLOAD (none|cpu), BENCH_UNROLL,
+BENCH_FLASH_BLOCK, BENCH_FLASH (bert einsum switch). Measured per-family
 sweet spots on one v5e chip:
 - gpt2-760m: 0.512 MFU (bs=12, remat='attn', flash_block=1024 — the
-  full-sequence tile; the 512 default tile measured 0.501, 256 regresses
-  to 0.434)
-- bert-large (the reference's own headline family): 0.46 MFU at
+  full-sequence tile; 512 measured 0.501, 256 regresses to 0.434).
+  Negative results from the r4 sweep, so they are not re-probed: bs=14
+  0.500, bs=16 OOM by 374M, gas=2 0.453 (accumulation-scan overhead),
+  scan unroll=4 0.448, remat='attn_mlp' (save gelu outs too) OOM at bs=12
+  and 0.442 at bs=8 — the raw-util loss below bs=12 outweighs the saved
+  MLP recompute.
+- gpt2-1.3b / gpt2-xl (ZeRO-Offload ladder): 0.342 / 0.211 MFU at
+  gas=32/16 — the host round-trip amortized over a GPT-2-paper-sized
+  token batch; xl gas=32 faults the TPU worker.
+- bert-large (the reference's own headline family): 0.463 MFU at
   bs=12/seq=512/gas=4 — no remat + unrolled layer loop + MLM head over
   gathered masked positions (honest accounting: skipped head flops
-  subtracted). Round-2 state was 0.33 with forced full remat.
+  subtracted); flash beats einsum at seq=512 (0.428). At the reference
+  record's own seq=128 phase-1 config: 0.478 (bs=48, gas=8) vs the
+  published 64 TFLOPS/V100 ≈ 51% — close but not yet parity.
+- gpt2-moe-125m (Switch-8): 0.253 MFU at bs=12 (bs=8 0.256, bs=24 0.200).
 """
 
 import json
@@ -94,8 +105,15 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         # synthetic batch is generated with the same cap so no label is ever
         # dropped by the gather (loss stays exact)
         maxp = int(math.ceil(0.15 * seq) + 3)
+        # full-sequence flash tile: the bidirectional grid has no triangular
+        # skip, so one 512-wide tile removes the tiling overhead entirely
+        fb = int(os.environ.get("BENCH_FLASH_BLOCK", min(seq, 512)))
         config = dataclasses.replace(
-            config, scan_unroll=config.n_layer, max_predictions_per_seq=maxp)
+            config,
+            scan_unroll=int(os.environ.get("BENCH_UNROLL", config.n_layer)),
+            max_predictions_per_seq=maxp,
+            flash_block=fb or None,
+            use_flash_attention=os.environ.get("BENCH_FLASH", "1") != "0")
         make_batch = partial(make_batch, max_predictions=maxp)
     elif (not model_name.startswith("llama") and not big
           and seq >= 1024 and on_tpu):
@@ -104,7 +122,9 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         # Scoped to the measured headline class; the offload-backed ladder
         # models and llama keep the kernel default until measured.
         fb = int(os.environ.get("BENCH_FLASH_BLOCK", 1024))
-        config = dataclasses.replace(config, flash_block=fb or None)
+        config = dataclasses.replace(config, flash_block=fb or None,
+                                     scan_unroll=int(os.environ.get(
+                                         "BENCH_UNROLL", 1)))
     # offload-backed models: fewer timed steps (each is seconds), and large
     # accumulation — the way ZeRO-Offload is actually run: the 15G fp32
     # streamed Adam pass amortizes over the accumulation window

@@ -228,7 +228,17 @@ class InferenceEngine:
             dq = self._dequant or (lambda p: p)
             self._compiled[key] = jax.jit(
                 lambda p, *xs: self.module.apply(dq(p), *xs))
-        xs = [jnp.asarray(np.asarray(a)) for a in (input_ids, *args)]
+
+        def to_dev(a):
+            # jax arrays (the natural denoising-loop state) pass through
+            # without a host round-trip; only foreign tensor types (torch)
+            # detour via numpy
+            try:
+                return jnp.asarray(a)
+            except TypeError:
+                return jnp.asarray(np.asarray(a))
+
+        xs = [to_dev(a) for a in (input_ids, *args)]
         with self.mesh:
             return self._compiled[key](self.params, *xs)
 

@@ -65,6 +65,10 @@ class BertConfig:
     # never re-runs the kernel (the policy behind gpt2's headline MFU)
     remat: Any = False
     use_flash_attention: bool = True
+    # flash kernel tile edge (block_q == block_k); None = kernel default.
+    # The bidirectional grid has no triangular skip, so the full-sequence
+    # tile (= seq_len) removes all tiling overhead at BERT's short seqs
+    flash_block: Optional[int] = None
     # lax.scan unroll factor for the layer loop: >1 trades compile time for
     # schedule freedom (fewer while-loop iterations and less saved-activation
     # dynamic-update-slice traffic, which profiles as ~15% of a remat='dots'
@@ -215,7 +219,8 @@ class BertModel:
         return local_causal_attention(q, k, v,
                                       use_flash=self.config.use_flash_attention,
                                       causal=False,
-                                      key_padding_mask=attention_mask)
+                                      key_padding_mask=attention_mask,
+                                      flash_block=self.config.flash_block)
 
     def _block(self, x, blk, attention_mask):
         c = self.config

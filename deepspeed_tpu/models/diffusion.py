@@ -84,16 +84,19 @@ def _silu(x):
 
 def _mha(q, k, v, n_heads: int):
     """(B, Tq, C) x (B, Tk, C) attention, torch-layout projections applied
-    by the caller."""
+    by the caller. Routes through the shared non-causal dispatch
+    (models/common.py — the BERT path: Pallas flash on TPU, einsum
+    elsewhere) so 64x64-latent self-attention (T=4096) streams through the
+    blocked kernel instead of materializing (B, H, T, T) fp32 scores."""
+    from deepspeed_tpu.models.common import local_causal_attention
+
     B, Tq, C = q.shape
     Tk = k.shape[1]
     dh = C // n_heads
-    qh = q.reshape(B, Tq, n_heads, dh)
-    kh = k.reshape(B, Tk, n_heads, dh)
-    vh = v.reshape(B, Tk, n_heads, dh)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) / math.sqrt(dh)
-    a = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", a, vh).reshape(B, Tq, C)
+    out = local_causal_attention(
+        q.reshape(B, Tq, n_heads, dh), k.reshape(B, Tk, n_heads, dh),
+        v.reshape(B, Tk, n_heads, dh), use_flash=True, causal=False)
+    return out.reshape(B, Tq, C)
 
 
 def timestep_embedding(timesteps, dim: int, max_period: float = 10000.0):
@@ -137,6 +140,8 @@ class UNetConfig:
     def __post_init__(self):
         if len(self.down_block_types) != len(self.block_out_channels):
             raise ValueError("down_block_types must match block_out_channels")
+        if len(self.up_block_types) != len(self.block_out_channels):
+            raise ValueError("up_block_types must match block_out_channels")
         if isinstance(self.attention_head_dim, (list, tuple)) and \
                 len(self.attention_head_dim) != len(self.block_out_channels):
             raise ValueError("per-block attention_head_dim must match "
@@ -348,10 +353,12 @@ class UNet2DConditionModel:
                                    "2": lin(4 * c, c)}},
                 }}}
 
-        t_dim = cfg.block_out_channels[0]
+        # diffusers: sinusoid dim = bc[0], time_embed_dim = 4*bc[0]
+        sin_dim = cfg.block_out_channels[0]
+        t_dim = 4 * sin_dim
         params: Dict[str, Any] = {
             "conv_in": conv(cfg.in_channels, cfg.block_out_channels[0]),
-            "time_embedding": {"linear_1": lin(t_dim, t_dim),
+            "time_embedding": {"linear_1": lin(sin_dim, t_dim),
                                "linear_2": lin(t_dim, t_dim)},
             "down_blocks": {}, "up_blocks": {},
             "conv_norm_out": norm(cfg.block_out_channels[0]),
@@ -555,8 +562,7 @@ def _vision_tp_specs(model) -> Any:
     q/k/v and GEGLU projections column-parallel, their output projections
     row-parallel, everything else replicated. Torch Linear stores (out, in),
     so column-parallel = shard dim 0."""
-    params = model.init_params(jax.random.PRNGKey(0))
-    shapes = jax.eval_shape(lambda: params)
+    shapes = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
 
     COL = ("to_q", "to_k", "to_v")
 

@@ -85,8 +85,13 @@ class GPT2Config:
     # windowed layers take the einsum path (the flash kernel has no window).
     attention_layers: Optional[tuple] = None
     window_size: int = 256
+    # lax.scan unroll factor for the layer loop (same knob as bert's): >1
+    # trades compile time for schedule freedom — fewer while-loop iterations
+    # and less saved-activation dynamic-update-slice traffic
+    scan_unroll: int = 1
 
-    VALID_REMAT = (False, None, "none", True, "full", "dots", "attn")
+    VALID_REMAT = (False, None, "none", True, "full", "dots", "attn",
+                   "attn_mlp")
 
     def __post_init__(self):
         if self.remat not in self.VALID_REMAT:
@@ -376,6 +381,14 @@ class GPT2Model:
         if c.remat == "attn":
             return jax.checkpoint(
                 fn, policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
+        if c.remat == "attn_mlp":
+            # middle rung between 'attn' (5d/token saved vs 3d): also save
+            # the gelu output, so the backward re-runs neither the flash
+            # kernel nor the two fat MLP matmuls — ~8d² of the 12d² per-layer
+            # recompute disappears for 4d/token more HBM
+            return jax.checkpoint(
+                fn, policy=jax.checkpoint_policies.save_only_these_names(
+                    "attn_out", "mlp_act"))
         return fn
 
     def _trunk(self, params, input_ids, rng=None):
@@ -398,7 +411,8 @@ class GPT2Model:
             return x, None
 
         x, _ = jax.lax.scan(scan_body, x,
-                            (params["blocks"], layer_rngs, windows))
+                            (params["blocks"], layer_rngs, windows),
+                            unroll=max(1, int(c.scan_unroll)))
         return self._layer_norm(x, params["lnf_g"], params["lnf_b"])
 
     def hidden_states(self, params, input_ids, rng=None):
@@ -497,6 +511,9 @@ class GPT2Model:
             h = h * jax.nn.sigmoid(1.702 * h)
         else:
             h = jax.nn.gelu(h, approximate=(act == "gelu_new"))
+        # named so remat='attn_mlp' can save the activation and skip the
+        # fc/fc2 matmul recompute in backward
+        h = checkpoint_name(h, "mlp_act")
         return h @ blk["fc2_w"].astype(h.dtype) + blk["fc2_b"].astype(h.dtype)
 
     def _block_finish(self, x, blk, attn, rng=None):

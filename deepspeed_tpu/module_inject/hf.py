@@ -929,6 +929,11 @@ def load_clip_text(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, A
         n_positions=int(getattr(cfg, "max_position_embeddings", 77) or 77),
         n_embd=d, n_layer=n_layer, n_head=n_head,
         activation=act, dtype=_compute_dtype(dtype))
+    # pooled() needs the real EOS id (argmax-of-ids only matches the
+    # original CLIP vocab where EOS is the largest token); ride it on the
+    # config instance so _clip_model can hand it to the encoder
+    eos = getattr(cfg, "eos_token_id", None)
+    config._clip_eos_token_id = int(eos) if eos is not None else None
     logger.info(f"load_clip_text: {n_layer} layers, d={d}, vocab={vocab}, "
                 f"heads={n_head}")
     return config, params
@@ -937,7 +942,8 @@ def load_clip_text(model_or_sd: Any, dtype=np.float32) -> Tuple[Any, Dict[str, A
 def _clip_model(config):
     from deepspeed_tpu.models.clip import CLIPTextEncoder
 
-    return CLIPTextEncoder(config)
+    return CLIPTextEncoder(config, eos_token_id=getattr(
+        config, "_clip_eos_token_id", None))
 
 
 # ------------------------------------------------------------- DistilBERT

@@ -192,12 +192,23 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     peak = get_accelerator().peak_flops()
     mfu = achieved / peak
 
+    # free this preset's device memory before the next ladder entry (the
+    # north-star evidence step otherwise inherits a chip full of dead
+    # buffers pinned by compiled-program constants and OOMs)
+    final_loss = float(loss)
+    engine.state = None
+    engine.invalidate_compiled()
+    jax.clear_caches()
+    import gc
+
+    gc.collect()
+
     off_tag = f", offload={offload}" if offload != "none" else ""
     return {
         "metric": f"{model_name} pretrain MFU (bs={per_chip_bs}/chip, seq={seq}, "
                   f"{n_dev} chip(s), gas={gas}{off_tag}, "
                   f"tok/s/chip={tok_per_sec_chip:.0f}, "
-                  f"TFLOPs/chip={achieved/1e12:.1f}, loss={float(loss):.3f})",
+                  f"TFLOPs/chip={achieved/1e12:.1f}, loss={final_loss:.3f})",
         "value": round(mfu, 4),
         "unit": "MFU",
         "vs_baseline": round(mfu / 0.50, 4),

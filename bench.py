@@ -49,6 +49,17 @@ import jax
 import numpy as np
 
 
+def _release(engine):
+    """Drop an engine's device memory: state, compiled programs (their
+    constants pin buffers), and jit caches."""
+    import gc
+
+    engine.state = None
+    engine.invalidate_compiled()
+    jax.clear_caches()
+    gc.collect()
+
+
 def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     import dataclasses
 
@@ -196,12 +207,7 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     # north-star evidence step otherwise inherits a chip full of dead
     # buffers pinned by compiled-program constants and OOMs)
     final_loss = float(loss)
-    engine.state = None
-    engine.invalidate_compiled()
-    jax.clear_caches()
-    import gc
-
-    gc.collect()
+    _release(engine)
 
     off_tag = f", offload={offload}" if offload != "none" else ""
     return {
@@ -250,11 +256,7 @@ def northstar_evidence(on_tpu: bool, n_dev: int) -> dict:
             loss = engine.train_batch(batch)
         float(loss)
         times[gas] = (time.time() - t0) / 2
-        engine.state = None
-        engine.invalidate_compiled()
-        import gc
-
-        gc.collect()
+        _release(engine)
 
     bd = solve_breakdown(times[4], 4, times[16], 16)
     t_micro, t_update = bd["t_micro_s"], bd["t_update_s"]

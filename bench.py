@@ -109,7 +109,12 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     seq = int(os.environ.get("BENCH_SEQ", min(1024, config.n_positions)))
     default_bs = 12 if on_tpu else 2
     if big and on_tpu:
-        default_bs = 8  # offload-backed: activations+params share HBM with grads
+        # offload-backed: bigger microbatches amortize the streamed update
+        # over more tokens. Measured stable ceilings: 1.3b bs=16 (0.394 MFU;
+        # bs>=20 faults the TPU worker), xl bs=12 (0.243; bs=16 faults).
+        # 2.7b/6.7b are unmeasured and larger than xl: keep the conservative
+        # bs=8 rather than defaulting past a known fault boundary.
+        default_bs = {"gpt2-1.3b": 16, "gpt2-xl": 12}.get(model_name, 8)
     per_chip_bs = int(os.environ.get("BENCH_BS", default_bs))
     if bert:
         # the canonical BERT max_predictions_per_seq (80 at seq=512); the
@@ -236,33 +241,44 @@ def northstar_evidence(on_tpu: bool, n_dev: int) -> dict:
 
     config = dataclasses.replace(PRESETS["gpt2-xl"], remat="attn")
     seq, bs = 1024, 8
-    times = {}
-    for gas in (4, 16):
-        engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(config), config={
-            "train_batch_size": bs * n_dev * gas,
-            "gradient_accumulation_steps": gas,
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-            "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 1,
-                                  "offload_optimizer": {"device": "cpu"}},
-            "data_types": {"grad_accum_dtype": "bf16"},
-            "gradient_clipping": 1.0, "steps_per_print": 0})
-        batch = engine._shard_batch(synthetic_lm_batch(
-            bs * n_dev * gas, seq, config.vocab_size, seed=0))
-        loss = engine.train_batch(batch)
-        float(loss)
-        t0 = time.time()
-        for _ in range(2):
-            loss = engine.train_batch(batch)
-        float(loss)
-        times[gas] = (time.time() - t0) / 2
-        _release(engine)
-
-    bd = solve_breakdown(times[4], 4, times[16], 16)
-    t_micro, t_update = bd["t_micro_s"], bd["t_update_s"]
     peak = get_accelerator().peak_flops()
     fpt = config.flops_per_token(seq)
-    compute_mfu = (bs * seq / max(t_micro, 1e-9)) * fpt / peak
+    # wall-clock through the measurement can be disturbed (host contention,
+    # VM scheduling): a gas=16 point that comes out FASTER per micro than
+    # gas=4 yields t_micro<=0 and a nonsense breakdown — retry once, then
+    # fail loudly (the caller prints a FAILED evidence line)
+    for attempt in range(2):
+        times = {}
+        for gas in (4, 16):
+            engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(config), config={
+                "train_batch_size": bs * n_dev * gas,
+                "gradient_accumulation_steps": gas,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 1,
+                                      "offload_optimizer": {"device": "cpu"}},
+                "data_types": {"grad_accum_dtype": "bf16"},
+                "gradient_clipping": 1.0, "steps_per_print": 0})
+            batch = engine._shard_batch(synthetic_lm_batch(
+                bs * n_dev * gas, seq, config.vocab_size, seed=0))
+            loss = engine.train_batch(batch)
+            float(loss)
+            t0 = time.time()
+            for _ in range(2):
+                loss = engine.train_batch(batch)
+            float(loss)
+            times[gas] = (time.time() - t0) / 2
+            _release(engine)
+
+        bd = solve_breakdown(times[4], 4, times[16], 16)
+        t_micro, t_update = bd["t_micro_s"], bd["t_update_s"]
+        compute_mfu = (bs * seq / max(t_micro, 1e-9)) * fpt / peak
+        if 0.0 < compute_mfu < 1.0:
+            break
+    else:
+        raise RuntimeError(
+            f"unstable breakdown after retry: times={times}, "
+            f"t_micro={t_micro:.4f}s (measurement disturbed)")
     proj = project_northstar(
         n_params=config.num_params(),
         tokens_per_chip_step=bs * seq * 16,

@@ -111,10 +111,11 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     if big and on_tpu:
         # offload-backed: bigger microbatches amortize the streamed update
         # over more tokens. Measured stable ceilings: 1.3b bs=16 (0.394 MFU;
-        # bs>=20 faults the TPU worker), xl bs=12 (0.243; bs=16 faults).
-        # 2.7b/6.7b are unmeasured and larger than xl: keep the conservative
-        # bs=8 rather than defaulting past a known fault boundary.
-        default_bs = {"gpt2-1.3b": 16, "gpt2-xl": 12}.get(model_name, 8)
+        # bs>=20 faults the TPU worker), xl bs=14 (0.252-0.255 over two
+        # runs; bs=16 faults). 2.7b/6.7b are unmeasured and larger than xl:
+        # keep the conservative bs=8 rather than defaulting past a known
+        # fault boundary.
+        default_bs = {"gpt2-1.3b": 16, "gpt2-xl": 14}.get(model_name, 8)
     per_chip_bs = int(os.environ.get("BENCH_BS", default_bs))
     if bert:
         # the canonical BERT max_predictions_per_seq (80 at seq=512); the

@@ -67,35 +67,15 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     from deepspeed_tpu.accelerator import get_accelerator
     from deepspeed_tpu.models.gpt2 import GPT2Model, PRESETS, synthetic_lm_batch
 
-    # model registry: gpt2-* (default flagship), gpt2-moe-* (Switch-style
-    # top-1 8-expert bank on every other block — the BASELINE "Switch-8-expert
-    # MoE" milestone), llama-*, bert-* (the reference's own headline benchmark
-    # family — MLM pretraining)
-    moe_experts = 0
-    if model_name.startswith("llama"):
-        from deepspeed_tpu.models.llama import PRESETS as LLAMA_PRESETS, LlamaModel
+    # model-family registry shared with ds_tune (models/registry.py):
+    # gpt2-* (default flagship), gpt2-moe-* (Switch-style top-1 expert bank
+    # on every other block — the BASELINE "Switch-8-expert MoE" milestone;
+    # MFU counts each token's ONE routed expert, honest w.r.t. useful math),
+    # llama-*, bert-* (the reference's own headline benchmark family)
+    from deepspeed_tpu.models.registry import resolve_family
 
-        PRESETS, model_cls, make_batch = LLAMA_PRESETS, LlamaModel, synthetic_lm_batch
-    elif model_name.startswith("bert"):
-        from deepspeed_tpu.models.bert import (PRESETS as BERT_PRESETS, BertModel,
-                                               synthetic_mlm_batch)
-
-        PRESETS, model_cls, make_batch = BERT_PRESETS, BertModel, synthetic_mlm_batch
-    elif model_name.startswith("gpt2-moe"):
-        from deepspeed_tpu.models.gpt2_moe import MoEGPT2
-
-        # "gpt2-moe-125m" rides the gpt2-125m trunk; E=8 top-1 experts on odd
-        # blocks. Single chip → ep_size=1 (the full bank lives on the chip;
-        # the dp×ep a2a program is covered by dryrun_multichip). MFU counts
-        # each token's ONE routed expert (= the dense trunk's flops): honest
-        # w.r.t. useful math — capacity padding is overhead, not credit.
-        moe_experts = int(os.environ.get("BENCH_EXPERTS", 8))
-        model_cls = partial(MoEGPT2, num_experts=moe_experts, ep_size=1)
-        make_batch = synthetic_lm_batch
-        model_name_base = model_name.replace("-moe", "")
-        PRESETS = {model_name: PRESETS[model_name_base]}
-    else:
-        model_cls, make_batch = GPT2Model, synthetic_lm_batch
+    model_cls, make_batch, PRESETS = resolve_family(
+        model_name, moe_experts=int(os.environ.get("BENCH_EXPERTS", 8)))
 
     config = PRESETS[model_name]
     # measured per-family sweet spots on one v5e chip (see docstring):
@@ -326,6 +306,12 @@ def _subproc_line(env_overrides, name, unit="MFU", timeout_s=1500):
     import subprocess
 
     def parse(stdout, stderr):
+        # TimeoutExpired carries BYTES even under text=True (observed on
+        # this Python 3.12) — normalize before parsing
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
         for line in reversed((stdout or "").strip().splitlines()):
             if line.startswith("{"):
                 return json.loads(line)

@@ -154,3 +154,23 @@ class TestAutotunerAxes:
         first_off = next(i for i, c in enumerate(ordered)
                          if c["_tune"]["offload"])
         assert all(not c["_tune"]["offload"] for c in ordered[:first_off])
+
+
+class TestDsTuneCLI:
+    def test_family_dispatch_bert(self, tmp_path, capsys, monkeypatch):
+        """ds_tune drives non-GPT2 families (reference autotuning runner
+        role): bert preset + MLM batches through a real 2-candidate tune."""
+        import runpy
+        import sys
+
+        monkeypatch.setattr(sys, "argv", [
+            "ds_tune", "--model", "bert-tiny", "--seq", "64",
+            "--mbs", "2", "--remat", "none", "--steps", "1",
+            "--output", str(tmp_path)])
+        runpy.run_path(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "bin", "ds_tune"),
+            run_name="__main__")
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        res = json.loads(out)
+        assert res["status"] == "ok"
+        assert res["tuned"]["micro_batch"] == 2

@@ -321,6 +321,7 @@ def _subproc_line(env_overrides, name, unit="MFU", timeout_s=1500):
     env = dict(os.environ, BENCH_SUITE="0", **env_overrides)
     last = None
     for attempt in range(2):   # worker crashes are intermittent: retry once
+        t0 = time.time()
         try:
             out = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                  env=env, capture_output=True, text=True,
@@ -335,6 +336,11 @@ def _subproc_line(env_overrides, name, unit="MFU", timeout_s=1500):
                 last = _fail_line(name, e, unit)
         except Exception as e:
             last = _fail_line(name, e, unit)
+        if time.time() - t0 > 300:
+            # slow failure (hang/timeout, not a crash): a retry would burn
+            # another full window for the same outcome — bound the ladder's
+            # worst-case wall time instead
+            break
         if attempt == 0:
             time.sleep(20)     # let a crashed TPU worker restart
     return last

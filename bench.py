@@ -17,15 +17,21 @@ bert-*), BENCH_SUITE=0 to skip the extra presets.
 Env knobs: BENCH_MODEL, BENCH_BS (per-chip microbatch), BENCH_SEQ,
 BENCH_STEPS, BENCH_GAS, BENCH_REMAT (none|full|dots|attn|attn_mlp; default
 attn for decoders, none for bert), BENCH_OFFLOAD (none|cpu), BENCH_UNROLL,
-BENCH_FLASH_BLOCK, BENCH_FLASH (bert einsum switch). Measured per-family
+BENCH_FLASH_BLOCK, BENCH_FLASH (bert einsum switch), BENCH_EXPERTS (moe
+bank size), BENCH_HEADS (head-count override at fixed n_embd; gpt2/bert
+only — params/flops are head-count invariant there). Measured per-family
 sweet spots on one v5e chip:
-- gpt2-760m: 0.512 MFU (bs=12, remat='attn', flash_block=1024 — the
-  full-sequence tile; 512 measured 0.501, 256 regresses to 0.434).
-  Negative results from the r4 sweep, so they are not re-probed: bs=14
-  0.500, bs=16 OOM by 374M, gas=2 0.453 (accumulation-scan overhead),
-  scan unroll=4 0.448, remat='attn_mlp' (save gelu outs too) OOM at bs=12
-  and 0.442 at bs=8 — the raw-util loss below bs=12 outweighs the saved
-  MLP recompute.
+- gpt2-760m: 0.533 MFU (bs=12, remat='attn', flash_block=1024 — the
+  full-sequence tile; 512 measured 0.521, 256 regresses to 0.461 — and
+  n_head=12, i.e. head_dim=128 = the MXU lane width; the GPT-2-paper-ish
+  16 heads pad every attention MXU pass 96->128 and measured 0.512).
+  Negative results from the r4 sweeps, so they are not re-probed: bs=14
+  0.520, bs=16 OOM by 374M, gas=2 0.453 (accumulation-scan overhead),
+  scan unroll=2 0.523 / 4 0.448, remat='attn_mlp' (save gelu outs too)
+  OOM at bs=12 and 0.442 at bs=8 — the raw-util loss below bs=12
+  outweighs the saved MLP recompute; remat='dots'+offload crashes the
+  XLA compile helper; remat='attn'+offload gas=8 0.427 (host round-trip
+  tax beats the recompute saving at this size).
 - gpt2-1.3b / gpt2-xl (ZeRO-Offload ladder): 0.342 / 0.211 MFU at
   gas=32/16 — the host round-trip amortized over a GPT-2-paper-sized
   token batch; xl gas=32 faults the TPU worker.
@@ -78,6 +84,18 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         model_name, moe_experts=int(os.environ.get("BENCH_EXPERTS", 8)))
 
     config = PRESETS[model_name]
+    heads = int(os.environ.get("BENCH_HEADS", 0))
+    if heads and model_name.startswith("llama"):
+        # LlamaConfig.__post_init__ has already resolved n_kv_head from the
+        # preset's n_head: replacing n_head would silently flip the model to
+        # GQA with a different kv_dim (params/flops NOT invariant there)
+        raise ValueError("BENCH_HEADS supports gpt2/bert families only")
+    if heads:
+        # head-count override at constant n_embd: params and flops_per_token
+        # are head-count invariant, so MFU stays comparable; head_dim=128
+        # (the MXU-native lane width) is the TPU-first choice where the
+        # GPT-2 paper shapes give 96 or 100
+        config = dataclasses.replace(config, n_head=heads)
     # measured per-family sweet spots on one v5e chip (see docstring):
     # decoders want 'attn' remat (save flash outputs, recompute the cheap
     # matmul chain); bert-large fits WITHOUT remat at bs=12 once the layer

@@ -160,7 +160,7 @@ PRESETS = {
     "gpt2-350m": GPT2Config(n_embd=1024, n_layer=24, n_head=16),
     # 12 heads, not the GPT-2-paper-style 16: head_dim 128 = the MXU lane
     # width, so QK^T/PV tiles carry no K-dim padding (16 heads -> head_dim 96
-    # pads every MXU pass 96->128; measured 0.512 -> 0.533 MFU on v5e).
+    # pads every MXU pass 96->128; measured 0.512 -> 0.533-0.536 MFU on v5e).
     # Param count and flops_per_token are head-count invariant.
     "gpt2-760m": GPT2Config(n_embd=1536, n_layer=24, n_head=12),
     "gpt2-1.3b": GPT2Config(n_embd=2048, n_layer=24, n_head=16, n_positions=2048),

@@ -36,3 +36,19 @@ def resolve_family(model_name: str, moe_experts: int = 8
         return cls, synthetic_lm_batch, {
             model_name: GPT2_PRESETS[model_name.replace("-moe", "")]}
     return GPT2Model, synthetic_lm_batch, GPT2_PRESETS
+
+
+def mxu_aligned(config):
+    """TPU-native pretrain head layout: head_dim = 128 (the MXU lane width).
+
+    Param- and flop-count invariant for plain multi-head attention (gpt2/bert
+    families — do NOT use for llama GQA, where kv_dim follows n_kv_head).
+    Applied by bench.py and bin/ds_tune through this one helper so the tuner
+    sweeps the same model the bench measures. No-op when n_embd is not a
+    multiple of 128 (e.g. gpt2-xl's 1600) or the layout is already aligned.
+    """
+    import dataclasses
+
+    if config.n_embd % 128 == 0 and config.n_head != config.n_embd // 128:
+        return dataclasses.replace(config, n_head=config.n_embd // 128)
+    return config

@@ -42,7 +42,8 @@ sweet spots on one v5e chip:
   subtracted); flash beats einsum at seq=512. At the reference record's
   own seq=128 phase-1 config: 0.611 (bs=48, gas=8) vs the published
   64 TFLOPS/V100 ≈ 51% — BEATS the reference's record efficiency.
-- gpt2-moe-125m (Switch-8): 0.253 MFU at bs=12 (bs=8 0.256, bs=24 0.200).
+- gpt2-moe-125m (Switch-8): 0.390 MFU at bs=12 with the MXU-aligned
+  6x128 head layout (12x64 canonical: 0.328; bs=16 0.370, bs=24 0.200).
 """
 
 import json
@@ -100,6 +101,15 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
             raise ValueError(f"BENCH_HEADS={heads} does not divide "
                              f"n_embd={config.n_embd}")
         config = dataclasses.replace(config, n_head=heads)
+    elif not model_name.startswith("llama") and on_tpu:
+        # TPU-native pretrain head layout: head_dim 128 at fixed n_embd
+        # (param/flop invariant; no-op when n_embd%128 or already aligned —
+        # 760m/1.3b presets are, xl's 1600 can't be). Measured: bert-large
+        # 0.463 -> 0.556, gpt2-moe-125m 0.328 -> 0.390. ds_tune applies the
+        # same registry.mxu_aligned helper, so tuner and bench agree;
+        # BENCH_HEADS=16 etc. benches a canonical layout instead.
+        from deepspeed_tpu.models.registry import mxu_aligned
+        config = mxu_aligned(config)
     # measured per-family sweet spots on one v5e chip (see docstring):
     # decoders want 'attn' remat (save flash outputs, recompute the cheap
     # matmul chain); bert-large fits WITHOUT remat at bs=12 once the layer
@@ -126,16 +136,6 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         default_bs = {"gpt2-1.3b": 12, "gpt2-xl": 12}.get(model_name, 8)
     per_chip_bs = int(os.environ.get("BENCH_BS", default_bs))
     if bert:
-        # TPU-native pretrain shape: head_dim 128 (the MXU lane width; 8
-        # heads for bert-large) instead of the canonical 64 — param- and
-        # flop-identical, measured 0.463 -> 0.553 (seq512) / 0.478 -> 0.611
-        # (seq128) on v5e. The canonical 16-head layout stays in PRESETS for
-        # HF-checkpoint compatibility; BENCH_HEADS=16 benches it. ds_tune
-        # applies the same registry.mxu_aligned helper, so tuner and bench
-        # sweep the same model.
-        if not heads and on_tpu:
-            from deepspeed_tpu.models.registry import mxu_aligned
-            config = mxu_aligned(config)
         # the canonical BERT max_predictions_per_seq (80 at seq=512); the
         # synthetic batch is generated with the same cap so no label is ever
         # dropped by the gather (loss stays exact)

@@ -158,7 +158,10 @@ class Autotuner:
             zc = cfg.setdefault("zero_optimization", {})
             zc["stage"] = stage
             if off:
-                zc["offload_optimizer"] = {"device": "cpu"}
+                # stream_overlap rides the candidate config (not env), so the
+                # winning ds_config the tuner reports reproduces the result
+                zc["offload_optimizer"] = {"device": "cpu",
+                                           "stream_overlap": bool(ov)}
             if tp > 1:
                 cfg.setdefault("tpu", {})["tensor"] = tp
             # NOTE: gas>1 candidates keep the user's grad_accum_dtype — a
@@ -263,12 +266,6 @@ class Autotuner:
         cfg = {k: v for k, v in exp.ds_config.items() if k != "_tune"}
         tune = exp.ds_config.get("_tune", {})
         refs = {}   # explicit slot so `finally` can drop device buffers
-        # streamed-offload scheduling knob: read (env_flag) inside the step
-        # trace, so setting it before the engine compiles is sufficient
-        prev_overlap = os.environ.get("DS_TPU_OFFLOAD_OVERLAP")
-        if tune.get("offload"):
-            os.environ["DS_TPU_OFFLOAD_OVERLAP"] = \
-                "1" if tune.get("offload_overlap") else "0"
         try:
             import inspect
 
@@ -328,11 +325,6 @@ class Autotuner:
                 if hasattr(eng, "invalidate_compiled"):
                     eng.invalidate_compiled()
             refs.clear()
-            if tune.get("offload"):
-                if prev_overlap is None:
-                    os.environ.pop("DS_TPU_OFFLOAD_OVERLAP", None)
-                else:
-                    os.environ["DS_TPU_OFFLOAD_OVERLAP"] = prev_overlap
             try:
                 import jax
 

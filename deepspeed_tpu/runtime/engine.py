@@ -85,6 +85,17 @@ def _supports_lr_override(opt) -> bool:
         return False
 
 
+def _resolve_stream_overlap(off_opt) -> bool:
+    """Double-buffered host streaming for the offloaded optimizer update:
+    the ``stream_overlap`` config field wins when set; the
+    ``DS_TPU_OFFLOAD_OVERLAP`` env knob is the fallback when it is None
+    (or when there is no offload_optimizer block at all)."""
+    from deepspeed_tpu.utils import env_flag
+
+    cfg = off_opt.stream_overlap if off_opt is not None else None
+    return env_flag("DS_TPU_OFFLOAD_OVERLAP") if cfg is None else bool(cfg)
+
+
 class DeepSpeedEngine:
     def __init__(self,
                  args=None,
@@ -705,10 +716,12 @@ class DeepSpeedEngine:
 
         keep = lambda new, old: jnp.where(finite, new, old)
         # ordering: each pull chains on a previous chunk's host write-back.
-        # DS_TPU_OFFLOAD_OVERLAP=1 chains on the write TWO steps back
-        # instead (double-buffering, peak = two working sets) — measured
-        # slightly SLOWER on v5e via the axon tunnel (0.149 vs 0.171 MFU on
-        # gpt2-1.3b), so strict serial is the default.
+        # stream_overlap (config; DS_TPU_OFFLOAD_OVERLAP env fallback) chains
+        # on the write TWO steps back instead (double-buffering, peak = two
+        # working sets). Link-speed dependent: on v5e gpt2-1.3b it measures
+        # 0.368 -> 0.384-0.388 MFU, but it destabilizes gpt2-xl (worker
+        # faults / 3x collapses), so strict serial stays the global default
+        # and the autotuner sweeps the axis per model.
         token = token_prev = jnp.float32(0.0)
         # giant leaves (layer-stacked (L, ...) weights are GBs in fp32 — a
         # gpt2-1.3b fc stack is 1.5G and its streamed update needs ~6 temps
@@ -732,7 +745,8 @@ class DeepSpeedEngine:
             # and the peak bound, as buffers free on write completion.)
             return x.ravel()[0].astype(jnp.float32)
 
-        serial = not env_flag("DS_TPU_OFFLOAD_OVERLAP")
+        serial = not _resolve_stream_overlap(
+            self._config.zero_config.offload_optimizer)
 
         def advance(new_tok):
             nonlocal token, token_prev

@@ -331,3 +331,56 @@ class TestZeroInfinityParams:
             np.testing.assert_array_equal(np.asarray(v), shared_before[n])
         resumed = float(zengine.train_batch(batch))
         np.testing.assert_allclose(resumed, drift, rtol=1e-5)
+
+
+class TestStreamOverlapKnob:
+    """stream_overlap precedence: config field wins; DS_TPU_OFFLOAD_OVERLAP
+    env is the fallback only while the field is None / the block absent."""
+
+    def test_config_wins_over_env(self, monkeypatch):
+        from deepspeed_tpu.runtime.engine import _resolve_stream_overlap
+        from deepspeed_tpu.runtime.zero.config import \
+            DeepSpeedZeroOffloadOptimizerConfig as Off
+
+        monkeypatch.setenv("DS_TPU_OFFLOAD_OVERLAP", "1")
+        assert _resolve_stream_overlap(Off(device="cpu", stream_overlap=False)) is False
+        monkeypatch.setenv("DS_TPU_OFFLOAD_OVERLAP", "0")
+        assert _resolve_stream_overlap(Off(device="cpu", stream_overlap=True)) is True
+
+    def test_env_fallback_when_unset(self, monkeypatch):
+        from deepspeed_tpu.runtime.engine import _resolve_stream_overlap
+        from deepspeed_tpu.runtime.zero.config import \
+            DeepSpeedZeroOffloadOptimizerConfig as Off
+
+        monkeypatch.delenv("DS_TPU_OFFLOAD_OVERLAP", raising=False)
+        assert _resolve_stream_overlap(Off(device="cpu")) is False
+        assert _resolve_stream_overlap(None) is False
+        monkeypatch.setenv("DS_TPU_OFFLOAD_OVERLAP", "1")
+        assert _resolve_stream_overlap(Off(device="cpu")) is True
+        assert _resolve_stream_overlap(None) is True
+
+    def test_ds_config_parses_stream_overlap(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 8,
+            "zero_optimization": {
+                "stage": 1,
+                "offload_optimizer": {"device": "cpu", "stream_overlap": True}}})
+        assert cfg.zero_config.offload_optimizer.stream_overlap is True
+
+    def test_autotuner_candidates_carry_stream_overlap(self):
+        # the winning ds_config the tuner reports must reproduce the result
+        # without env knobs (review finding r4)
+        from deepspeed_tpu.autotuning.autotuner import (Autotuner,
+                                                        AutotuningConfig)
+
+        t = AutotuningConfig(enabled=True, mbs_list=[1], gas_list=[1],
+                             zero_stage_list=[1], remat_list=[False],
+                             offload_list=[True], offload_overlap_list=[True, False])
+        tuner = Autotuner.__new__(Autotuner)
+        tuner.tuning = t
+        tuner.base_config = {"optimizer": {"type": "AdamW", "params": {}}}
+        cands = tuner.candidate_space()
+        offs = [c["zero_optimization"]["offload_optimizer"] for c in cands]
+        assert {o["stream_overlap"] for o in offs} == {True, False}

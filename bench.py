@@ -19,7 +19,9 @@ BENCH_STEPS, BENCH_GAS, BENCH_REMAT (none|full|dots|attn|attn_mlp; default
 attn for decoders, none for bert), BENCH_OFFLOAD (none|cpu), BENCH_UNROLL,
 BENCH_FLASH_BLOCK, BENCH_FLASH (bert einsum switch), BENCH_EXPERTS (moe
 bank size), BENCH_HEADS (head-count override at fixed n_embd; gpt2/bert
-only — params/flops are head-count invariant there). Measured per-family
+only — params/flops are head-count invariant there), BENCH_VOCAB (vocab
+override; 50304 = 128-aligned measured no change vs 50257 — XLA already
+handles the pad). Measured per-family
 sweet spots on one v5e chip:
 - gpt2-760m: 0.533–0.536 MFU (bs=12, remat='attn', flash_block=1024 — the
   full-sequence tile; 512 measured 0.521, 256 regresses to 0.461 — and
@@ -103,7 +105,13 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
             raise ValueError(f"BENCH_HEADS={heads} does not divide "
                              f"n_embd={config.n_embd}")
         config = dataclasses.replace(config, n_head=heads)
-    elif not model_name.startswith("llama") and on_tpu:
+    vocab = int(os.environ.get("BENCH_VOCAB", 0))
+    if vocab:
+        # e.g. 50304 = 50257 rounded up to the 128-lane boundary (nanoGPT's
+        # trick): the pad keeps the logits matmul tile-aligned without an
+        # XLA pad-copy of the embedding table each step
+        config = dataclasses.replace(config, vocab_size=vocab)
+    if not heads and not model_name.startswith("llama") and on_tpu:
         # TPU-native pretrain head layout: head_dim 128 at fixed n_embd
         # (param/flop invariant; no-op when n_embd%128 or already aligned —
         # 760m/1.3b presets are, xl's 1600 can't be). Measured: bert-large
@@ -120,6 +128,14 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     big = model_name in ("gpt2-1.3b", "gpt2-xl", "gpt2-2.7b", "gpt2-6.7b")
     remat = os.environ.get("BENCH_REMAT", "none" if bert else "attn")
     config = dataclasses.replace(config, remat=remat if remat != "none" else False)
+    small_lm = (model_name.startswith(("gpt2", "bert")) and not big)
+    if small_lm and on_tpu:
+        # MEASURED small presets fit HBM with slack: skip the loss-chunk
+        # remat and keep the saved fp32 logits (0.525 -> 0.535 on the 760m
+        # headline). The offload-backed big models and the llama family
+        # (llama3's V=128k logit residuals are GBs/chip) keep the default
+        # True — their peak is the binding constraint.
+        config = dataclasses.replace(config, remat_loss_chunks=False)
     seq = int(os.environ.get("BENCH_SEQ", min(1024, config.n_positions)))
     default_bs = 12 if on_tpu else 2
     if bert and on_tpu:
@@ -129,12 +145,13 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     if big and on_tpu:
         # offload-backed: bigger microbatches amortize the streamed update
         # over more tokens. Measured peaks: 1.3b bs=16 (0.392-0.394 MFU),
-        # xl bs=14 (0.252-0.255) — but BOTH intermittently crash the TPU
-        # worker near those sizes (bs+2 faults outright), so the DEFAULTS
-        # derate one notch to the never-faulted points: 1.3b bs=12 (0.368),
-        # xl bs=12 (0.243). A lost ladder line costs more than 0.01-0.03
-        # MFU; BENCH_BS overrides for peak runs. 2.7b/6.7b unmeasured:
-        # conservative bs=8.
+        # xl bs=14 (0.252-0.255; with the loss-chunk remat freeing ~2.9G it
+        # now completes 2 of 3 runs instead of faulting outright) — but both
+        # still intermittently crash the TPU worker, so the DEFAULTS derate
+        # one notch to the never-faulted points: 1.3b bs=12 (0.384-0.391
+        # w/ stream_overlap), xl bs=12 (0.242-0.243). A lost ladder line
+        # costs more than 0.01-0.03 MFU; BENCH_BS overrides for peak runs.
+        # 2.7b/6.7b unmeasured: conservative bs=8.
         default_bs = {"gpt2-1.3b": 12, "gpt2-xl": 12}.get(model_name, 8)
     per_chip_bs = int(os.environ.get("BENCH_BS", default_bs))
     if bert:

@@ -64,6 +64,8 @@ class BertConfig:
     # decoders; 'attn' saves only the flash-attention outputs so the backward
     # never re-runs the kernel (the policy behind gpt2's headline MFU)
     remat: Any = False
+    # remat the chunked-CE loss scan (see gpt2.GPT2Config.remat_loss_chunks)
+    remat_loss_chunks: bool = True
     use_flash_attention: bool = True
     # flash kernel tile edge (block_q == block_k); None = kernel default.
     # The bidirectional grid has no triangular skip, so the full-sequence
@@ -321,7 +323,8 @@ class BertModel:
         h = self._mlm_transform(params, x)
         safe = jnp.where(mask, labels, 0)
         return chunked_lm_loss(h, params["wte"].T.astype(h.dtype), safe,
-                               loss_mask=mask, bias=params["decoder_b"])
+                               loss_mask=mask, bias=params["decoder_b"],
+                               remat=self.config.remat_loss_chunks)
 
 
 def synthetic_mlm_batch(batch_size: int, seq_len: int, vocab_size: int,

@@ -47,6 +47,11 @@ class GPT2Config:
     # or 'dots' (save matmul outputs, recompute elementwise — usually the
     # right trade on TPU where HBM, not FLOPs, is the binding constraint)
     remat: Any = True
+    # remat the chunked-CE loss scan (models/common.py chunked_lm_loss):
+    # True keeps peak HBM bounded (no saved per-chunk fp32 logits, ~2.4G at
+    # B=12/T=1024/V=50k); False buys ~1% step time back when the model fits
+    # with slack (the bench sets it for the small-model presets)
+    remat_loss_chunks: bool = True
     use_flash_attention: bool = True
     # flash kernel tile edge (block_q == block_k); None = kernel default
     # (512). An autotuner axis: smaller tiles fit tighter VMEM at long
@@ -440,7 +445,8 @@ class GPT2Model:
         head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
         return chunked_lm_loss(x, head, labels[:, 1:],
                                mask[:, 1:] if mask is not None else None,
-                               bias=params.get("lm_head_b"))
+                               bias=params.get("lm_head_b"),
+                               remat=c.remat_loss_chunks)
 
 
     # ------------------------------------------------------------- inference

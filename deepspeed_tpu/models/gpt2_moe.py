@@ -129,7 +129,8 @@ class MoEGPT2(GPT2Model):
                 else params["lm_head"]).astype(x.dtype)
         ce = chunked_lm_loss(x, head, labels[:, 1:],
                              mask[:, 1:] if mask is not None else None,
-                             bias=params.get("lm_head_b"))
+                             bias=params.get("lm_head_b"),
+                             remat=self.config.remat_loss_chunks)
         return ce + self.aux_loss_coef * aux
 
     def _attn_sublayer(self, x, blk, rope=None):

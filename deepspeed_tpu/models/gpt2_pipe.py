@@ -93,7 +93,8 @@ class PipelinedDecoderMixin:
         return chunked_lm_loss(x, self._head_shared(shared, x.dtype),
                                labels[:, 1:],
                                mask[:, 1:] if mask is not None else None,
-                               bias=shared.get("lm_head_b"))
+                               bias=shared.get("lm_head_b"),
+                               remat=self.config.remat_loss_chunks)
 
     def loss(self, params, batch, rng=None):
         if self._pipe_loss is None:

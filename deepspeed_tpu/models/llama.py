@@ -52,6 +52,8 @@ class LlamaConfig:
     # (HF config.rope_scaling semantics — llama3 is the 3.1+ long-context NTK)
     rope_scaling: Optional[dict] = None
     rms_norm_eps: float = 1e-5
+    # remat the chunked-CE loss scan (see gpt2.GPT2Config.remat_loss_chunks)
+    remat_loss_chunks: bool = True
     tie_embeddings: bool = False     # llama3.2-1B/3B style tied lm_head
     dtype: Any = jnp.bfloat16
     remat: Any = True                # False | True/'full' | 'dots' | 'attn'
@@ -283,7 +285,8 @@ class LlamaModel:
         x = self._trunk(params, ids, rng)[:, :-1]
         head = self._head(params, x.dtype)
         return chunked_lm_loss(x, head, labels[:, 1:],
-                               mask[:, 1:] if mask is not None else None)
+                               mask[:, 1:] if mask is not None else None,
+                               remat=self.config.remat_loss_chunks)
 
     # ------------------------------------------------------------- inference
     def init_cache(self, batch_size: int, max_len: int):

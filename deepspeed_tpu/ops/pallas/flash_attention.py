@@ -178,12 +178,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
         lse_ref[0] = (m_sc[:, :1] + jnp.log(l_safe)).astype(jnp.float32)
 
 
+def _tri_min_blocks() -> int:
+    """Min row blocks before the triangular grid pays for its bookkeeping
+    (default 4 = 37.5%+ of blocks skipped; DS_TPU_FLASH_TRI_MIN=2 enables
+    it at nq=2 for experiments — measured slower on v5e at GPT-2 shapes)."""
+    import os
+
+    return int(os.environ.get("DS_TPU_FLASH_TRI_MIN", "4"))
+
+
 def _use_tri(causal, t_q, t_k, bq, bk) -> bool:
     """The triangular grid skips (nq-1)/2nq of the blocks — worth its
-    bookkeeping only with ≥4 row blocks (37.5%+ skipped). At nq≤3 a
+    bookkeeping only with ≥_tri_min_blocks() row blocks. Below that a
     rectangular grid with a double-width k block measures faster (fewer,
     larger cells)."""
-    return causal and t_q == t_k and bq == bk and t_q // bq >= 4
+    return causal and t_q == t_k and bq == bk and t_q // bq >= _tri_min_blocks()
 
 
 def _flash_forward(q, k, v, scale, causal, block_q, block_k):
@@ -191,7 +200,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k):
     t_k = k.shape[1]
     bq = _pick_block(t_q, block_q)
     bk = _pick_block(t_k, block_k)
-    if causal and t_q == t_k and bq == bk and t_q // bq < 4:
+    if causal and t_q == t_k and bq == bk and t_q // bq < _tri_min_blocks():
         bk = _pick_block(t_k, 2 * bq)       # short-seq rect: wider k blocks
     nq, nk = t_q // bq, t_k // bk
 
@@ -402,7 +411,7 @@ def _flash_backward(res, g, scale, causal, block_q, block_k):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)  # (bh, t_q, 1)
 
-    if causal and t_q == t_k and bq == bk and t_q // bq < 4:
+    if causal and t_q == t_k and bq == bk and t_q // bq < _tri_min_blocks():
         bk = _pick_block(t_k, 2 * bq)       # mirror the forward's block choice
         nk = t_k // bk
     tri = _use_tri(causal, t_q, t_k, bq, bk)

@@ -113,6 +113,19 @@ PRESETS = {
                               n_layer=2, n_head=4, n_kv_head=2,
                               intermediate_size=128),
     "llama-7b": LlamaConfig(),
+    # llama-3.2-1B (HF meta-llama/Llama-3.2-1B, incl. its llama3-NTK rope
+    # scaling and 128k context): the one llama preset that pretrains on a
+    # single 16G chip (bf16 params 2.5G + offloaded fp32 Adam state; the
+    # V=128k logit residuals stay bounded by the remat_loss_chunks default)
+    "llama3.2-1b": LlamaConfig(vocab_size=128256, n_positions=131072,
+                               n_embd=2048, n_layer=16, n_head=32,
+                               n_kv_head=8, intermediate_size=8192,
+                               rope_theta=500000.0, tie_embeddings=True,
+                               rope_scaling={"rope_type": "llama3",
+                                             "factor": 32.0,
+                                             "low_freq_factor": 1.0,
+                                             "high_freq_factor": 4.0,
+                                             "original_max_position_embeddings": 8192}),
     "llama-13b": LlamaConfig(n_embd=5120, n_layer=40, n_head=40,
                              intermediate_size=13824),
     "llama2-7b": LlamaConfig(n_positions=4096),

@@ -28,12 +28,15 @@ sweet spots on one v5e chip:
   n_head=12, i.e. head_dim=128 = the MXU lane width; the GPT-2-paper-ish
   16 heads pad every attention MXU pass 96->128 and measured 0.512).
   Negative results from the r4 sweeps, so they are not re-probed: bs=14
-  0.520, bs=16 OOM by 374M, gas=2 0.453 (accumulation-scan overhead),
-  scan unroll=2 0.523 / 4 0.448, remat='attn_mlp' (save gelu outs too)
-  OOM at bs=12 and 0.442 at bs=8 — the raw-util loss below bs=12
-  outweighs the saved MLP recompute; remat='dots'+offload crashes the
-  XLA compile helper; remat='attn'+offload gas=8 0.427 (host round-trip
-  tax beats the recompute saving at this size).
+  0.520, bs=16 0.512 (fits only with remat_loss_chunks), gas=2 0.488 /
+  gas=4 0.496 (~8%/micro accumulation-scan tax; unrolling the gas scan
+  OOMs — XLA interleaves the unrolled micros), layer-scan unroll=2
+  0.523 / 4 0.448, remat='attn_mlp' (save gelu outs too) OOM at bs=12
+  and 0.442 at bs=8 — the raw-util loss below bs=12 outweighs the saved
+  MLP recompute; remat='dots'+offload crashes the XLA compile helper;
+  remat='attn'+offload gas=8 0.427 (host round-trip tax beats the
+  recompute saving at this size); forced triangular flash at nq=2
+  (DS_TPU_FLASH_TRI_MIN=2, fb=512) 0.510; BENCH_VOCAB=50304 no change.
 - gpt2-1.3b / gpt2-xl (ZeRO-Offload ladder): 0.386 / 0.243 MFU at
   gas=32/16 — the host round-trip amortized over a GPT-2-paper-sized
   token batch. 1.3b defaults to stream_overlap (double-buffered host

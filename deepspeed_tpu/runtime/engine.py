@@ -887,6 +887,10 @@ class DeepSpeedEngine:
         zero_acc = jax.tree.map(lambda s: jnp.zeros(s.shape, acc_dtype),
                                 jax.eval_shape(lambda: params_c))
         zero_acc = jax.lax.with_sharding_constraint(zero_acc, plan.grad_specs)
+        # NOT unrolled: measured on v5e gpt2-760m/gas=4, unroll=2 OOMs by
+        # 1.9G and unroll=4 by 4.7G — XLA interleaves the unrolled micros,
+        # so each extra body keeps a full live activation set (~1.8G). The
+        # scan's sequencing is what bounds gas>1 memory to one micro.
         (acc, _), losses = jax.lax.scan(body, (zero_acc, jnp.int32(0)), mbs)
         return jnp.mean(losses), jax.tree.map(
             lambda g: (g.astype(jnp.float32) / gas).astype(g.dtype), acc)

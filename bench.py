@@ -10,7 +10,9 @@ docs/_posts/2020-05-19-bert-record.md:13). vs_baseline = MFU / 0.50.
 
 Default on TPU: the BASELINE ladder — the gpt2-760m headline, gpt2-xl
 (1.5B north star, host-offload-backed on one 16G chip), gpt2-1.3b
-(offload), gpt2-moe-125m (Switch-8-expert milestone), headline repeated.
+(offload), gpt2-moe-125m (Switch-8-expert milestone), bert-large (the
+reference's record family), llama3.2-1b (GQA, 128k vocab, offload), a
+v5e-64 north-star projection, headline repeated.
 Set BENCH_MODEL to bench exactly one preset (gpt2-*/gpt2-moe-*/llama-*/
 bert-*), BENCH_SUITE=0 to skip the extra presets.
 
@@ -54,6 +56,14 @@ sweet spots on one v5e chip:
 - llama3.2-1b (GQA 32h/8kv, V=128k, tied): 0.341 MFU at bs=12/gas=32,
   offload-backed (bs=8 0.314, bs=16 faults the worker; stream_overlap
   measured +0.004 — within noise, left off).
+- serving (BENCH_SERVE=1, gpt2-760m bf16 greedy, prompt 128 gen 128,
+  prefill measured separately and subtracted): pure decode 6.8k tok/s at
+  B=32 (MBU 0.70), 13.7k at B=128 (MBU 0.83) after moving the stacked KV
+  cache into the decode scan's carry (the xs/ys layout copied the whole
+  cache every token: 2.2k tok/s). int8 weights measured no change
+  (decode is cache+weight-stream bound, not weight-only);
+  use_flash_decode at this 256-token cache measured slower —
+  the streaming kernel wins only on long preallocated caches.
 """
 
 import json
@@ -277,6 +287,76 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     }
 
 
+def serving_line(on_tpu: bool, n_dev: int) -> dict:
+    """Measured serving decode throughput (BENCH_SERVE=1): init_inference
+    on the headline model, batched greedy generate, report decode tok/s and
+    MBU (model-bandwidth utilization — batched decode is HBM-bound: every
+    generated token streams the weights once plus the live KV cache, so
+    MBU = that traffic over peak bandwidth; the serving analogue of MFU).
+    Prefill is measured separately (a max_new_tokens=1 call) and subtracted,
+    so the line reports pure decode."""
+    import dataclasses
+
+    import deepspeed_tpu
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.models.registry import mxu_aligned, resolve_family
+
+    name = os.environ.get("BENCH_MODEL", "gpt2-760m")
+    model_cls, _, PRESETS = resolve_family(name)
+    config = PRESETS[name]
+    if not name.startswith("llama") and on_tpu:
+        config = mxu_aligned(config)
+    B = int(os.environ.get("BENCH_BS", 32))
+    prompt = int(os.environ.get("BENCH_SEQ", 128))
+    gen = int(os.environ.get("BENCH_GEN", 128))
+    if gen < 2:
+        raise ValueError("BENCH_GEN must be >= 2 (prefill is solved out of "
+                         "the two-point measurement)")
+    if os.environ.get("BENCH_FLASH_DECODE", "0") == "1":
+        config = dataclasses.replace(config, use_flash_decode=True)
+
+    model = model_cls(config)
+    params = model.init_params(jax.random.PRNGKey(0))
+    serve_dtype = os.environ.get("BENCH_SERVE_DTYPE", "bfloat16")
+    engine = deepspeed_tpu.init_inference(
+        model, config={"dtype": serve_dtype,
+                       "max_out_tokens": prompt + gen}, params=params)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, config.vocab_size, (B, prompt), dtype=np.int32)
+    reps = int(os.environ.get("BENCH_STEPS", 3 if on_tpu else 1))
+
+    def timed(new_tokens):
+        np.asarray(engine.generate(ids, max_new_tokens=new_tokens))  # compile
+        t0 = time.time()
+        for _ in range(reps):
+            out = engine.generate(ids, max_new_tokens=new_tokens)
+        np.asarray(out)  # host read = completion barrier
+        return (time.time() - t0) / reps
+
+    t_pre1 = timed(1)            # prefill + one decode step
+    t_full = timed(gen)          # prefill + gen decode steps
+    t_step = max(t_full - t_pre1, 1e-9) / (gen - 1)
+    tok_s = B / t_step / n_dev
+    # per-chip traffic per decode step: weights once (at the served width)
+    # plus the live KV cache (k+v, all layers, padded length, bf16)
+    dtype_bytes = {"float32": 4, "fp32": 4, "bfloat16": 2, "bf16": 2,
+                   "float16": 2, "fp16": 2, "int8": 1}.get(serve_dtype, 2)
+    param_bytes = config.num_params() * dtype_bytes
+    kv_heads = getattr(config, "n_kv_head", None) or config.n_head
+    kv_bytes = 2 * config.n_layer * B * (prompt + gen) * kv_heads * \
+        config.head_dim * 2
+    bw = get_accelerator().memory_bandwidth()
+    mbu = (param_bytes + kv_bytes) / n_dev / (bw * t_step)
+    return {
+        "metric": f"{name} serving decode (B={B}, prompt={prompt}, gen={gen}, "
+                  f"{n_dev} chip(s), {serve_dtype}, tok/s/chip={tok_s:.0f}, "
+                  f"prefill={t_pre1*1e3:.0f}ms, decode MBU={mbu:.3f})",
+        "value": round(tok_s, 1),
+        "unit": "decode-tok/s/chip",
+        "vs_baseline": round(mbu, 4),
+    }
+
+
 def northstar_evidence(on_tpu: bool, n_dev: int) -> dict:
     """Measured xl compute/update breakdown + v5e-64 ZeRO-3 projection
     (profiling/scaling.py): two short gas points solve t_micro/t_update;
@@ -421,6 +501,9 @@ def main():
     if os.environ.get("BENCH_NORTHSTAR") == "1":
         print(json.dumps(northstar_evidence(on_tpu, n_dev)), flush=True)
         return
+    if os.environ.get("BENCH_SERVE") == "1":
+        print(json.dumps(serving_line(on_tpu, n_dev)), flush=True)
+        return
 
     def bench_line(name):
         """run_one guarded: failures become a FAILED line, flagged."""
@@ -434,10 +517,12 @@ def main():
         model_name = "gpt2-760m" if on_tpu else "gpt2-tiny"
         # BASELINE ladder: headline FIRST (so a driver timeout mid-ladder
         # still leaves its line as the most recent JSON), then the 1.5B
-        # north star + 1.3B (offload-backed) + MoE, each in an isolated
-        # subprocess, then the SAME headline line REPEATED last for the
-        # tail-line parse.
-        suite = ("gpt2-xl", "gpt2-1.3b", "gpt2-moe-125m") if (
+        # north star + 1.3B (offload-backed) + MoE + BERT (the reference's
+        # own record family) + llama3.2-1b (GQA/128k-vocab), each in an
+        # isolated subprocess, then the SAME headline line REPEATED last
+        # for the tail-line parse.
+        suite = ("gpt2-xl", "gpt2-1.3b", "gpt2-moe-125m", "bert-large",
+                 "llama3.2-1b") if (
             on_tpu and os.environ.get("BENCH_SUITE", "1") != "0") else ()
         headline, ok = bench_line(model_name)
         print(json.dumps(headline), flush=True)

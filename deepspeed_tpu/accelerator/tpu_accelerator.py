@@ -35,6 +35,22 @@ _PEAK_FLOPS = {
     "cpu": 1e12,  # nominal, keeps MFU math finite in CPU tests
 }
 
+# HBM bandwidth per chip, bytes/s (published TPU specs) — the denominator
+# for bandwidth-bound metrics (batched decode MBU in bench.py's serving
+# line, the autotuner's HBM cost model)
+_PEAK_HBM_BW = {
+    "v2": 700e9,
+    "v3": 900e9,
+    "v4": 1228e9,
+    "v5lite": 819e9,
+    "v5e": 819e9,
+    "v5": 2765e9,
+    "v5p": 2765e9,
+    "v6e": 1640e9,
+    "v6": 1640e9,
+    "cpu": 100e9,  # nominal, keeps MBU math finite in CPU tests
+}
+
 
 def _detect_generation(device) -> str:
     kind = getattr(device, "device_kind", "") or ""
@@ -165,6 +181,11 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         if dtype in (jnp.float32, np.float32, "float32", "fp32"):
             peak = peak / 2.0
         return peak
+
+    def memory_bandwidth(self) -> float:
+        """Peak HBM bandwidth per chip, bytes/s."""
+        gen = _detect_generation(jax.local_devices()[0])
+        return _PEAK_HBM_BW.get(gen, 819e9)
 
     # ------------------------------------------------------------- op builder
     def create_op_builder(self, op_name: str):

@@ -195,36 +195,44 @@ class MoEGPT2(GPT2Model):
         x = self._decode_embed(params, token, pos)
         rope = self._rope_tables(pos[None])
         n_pairs, paired = self._paired_blocks(params)
-        to_pairs = lambda t: t.reshape((n_pairs, self.moe_every) + t.shape[1:])
 
-        def attend(x, blk, k_cache, v_cache):
+        # stacked (L, ...) cache rides the scan CARRY with per-layer in-place
+        # DUS at 2p / 2p+1 (see gpt2.decode_step: the xs/ys layout copied
+        # the whole cache every decode step)
+        def attend(x, blk, cache_k, cache_v, l):
             q, k, v = self._block_kv(x, blk, rope)          # (B, 1, H, Dh)
-            k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-            attn = cached_decode_attention(q[:, 0], k_cache, v_cache, pos,
+            cache_k = jax.lax.dynamic_update_slice(
+                cache_k, k[None].astype(cache_k.dtype), (l, 0, pos, 0, 0))
+            cache_v = jax.lax.dynamic_update_slice(
+                cache_v, v[None].astype(cache_v.dtype), (l, 0, pos, 0, 0))
+            k_l = jax.lax.dynamic_index_in_dim(cache_k, l, 0, keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(cache_v, l, 0, keepdims=False)
+            attn = cached_decode_attention(q[:, 0], k_l, v_l, pos,
                                            c.use_flash_decode,
                                            alibi=self._alibi())[:, None]
-            return attn, k_cache, v_cache
+            return attn, cache_k, cache_v
 
-        def body(x, xs):
-            pair_blocks, moe_p, k_pair, v_pair = xs
+        def body(carry, xs):
+            x, cache_k, cache_v = carry
+            pair_blocks, moe_p, p = xs
             b0 = jax.tree.map(lambda t: t[0], pair_blocks)
-            attn0, k0, v0 = attend(x, b0, k_pair[0], v_pair[0])
+            attn0, cache_k, cache_v = attend(x, b0, cache_k, cache_v,
+                                             self.moe_every * p)
             x = self._block_finish(x, b0, attn0)
             b1 = jax.tree.map(lambda t: t[1], pair_blocks)
-            attn1, k1, v1 = attend(x, b1, k_pair[1], v_pair[1])
+            attn1, cache_k, cache_v = attend(x, b1, cache_k, cache_v,
+                                             self.moe_every * p + 1)
             B = x.shape[0]
             a = attn1.reshape(B, 1, -1)
             x = x + a @ b1["proj_w"].astype(x.dtype) + b1["proj_b"].astype(x.dtype)
             h = self._layer_norm(x, b1["ln2_g"], b1["ln2_b"])
             moe_out, _ = self.moe(moe_p, h, None, train=False)
             x = x + moe_out
-            return x, (jnp.stack([k0, k1]), jnp.stack([v0, v1]))
+            return (x, cache_k, cache_v), None
 
-        x, (ks, vs) = jax.lax.scan(
-            body, x, (paired, params["moe"],
-                      to_pairs(cache["k"]), to_pairs(cache["v"])))
+        (x, ks, vs), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (paired, params["moe"], jnp.arange(n_pairs)))
         x = self._layer_norm(x, params["lnf_g"], params["lnf_b"])
         logits = self._lm_logits(params, x[:, 0])
-        to_layers = lambda t: t.reshape((c.n_layer,) + t.shape[2:])
-        return logits, {"k": to_layers(ks), "v": to_layers(vs), "pos": pos + 1}
+        return logits, {"k": ks, "v": vs, "pos": pos + 1}

@@ -353,20 +353,29 @@ class LlamaModel:
 
         from deepspeed_tpu.models.common import cached_decode_attention
 
+        # stacked cache rides the scan CARRY (in-place per-layer DUS); the
+        # xs/ys layout made lax.scan assemble a fresh stacked cache buffer
+        # every decode step — see gpt2.decode_step for the measured cost
         def body(carry, xs):
-            x = carry
-            blk, k_cache, v_cache = xs
+            x, cache_k, cache_v = carry
+            blk, l = xs
             q, k, v = self._block_qkv(x, blk, cos, sin)     # q (B,1,H,Dh)
-            k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+            cache_k = jax.lax.dynamic_update_slice(
+                cache_k, k[None].astype(cache_k.dtype), (l, 0, pos, 0, 0))
+            cache_v = jax.lax.dynamic_update_slice(
+                cache_v, v[None].astype(cache_v.dtype), (l, 0, pos, 0, 0))
+            k_l = jax.lax.dynamic_index_in_dim(cache_k, l, 0, keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(cache_v, l, 0, keepdims=False)
             # GQA decode against the KV-head cache — repeated K/V are never
             # materialized (grouped einsum or the Pallas streaming kernel)
-            attn = cached_decode_attention(q[:, 0], k_cache, v_cache, pos,
+            attn = cached_decode_attention(q[:, 0], k_l, v_l, pos,
                                            c.use_flash_decode)[:, None]
             x = self._block_finish(x, blk, attn)
-            return x, (k_cache, v_cache)
+            return (x, cache_k, cache_v), None
 
-        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+        (x, ks, vs), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], jnp.arange(c.n_layer)))
         x = self._rms_norm(x, params["norm_g"])
         logits = (x[:, 0] @ self._head(params, x.dtype)).astype(jnp.float32)
         return logits, {"k": ks, "v": vs, "pos": pos + 1}

@@ -184,3 +184,36 @@ def test_autotp_classifies_raw_bert_tree():
     mlp_out = next(v for k, v in flat.items()
                    if "output/dense" in k and "attention" not in k)
     assert tuple(mlp_out) == ("tensor", None)
+
+
+def test_mxu_aligned_is_param_and_flop_invariant():
+    """registry.mxu_aligned must only relayout heads: same n_embd, same
+    num_params, same flops_per_token — and no-op when n_embd % 128 != 0
+    (gpt2-xl's 1600) or the layout is already aligned."""
+    from deepspeed_tpu.models.bert import PRESETS as BERT_PRESETS
+    from deepspeed_tpu.models.gpt2 import PRESETS as GPT2_PRESETS
+    from deepspeed_tpu.models.registry import mxu_aligned
+
+    bl = BERT_PRESETS["bert-large"]
+    al = mxu_aligned(bl)
+    assert al.n_head == bl.n_embd // 128 and al.n_embd == bl.n_embd
+    assert al.num_params() == bl.num_params()
+    assert al.flops_per_token(512) == bl.flops_per_token(512)
+
+    xl = GPT2_PRESETS["gpt2-xl"]          # 1600 % 128 != 0: untouched
+    assert mxu_aligned(xl) is xl
+    m760 = GPT2_PRESETS["gpt2-760m"]      # already 12 x 128: untouched
+    assert mxu_aligned(m760) is m760
+
+
+def test_llama32_1b_preset_matches_hf_shape():
+    """llama3.2-1b: ~1.24B params, GQA 32h/8kv, llama3 NTK rope scaling —
+    the shape of HF meta-llama/Llama-3.2-1B."""
+    from deepspeed_tpu.models.llama import PRESETS
+
+    c = PRESETS["llama3.2-1b"]
+    n = c.num_params()
+    assert abs(n - 1.236e9) / 1.236e9 < 0.02, n
+    assert c.n_head == 32 and c.n_kv_head == 8 and c.tie_embeddings
+    assert c.rope_scaling["rope_type"] == "llama3"
+    assert c.rope_scaling["factor"] == 32.0

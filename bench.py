@@ -62,8 +62,12 @@ sweet spots on one v5e chip:
   cache into the decode scan's carry (the xs/ys layout copied the whole
   cache every token: 2.2k tok/s). int8 weights measured no change
   (decode is cache+weight-stream bound, not weight-only);
-  use_flash_decode at this 256-token cache measured slower —
-  the streaming kernel wins only on long preallocated caches.
+  use_flash_decode measured slower at 256-token AND ~4k tight caches
+  (llama 4096+64: 409 vs 720 tok/s) — generate() tight-allocates the
+  cache per (prompt, gen) shape, so the kernel's length-clamped-DMA win
+  case (long preallocated, mostly-empty cache) never arises there; it
+  stays opt-in for external cache-reusing callers. llama3.2-1b GQA
+  decode: 6.3k tok/s at B=32/128/128 (MBU 0.66).
 """
 
 import json

@@ -61,7 +61,12 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
             q = q_ref[0, g]                 # (Rp, Dh), scale pre-folded
             k = k_ref[0, :, g]              # (block_k, Dh)
             v = v_ref[0, :, g]
-            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+            # f32 operands: the mixed bf16->f32 dot trips a Mosaic
+            # vector.broadcast verification error at Dh=64 (GQA llama
+            # shapes); decode is bandwidth-bound so in-VMEM f32 is free
+            s = jax.lax.dot_general(q.astype(jnp.float32),
+                                    k.astype(jnp.float32),
+                                    (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             if mask_cols:
                 if cols is None:
@@ -76,7 +81,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
                 l_sc[g, :, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
                 l_sc.shape[1:])
             acc_sc[g] = acc_sc[g] * corr + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             m_sc[g] = jnp.broadcast_to(m_new, m_sc.shape[1:])
 

@@ -12,7 +12,8 @@ Default on TPU: the BASELINE ladder — the gpt2-760m headline, gpt2-xl
 (1.5B north star, host-offload-backed on one 16G chip), gpt2-1.3b
 (offload), gpt2-moe-125m (Switch-8-expert milestone), bert-large (the
 reference's record family), llama3.2-1b (GQA, 128k vocab, offload), a
-v5e-64 north-star projection, headline repeated.
+serving-decode line (BENCH_SERVE_LINE=0 skips), a v5e-64 north-star
+projection, headline repeated.
 Set BENCH_MODEL to bench exactly one preset (gpt2-*/gpt2-moe-*/llama-*/
 bert-*), BENCH_SUITE=0 to skip the extra presets.
 
@@ -533,6 +534,13 @@ def main():
         for extra in suite:
             print(json.dumps(_subproc_line({"BENCH_MODEL": extra}, extra)),
                   flush=True)
+        if suite and os.environ.get("BENCH_SERVE_LINE", "1") != "0":
+            # serving evidence: batched decode tok/s + MBU on the headline
+            # model (prefill solved out) — the inference-engine counterpart
+            # of the training MFU lines
+            print(json.dumps(_subproc_line(
+                {"BENCH_SERVE": "1"}, "serving decode",
+                unit="decode-tok/s/chip")), flush=True)
         if suite and os.environ.get("BENCH_SCALING", "1") != "0":
             # scaling evidence for the v5e-64 north star (VERDICT r3 #10):
             # measured single-chip breakdown + first-order ICI projection

@@ -24,7 +24,9 @@ BENCH_FLASH_BLOCK, BENCH_FLASH (bert einsum switch), BENCH_EXPERTS (moe
 bank size), BENCH_HEADS (head-count override at fixed n_embd; gpt2/bert
 only — params/flops are head-count invariant there), BENCH_VOCAB (vocab
 override; 50304 = 128-aligned measured no change vs 50257 — XLA already
-handles the pad). Measured per-family
+handles the pad), BENCH_NORTHSTAR_BS (grad-only batch for the 64-chip
+compute-regime measurement in the projection line; default 14).
+Measured per-family
 sweet spots on one v5e chip:
 - gpt2-760m: 0.533–0.536 MFU (bs=12, remat='attn', flash_block=1024 — the
   full-sequence tile; 512 measured 0.521, 256 regresses to 0.461 — and
@@ -415,17 +417,55 @@ def northstar_evidence(on_tpu: bool, n_dev: int) -> dict:
         raise RuntimeError(
             f"unstable breakdown after retry: times={times}, "
             f"t_micro={t_micro:.4f}s (measurement disturbed)")
+
+    # The offload-regime t_micro above is the 1-chip documentary number, but
+    # it under-represents the 64-chip compute regime: there the fp32 state is
+    # dp-sharded into HBM (no streaming working set), so the per-chip micro
+    # can run the unconstrained batch with the loss-chunk residuals kept.
+    # Measure that directly — a grad-only step (params + grads + activations
+    # only) at the offload-free sweet spot — and feed IT to the projection.
+    import jax.numpy as jnp
+
+    bs64 = int(os.environ.get("BENCH_NORTHSTAR_BS", 14))
+    cfg64 = dataclasses.replace(config, remat="attn", flash_block=None,
+                                remat_loss_chunks=False)
+    model64 = GPT2Model(cfg64)
+    params64 = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                            model64.init_params(jax.random.PRNGKey(0)))
+    ids64 = jnp.asarray(synthetic_lm_batch(
+        bs64, seq, cfg64.vocab_size, seed=0)["input_ids"])
+    grad_fn = jax.jit(jax.grad(lambda p, i: model64.loss(p, {"input_ids": i})))
+    drain = lambda r: float(jnp.asarray(jax.tree.leaves(r)[0]).ravel()[0])
+    drain(grad_fn(params64, ids64))          # compile
+    # host contention only ever INFLATES wall time, so take the best of two
+    # timed windows (the same disturbance the offload solve retries on)
+    t_micro64 = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        for _ in range(3):
+            g = grad_fn(params64, ids64)
+        drain(g)
+        t_micro64 = min(t_micro64, (time.time() - t0) / 3)
+    compute_mfu64 = (bs64 * seq / t_micro64) * fpt / peak
+    if not (0.0 < compute_mfu64 < 1.0):
+        raise RuntimeError(f"implausible grad-only MFU {compute_mfu64:.3f} "
+                           f"(t_micro64={t_micro64:.3f}s, disturbed?)")
+    del params64, g
+    jax.clear_caches()
+
     proj = project_northstar(
         n_params=config.num_params(),
-        tokens_per_chip_step=bs * seq * 16,
+        tokens_per_chip_step=bs64 * seq * 16,
         flops_per_token=fpt,
-        measured_mfu_1chip=min(compute_mfu, 0.6),
+        measured_mfu_1chip=min(compute_mfu64, 0.6),
         peak_flops=peak)
     return {
         "metric": "gpt2-xl v5e-64 ZeRO-3 north-star projection "
-                  f"(measured 1-chip: t_micro={t_micro*1e3:.0f}ms, "
-                  f"t_update={t_update*1e3:.0f}ms/step, "
-                  f"compute-only MFU={compute_mfu:.3f}; "
+                  f"(measured 1-chip offload regime: t_micro={t_micro*1e3:.0f}ms "
+                  f"@bs={bs}, t_update={t_update*1e3:.0f}ms/step, "
+                  f"compute-only MFU={compute_mfu:.3f}; 64-chip compute regime "
+                  f"grad-only @bs={bs64}: t_micro={t_micro64*1e3:.0f}ms, "
+                  f"MFU={compute_mfu64:.3f}; "
                   f"projected MFU@64 no/mid/full overlap="
                   f"{proj['projected_mfu_no_overlap']}/"
                   f"{proj['projected_mfu_mid_overlap']}/"

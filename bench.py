@@ -11,9 +11,10 @@ docs/_posts/2020-05-19-bert-record.md:13). vs_baseline = MFU / 0.50.
 Default on TPU: the BASELINE ladder — the gpt2-760m headline, gpt2-xl
 (1.5B north star, host-offload-backed on one 16G chip), gpt2-1.3b
 (offload), gpt2-moe-125m (Switch-8-expert milestone), bert-large (the
-reference's record family), llama3.2-1b (GQA, 128k vocab, offload), a
-serving-decode line (BENCH_SERVE_LINE=0 skips), a v5e-64 north-star
-projection, headline repeated.
+reference's record family, at seq512 AND its published seq128 record
+config), llama3.2-1b (GQA, 128k vocab, offload), a serving-decode line
+(BENCH_SERVE_LINE=0 skips), a v5e-64 north-star projection, headline
+repeated.
 Set BENCH_MODEL to bench exactly one preset (gpt2-*/gpt2-moe-*/llama-*/
 bert-*), BENCH_SUITE=0 to skip the extra presets.
 
@@ -566,14 +567,22 @@ def main():
         # own record family) + llama3.2-1b (GQA/128k-vocab), each in an
         # isolated subprocess, then the SAME headline line REPEATED last
         # for the tail-line parse.
-        suite = ("gpt2-xl", "gpt2-1.3b", "gpt2-moe-125m", "bert-large",
-                 "llama3.2-1b") if (
-            on_tpu and os.environ.get("BENCH_SUITE", "1") != "0") else ()
+        suite = (
+            ("gpt2-xl", {"BENCH_MODEL": "gpt2-xl"}),
+            ("gpt2-1.3b", {"BENCH_MODEL": "gpt2-1.3b"}),
+            ("gpt2-moe-125m", {"BENCH_MODEL": "gpt2-moe-125m"}),
+            ("bert-large", {"BENCH_MODEL": "bert-large"}),
+            # the reference's own record config (64 TFLOPS/V100 ~ 51% of
+            # peak at seq=128, docs/_posts/2020-05-28): measured 0.61 here
+            ("bert-large seq128 record config",
+             {"BENCH_MODEL": "bert-large", "BENCH_SEQ": "128",
+              "BENCH_GAS": "8"}),
+            ("llama3.2-1b", {"BENCH_MODEL": "llama3.2-1b"}),
+        ) if on_tpu and os.environ.get("BENCH_SUITE", "1") != "0" else ()
         headline, ok = bench_line(model_name)
         print(json.dumps(headline), flush=True)
-        for extra in suite:
-            print(json.dumps(_subproc_line({"BENCH_MODEL": extra}, extra)),
-                  flush=True)
+        for label, env in suite:
+            print(json.dumps(_subproc_line(env, label)), flush=True)
         if suite and os.environ.get("BENCH_SERVE_LINE", "1") != "0":
             # serving evidence: batched decode tok/s + MBU on the headline
             # model (prefill solved out) — the inference-engine counterpart

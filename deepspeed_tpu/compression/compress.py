@@ -59,7 +59,15 @@ class CompressionTransform:
     def __init__(self, config: CompressionConfig, param_shapes: Any):
         self.config = config
         flat, self._treedef = jax.tree_util.tree_flatten_with_path(param_shapes)
-        self._plans = []          # per leaf: list of (schedule_offset, fn)
+        self._plans = []          # per leaf: list of stage dicts (see _quant_plan)
+        self._paths = []
+        # MoQ eigenvalue coupling (reference runtime/quantize.py:70): per-layer
+        # integer factors stretching the quantization-period schedule of
+        # layer-stacked leaves; installs are (step, factors) events so the
+        # stretch is forward-only (see set_eigenvalue_factors)
+        self._ev_factors = None
+        self._ev_history = []
+        self._ev_layer_name = "blocks"
         n_armed = 0
         for path, leaf in flat:
             p = _path_of(path)
@@ -68,6 +76,7 @@ class CompressionTransform:
                 plan += self._quant_plan(p)
                 plan += self._prune_plans(p, leaf)
             self._plans.append(plan)
+            self._paths.append(p)
             n_armed += bool(plan)
         logger.info(f"init_compression: {n_armed} tensors armed")
 
@@ -98,9 +107,12 @@ class CompressionTransform:
                     off = shared.schedule_offset + i * period
                     end = (shared.schedule_offset + (i + 1) * period
                            if i + 1 < len(stages) else None)
-                    plan.append((off, end,
-                                 lambda w, b=bits: basic_ops.fake_quantize(
-                                     w, b, groups, sym, sto)))
+                    plan.append({"kind": "quant", "off": off, "end": end,
+                                 "stage": i, "n_stages": len(stages),
+                                 "period": period,
+                                 "base": shared.schedule_offset,
+                                 "fn": lambda w, b=bits: basic_ops.fake_quantize(
+                                     w, b, groups, sym, sto)})
                 return plan
         return []
 
@@ -119,29 +131,135 @@ class CompressionTransform:
                 gp = PruneGroupParams(**group.params)
                 if fn_name == "head_prune":
                     nh = int(gp.num_heads or 1)
-                    plans.append((shared.schedule_offset, None,
-                                  lambda w, nh=nh, r=gp.dense_ratio:
-                                  basic_ops.head_prune(w, nh, r)))
+                    plans.append({"kind": "prune", "off": shared.schedule_offset,
+                                  "end": None,
+                                  "fn": lambda w, nh=nh, r=gp.dense_ratio:
+                                  basic_ops.head_prune(w, nh, r)})
                 else:
                     fn = getattr(basic_ops, fn_name)
-                    plans.append((shared.schedule_offset, None,
-                                  lambda w, fn=fn, r=gp.dense_ratio,
-                                  m=shared.method: fn(w, r, m)))
+                    plans.append({"kind": "prune", "off": shared.schedule_offset,
+                                  "end": None,
+                                  "fn": lambda w, fn=fn, r=gp.dense_ratio,
+                                  m=shared.method: fn(w, r, m)})
                 break
         return plans
+
+    # --------------------------------------------------- MoQ eigenvalue hook
+    def any_quant_armed(self) -> bool:
+        return any(e["kind"] == "quant" for plan in self._plans for e in plan)
+
+    def any_precision_switch(self, step: int) -> bool:
+        """True while some quant stage boundary still lies AHEAD of ``step``
+        under the current (possibly stretched) schedule — the reference's
+        ``quantizer.any_precision_switch()`` gate (engine.py:2025): once every
+        layer has reached its terminal bit width, eigenvalue re-estimation
+        can stop."""
+        for plan, path in zip(self._plans, self._paths):
+            for e in plan:
+                if e["kind"] != "quant" or e["end"] is None:
+                    continue       # terminal stage has no upper boundary
+                _, end = self._window_arrays(e, path)
+                if bool(np.any(np.asarray(end) > step)):
+                    return True
+        return False
+
+    def set_eigenvalue_factors(self, factors, layer_name: str = "blocks",
+                               step: int = 0) -> bool:
+        """Install per-layer period-stretch factors (reference
+        runtime/quantize.py:70: ``factor = 1 + floor(ev * 4)``), effective at
+        ``step``. Applies to quant stages of layer-stacked leaves under
+        ``layer_name`` whose leading dim matches ``len(factors)``.
+
+        Forward-only semantics (the reference stretches the REMAINING
+        quantize_period, never rewinding precision): the stage a layer
+        occupies at ``step`` keeps its start; only that stage's duration and
+        all later stages stretch. An install can therefore never move a layer
+        back to an earlier, higher-precision stage. Implemented by recording
+        (step, factors) installs and replaying them per schedule in
+        :meth:`_window_arrays` — all static, trace-time arithmetic.
+
+        Returns True when the factors CHANGED — the caller must invalidate
+        compiled steps then (they are trace-time constants)."""
+        f = tuple(int(x) for x in factors)
+        changed = (not self._ev_history
+                   or f != self._ev_history[-1][1]
+                   or layer_name != self._ev_layer_name)
+        if changed:
+            self._ev_history.append((int(step), f))
+            self._ev_layer_name = layer_name
+            self._ev_factors = f
+        return bool(changed)
+
+    def _schedule_state(self, base: int, period: int, n_stages: int, L: int):
+        """Replay the install history for one (base, period) schedule →
+        (anchor (L,), jstage (L,), factors (L,)): the start step and index of
+        the stage each layer occupies after the last install, and the current
+        per-layer stretch."""
+        anchor = np.full(L, base, np.int64)
+        jstage = np.zeros(L, np.int64)
+        fcur = np.ones(L, np.int64)
+        for s0, factors in self._ev_history:
+            if len(factors) != L:
+                continue
+            # advance each layer to the stage it occupies at s0 under the
+            # PREVIOUS schedule, then stretch from there with the new factors
+            adv = np.maximum(0, (s0 - anchor) // (period * fcur))
+            adv = np.minimum(adv, (n_stages - 1) - jstage)
+            anchor = anchor + adv * period * fcur
+            jstage = jstage + adv
+            fcur = np.asarray(factors, np.int64)
+        return anchor, jstage, fcur
+
+    def _window_arrays(self, e, path):
+        """(off, end) numpy arrays for a quant stage — per-layer (L,) when
+        eigenvalue installs apply to this schedule, else scalars."""
+        f = self._ev_factors
+        if (f is None or self._ev_layer_name not in path):
+            return np.asarray(e["off"]), \
+                None if e["end"] is None else np.asarray(e["end"])
+        L = len(f)
+        anchor, j, fv = self._schedule_state(e["base"], e["period"],
+                                             e["n_stages"], L)
+        i = e["stage"]
+        # stages already passed keep their static windows (inactive in the
+        # forward direction); the current stage re-anchors; later stages
+        # follow at the stretched period
+        static_off = e["base"] + i * e["period"]
+        off = np.where(i < j, static_off, anchor + (i - j) * e["period"] * fv)
+        if e["end"] is None:
+            return off, None
+        static_end = static_off + e["period"]
+        end = np.where(i < j, static_end,
+                       anchor + (i - j + 1) * e["period"] * fv)
+        return off, end
+
+    def _stretched_window(self, e, leaf, path):
+        """(off, end) for a plan entry as jnp values — per-layer vectors when
+        eigenvalue factors apply to this stacked leaf, else scalars."""
+        if e["kind"] != "quant" or self._ev_factors is None:
+            return e["off"], e["end"]
+        if not (self._ev_layer_name in path and hasattr(leaf, "shape")
+                and leaf.ndim >= 2 and leaf.shape[0] == len(self._ev_factors)):
+            return e["off"], e["end"]
+        off, end = self._window_arrays(e, path)
+        return jnp.asarray(off), None if end is None else jnp.asarray(end)
 
     # ------------------------------------------------------------- applying
     def transform(self, params: Any, step) -> Any:
         """Jit-traceable: apply each armed technique inside its step window
-        [offset, end) — end None = open-ended."""
+        [offset, end) — end None = open-ended. Quant windows may be per-layer
+        vectors over a stacked leaf's leading axis (MoQ eigenvalue stretch)."""
         leaves = jax.tree_util.tree_leaves(params)
         out = []
-        for leaf, plan in zip(leaves, self._plans):
+        for leaf, plan, path in zip(leaves, self._plans, self._paths):
             w = leaf
-            for offset, end, fn in plan:
+            for e in plan:
+                offset, end = self._stretched_window(e, leaf, path)
                 active = step >= offset if end is None else \
                     (step >= offset) & (step < end)
-                w = jnp.where(active, fn(w), w)
+                if getattr(active, "ndim", 0):          # (L,) per-layer gate
+                    active = active.reshape((-1,) + (1,) * (w.ndim - 1))
+                w = jnp.where(active, e["fn"](w), w)
             out.append(w)
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
@@ -153,9 +271,9 @@ class CompressionTransform:
         out = []
         for leaf, plan in zip(leaves, self._plans):
             w = leaf
-            for _, end, fn in plan:
-                if end is None:
-                    w = fn(w)
+            for e in plan:
+                if e["end"] is None:
+                    w = e["fn"](w)
             out.append(w)
         return jax.tree_util.tree_unflatten(self._treedef, out)
 

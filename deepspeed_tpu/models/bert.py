@@ -241,7 +241,8 @@ class BertModel:
         h = self._act(h) @ blk["fc2_w"].astype(x.dtype) + blk["fc2_b"].astype(x.dtype)
         return self._layer_norm(x + h, blk["mlp_ln_g"], blk["mlp_ln_b"])
 
-    def _trunk(self, params, input_ids, token_type_ids=None, attention_mask=None):
+    def _trunk(self, params, input_ids, token_type_ids=None, attention_mask=None,
+               rng=None, pld_theta=None):
         c = self.config
         B, T = input_ids.shape
         x = params["wte"].astype(c.dtype)[input_ids] \
@@ -263,10 +264,28 @@ class BertModel:
                 block_fn,
                 policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
 
-        def scan_body(carry, blk):
-            return block_fn(carry, blk, attention_mask), None
+        # Progressive Layer Drop gate (same design as models/gpt2.py _trunk:
+        # depth-scaled keep probs, inverted 1/p scaling, θ traced) — PLD's
+        # home model family (arXiv:2010.13369 trains BERT)
+        use_pld = pld_theta is not None and rng is not None
+        if use_pld:
+            from deepspeed_tpu.runtime.progressive_layer_drop import layer_keep_probs
 
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"],
+            keep_p = layer_keep_probs(pld_theta, c.n_layer)
+            pld_rngs = jax.random.split(jax.random.fold_in(rng, 0x9D), c.n_layer)
+        else:
+            keep_p = pld_rngs = None
+
+        def scan_body(carry, xs):
+            blk, kp, prng = xs
+            x = block_fn(carry, blk, attention_mask)
+            if use_pld:
+                gate = jnp.where(jax.random.bernoulli(prng, kp),
+                                 1.0 / kp, 0.0).astype(x.dtype)
+                x = carry + gate * (x - carry)
+            return x, None
+
+        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], keep_p, pld_rngs),
                             unroll=c.scan_unroll)
         return x
 
@@ -290,18 +309,20 @@ class BertModel:
         return self._mlm_logits(
             params, self._trunk(params, input_ids, token_type_ids, attention_mask))
 
-    def loss(self, params, batch, rng=None):
+    def loss(self, params, batch, rng=None, pld_theta=None):
         """Masked-LM cross entropy. ``batch``: dict with input_ids and labels
         ((B, T), -100 = not predicted — the HF convention) [+ optional
         token_type_ids / attention_mask]. The vocab projection runs through
         the shared chunked CE (models/common.py) so the (B, T, V) fp32
-        logits tensor is never materialized."""
+        logits tensor is never materialized. ``pld_theta``: traced
+        Progressive-Layer-Drop keep probability (None = all blocks run)."""
         from deepspeed_tpu.models.common import chunked_lm_loss
 
         ids = batch["input_ids"]
         labels = batch.get("labels", ids)
         x = self._trunk(params, ids, batch.get("token_type_ids"),
-                        batch.get("attention_mask"))
+                        batch.get("attention_mask"), rng=rng,
+                        pld_theta=pld_theta)
         mask = (labels != IGNORE_INDEX)
         maxp = self.config.max_predictions_per_seq
         if maxp is not None and maxp < ids.shape[1]:

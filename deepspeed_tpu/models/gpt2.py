@@ -400,7 +400,7 @@ class GPT2Model:
                     "attn_out", "mlp_act"))
         return fn
 
-    def _trunk(self, params, input_ids, rng=None):
+    def _trunk(self, params, input_ids, rng=None, pld_theta=None):
         c = self.config
         B, T = input_ids.shape
         x = self._embed(params, input_ids)
@@ -414,13 +414,34 @@ class GPT2Model:
         rope = self._rope_tables(jnp.arange(T))
         windows = self._layer_windows()   # None (empty pytree leaf) or (L,)
 
+        # Progressive Layer Drop (reference runtime/progressive_layer_drop.py:8
+        # + the DeepSpeedExamples BERT pld_theta forward kwarg): per-block
+        # stochastic-depth gate with depth-scaled keep probability. θ is a
+        # TRACED scalar — the engine evaluates the θ(t) schedule from
+        # state.step inside the jitted step, so no recompile as it anneals.
+        use_pld = pld_theta is not None and rng is not None
+        if use_pld:
+            from deepspeed_tpu.runtime.progressive_layer_drop import layer_keep_probs
+
+            keep_p = layer_keep_probs(pld_theta, c.n_layer)          # (L,)
+            pld_rngs = jax.random.split(jax.random.fold_in(rng, 0x9D), c.n_layer)
+        else:
+            keep_p = pld_rngs = None
+
         def scan_body(carry, xs):
-            blk, lrng, w = xs
+            blk, lrng, w, kp, prng = xs
             x = block_fn(carry, blk, lrng, rope, w)
+            if use_pld:
+                # gate the block's residual contribution; 1/p inverted scaling
+                # keeps E[x] so inference (no θ) needs no rescale
+                gate = jnp.where(jax.random.bernoulli(prng, kp),
+                                 1.0 / kp, 0.0).astype(x.dtype)
+                x = carry + gate * (x - carry)
             return x, None
 
         x, _ = jax.lax.scan(scan_body, x,
-                            (params["blocks"], layer_rngs, windows),
+                            (params["blocks"], layer_rngs, windows,
+                             keep_p, pld_rngs),
                             unroll=max(1, int(c.scan_unroll)))
         return self._layer_norm(x, params["lnf_g"], params["lnf_b"])
 
@@ -428,7 +449,7 @@ class GPT2Model:
         """Transformer trunk only: (B, T) → final hidden (B, T, D)."""
         return self._trunk(params, input_ids, rng)
 
-    def loss(self, params, batch, rng=None):
+    def loss(self, params, batch, rng=None, pld_theta=None):
         """batch: dict with input_ids (B,T) [+ optional labels/loss_mask] or a
         bare (B,T) array — next-token cross entropy.
 
@@ -436,12 +457,16 @@ class GPT2Model:
         (B, T, V) fp32 logits tensor is never materialized (the same memory
         trick as the reference's fused softmax-CE kernels, csrc/transformer/
         softmax_kernels.cu — at V≈50k this is multiple GB per microbatch).
+
+        ``pld_theta``: traced Progressive-Layer-Drop keep-probability scalar
+        (engine passes it when the ``progressive_layer_drop`` config block is
+        enabled); None = all blocks run.
         """
         from deepspeed_tpu.models.common import chunked_lm_loss, parse_lm_batch
 
         ids, labels, mask = parse_lm_batch(batch)
         c = self.config
-        x = self._trunk(params, ids, rng)[:, :-1]          # (B, T-1, D)
+        x = self._trunk(params, ids, rng, pld_theta=pld_theta)[:, :-1]  # (B, T-1, D)
         head = (params["wte"].T if c.tie_embeddings else params["lm_head"]).astype(x.dtype)
         return chunked_lm_loss(x, head, labels[:, 1:],
                                mask[:, 1:] if mask is not None else None,

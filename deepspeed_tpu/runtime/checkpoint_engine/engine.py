@@ -262,5 +262,10 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         # custom schedules need the user's fn installed first; train_batch
         # recomputes difficulty from _host_step on the next step anyway
         sched.update_difficulty(engine._host_step + 1)
+    pld = getattr(engine, "progressive_layer_drop", None)
+    if pld is not None:
+        # the jitted step reads θ(t) from the restored state.step; re-sync the
+        # host-side reporting mirror so pld_theta() matches it after resume
+        pld.update_state(engine._host_step)
     log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
     return path, meta.get("client_state", {})

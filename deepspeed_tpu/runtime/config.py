@@ -35,6 +35,41 @@ DEEPSPEED_OPTIMIZERS = [
     LION_OPTIMIZER,
 ]
 
+# Reference ds_config keys that are ACCEPTED but deliberately do nothing on
+# TPU, with the rationale. The single source of truth: the engine logs each
+# one the user sets, `bin/ds_config_doc` renders this table into
+# docs/CONFIG.md, and the config contract (extra='forbid' + documented
+# advisories, MIGRATING.md) forbids any key outside this set from being a
+# silent no-op.
+ADVISORY_NOOP_KEYS = {
+    "sparse_gradients":
+        "XLA gradients are DENSE: embedding backward lowers to a dense "
+        "scatter-add fused into the step program. The reference's sparse "
+        "path (runtime/sparse_tensor.py:12 + engine sparse_allreduce_bucket, "
+        "engine.py:2375) compresses torch.sparse embedding grads over NCCL — "
+        "a gradient representation that does not exist under XLA, and dense "
+        "reduce-scatter over ICI is the fast path regardless.",
+    "prescale_gradients":
+        "grad reductions are inserted by GSPMD from sharding constraints, "
+        "not issued by the engine; overflow-avoidance prescaling is subsumed "
+        "by the fp32 accumulation dtype (data_types.grad_accum_dtype) and "
+        "fp16 dynamic loss scaling.",
+    "gradient_predivide_factor":
+        "see prescale_gradients — the predivide factor has no engine-issued "
+        "allreduce to attach to.",
+    "disable_allgather":
+        "legacy ZeRO perf knob (allgather vs broadcast parameter "
+        "reassembly); GSPMD chooses the gather strategy during compilation.",
+    "graph_harvesting":
+        "CUDA-graph capture knob; the whole TPU train step is already ONE "
+        "compiled XLA program — there is nothing to capture.",
+    "use_data_before_expert_parallel":
+        "expert/data group layout follows the device-mesh axis order "
+        "(pipe, data, mics, expert, seq, tensor — parallel/topology.py), "
+        "which already places data outermost of expert; rank-list "
+        "re-ordering is a process-group concept with no mesh counterpart.",
+}
+
 
 class FP16Config(DeepSpeedConfigModel):
     enabled: bool = False
@@ -185,6 +220,31 @@ class HybridEngineConfig(DeepSpeedConfigModel):
     tp_gather_partition_size: int = Field(8, ge=1)
 
 
+class PLDConfig(DeepSpeedConfigModel):
+    """cf. reference ``progressive_layer_drop`` block (config.py:119
+    get_pld_enabled / get_pld_params; runtime/progressive_layer_drop.py:8).
+    theta = keep-probability floor, gamma = anneal rate of θ(t)."""
+    enabled: bool = False
+    theta: float = Field(0.5, gt=0.0, le=1.0)
+    gamma: float = Field(0.001, ge=0.0)
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    """cf. reference ``eigenvalue`` block (config.py:533 get_eigenvalue_config)
+    — power-iteration curvature estimates feeding MoQ's quantization-period
+    schedule. ``layer_name``/``layer_num`` select the block stack; on TPU the
+    models' stacked-leaf layout makes every block addressable at once, so
+    ``layer_name`` defaults to the gpt2/bert trunk key."""
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = Field(100, gt=0)
+    tol: float = Field(1e-2, gt=0.0)
+    stability: float = Field(1e-6, ge=0.0)
+    gas_boundary_resolution: int = Field(1, gt=0)
+    layer_name: str = "blocks"
+    layer_num: int = Field(0, ge=0)
+
+
 class ElasticityConfig(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -272,7 +332,13 @@ class DeepSpeedConfig:
         self.checkpoint_tag_validation_enabled = self.checkpoint_config.tag_validation.lower() != "ignore"
         self.checkpoint_tag_validation_fail = self.checkpoint_config.tag_validation.lower() == "fail"
         self.load_universal_checkpoint = self.checkpoint_config.load_universal
-        self.eigenvalue_enabled = bool(pd.get("eigenvalue", {}).get("enabled", False))
+        self.eigenvalue_config = EigenvalueConfig(**pd.get("eigenvalue", {}))
+        self.eigenvalue_enabled = self.eigenvalue_config.enabled
+        self.pld_config = PLDConfig(**pd.get("progressive_layer_drop", {}))
+        self.pld_enabled = self.pld_config.enabled
+        # advisory no-ops the user actually set (engine logs them at init);
+        # presence, not truthiness — an explicit false/0 is still "set"
+        self.advisory_keys_set = [k for k in ADVISORY_NOOP_KEYS if k in pd]
 
         self._configure_train_batch_size(world_size)
 

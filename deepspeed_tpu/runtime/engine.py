@@ -371,6 +371,49 @@ class DeepSpeedEngine:
 
             self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
 
+        # Progressive Layer Drop (reference engine.py:334
+        # _configure_progressive_layer_drop): the host object mirrors θ(t) for
+        # reporting; the jitted step evaluates the same schedule from
+        # state.step (see _micro_loss_and_grads) so it needs no host update.
+        self.progressive_layer_drop = None
+        if self._config.pld_enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+
+            if not self._loss_accepts_pld():
+                raise ValueError(
+                    "progressive_layer_drop.enabled=true but the model loss "
+                    "does not accept a pld_theta kwarg — use a model with "
+                    "PLD gates (models.gpt2/bert) or add pld_theta support")
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self._config.pld_config.theta,
+                gamma=self._config.pld_config.gamma)
+
+        # Eigenvalue (reference engine.py:330 _configure_eigenvalue): block
+        # Hessian curvature via power iteration, feeding MoQ's per-layer
+        # quantization-period stretch at gas boundaries (engine.py:2027).
+        self.eigenvalue = None
+        self.block_eigenvalue = None
+        if self._config.eigenvalue_enabled:
+            from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+            ec = self._config.eigenvalue_config
+            self.eigenvalue = Eigenvalue(
+                verbose=ec.verbose, max_iter=ec.max_iter, tol=ec.tol,
+                stability=ec.stability,
+                gas_boundary_resolution=ec.gas_boundary_resolution,
+                layer_name=ec.layer_name, layer_num=ec.layer_num)
+
+        for key in self._config.advisory_keys_set:
+            from deepspeed_tpu.runtime.config import ADVISORY_NOOP_KEYS
+
+            log_dist(f"config key {key!r} accepted (advisory no-op on TPU): "
+                     f"{ADVISORY_NOOP_KEYS[key]}", ranks=[0])
+        if self._config.dump_state:
+            # reference engine.py dump_state role; the partition report was
+            # already logged unconditionally above
+            self._config.print_config()
+
         log_dist(f"engine ready: dtype={jnp.dtype(self.train_dtype).name}, zero={self.zero_stage}, "
                  f"dp={self.dp_world_size}, tp={self.mp_world_size}, "
                  f"micro_batch={self.train_micro_batch_size_per_gpu()}, "
@@ -524,11 +567,19 @@ class DeepSpeedEngine:
             params = comp.transform(params, step)
         return params
 
-    def _micro_loss_and_grads(self, params, batch, rng, scale):
-        """One microbatch: loss (unscaled, for reporting) + scaled grads."""
+    def _micro_loss_and_grads(self, params, batch, rng, scale, step=None):
+        """One microbatch: loss (unscaled, for reporting) + scaled grads.
+        ``step`` (traced) feeds the PLD θ(t) schedule when enabled."""
+        kw = {}
+        if self.progressive_layer_drop is not None and step is not None:
+            from deepspeed_tpu.runtime.progressive_layer_drop import theta_at
+
+            pld = self._config.pld_config
+            kw["pld_theta"] = theta_at(step, pld.theta, pld.gamma)
 
         def scaled_loss(p):
-            out = self._loss_fn(p, batch, rng) if self._loss_accepts_rng() else self._loss_fn(p, batch)
+            out = self._loss_fn(p, batch, rng, **kw) if self._loss_accepts_rng() \
+                else self._loss_fn(p, batch, **kw)
             loss = out[0] if isinstance(out, tuple) else out
             return loss.astype(jnp.float32) * scale, loss
 
@@ -545,6 +596,12 @@ class DeepSpeedEngine:
             except (TypeError, ValueError):
                 self._rng_ok = False
         return self._rng_ok
+
+    def _loss_accepts_pld(self) -> bool:
+        try:
+            return "pld_theta" in inspect.signature(self._loss_fn).parameters
+        except (TypeError, ValueError):
+            return False
 
     def _apply_grads(self, state: TrainState, grads, loss) -> Tuple[TrainState, StepMetrics]:
         """Shared optimizer phase: unscale→clip→update→cast-back→scale bookkeeping.
@@ -856,7 +913,8 @@ class DeepSpeedEngine:
         params_c = self._compute_params(state.params, step=state.step)
         if gas == 1:
             rng = jax.random.fold_in(state.rng, state.step)
-            return self._micro_loss_and_grads(params_c, batch, rng, scale)
+            return self._micro_loss_and_grads(params_c, batch, rng, scale,
+                                              step=state.step)
 
         def split(x):  # microbatch split: leading dim -> (gas, micro)
             return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
@@ -879,7 +937,8 @@ class DeepSpeedEngine:
         def body(carry, mb):
             acc, i = carry
             rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step), i)
-            loss, grads = self._micro_loss_and_grads(params_c, mb, rng, scale)
+            loss, grads = self._micro_loss_and_grads(params_c, mb, rng, scale,
+                                                     step=state.step)
             grads = jax.lax.with_sharding_constraint(grads, plan.grad_specs)
             acc = jax.tree.map(lambda a, g: a + g.astype(acc_dtype), acc, grads)
             return (acc, i + 1), loss
@@ -935,7 +994,8 @@ class DeepSpeedEngine:
             if gas == 1:
                 rng = jax.random.fold_in(state.rng, state.step)
                 loss, grads = self._micro_loss_and_grads(state.params, batch, rng,
-                                                         jnp.float32(1.0))
+                                                         jnp.float32(1.0),
+                                                         step=state.step)
             else:
                 def split(x):
                     return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
@@ -945,7 +1005,9 @@ class DeepSpeedEngine:
                 def body(carry, mb):
                     acc, i = carry
                     rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step), i)
-                    l, g = self._micro_loss_and_grads(state.params, mb, rng, jnp.float32(1.0))
+                    l, g = self._micro_loss_and_grads(state.params, mb, rng,
+                                                      jnp.float32(1.0),
+                                                      step=state.step)
                     acc = jax.tree.map(lambda a, x: a + x.astype(jnp.float32), acc, g)
                     return (acc, i + 1), l
 
@@ -1137,6 +1199,8 @@ class DeepSpeedEngine:
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
         self._post_step(metrics)
+        if self.eigenvalue is not None:
+            self._maybe_update_eigenvalue(batch)
         self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics.loss)
         self.tput_timer.stop(global_step=True, sync_obj=metrics.loss)
         if self.flops_profiler_cfg.enabled and \
@@ -1217,7 +1281,7 @@ class DeepSpeedEngine:
                                          jnp.int32(0))
                 loss, grads = self._micro_loss_and_grads(
                     self._compute_params(state.params, step=state.step),
-                    batch, rng, scale)
+                    batch, rng, scale, step=state.step)
                 grads = jax.lax.with_sharding_constraint(grads, self.plan.grad_specs)
                 return loss, grads
 
@@ -1302,11 +1366,20 @@ class DeepSpeedEngine:
         # host-side step counter: never force a device sync just for logging
         self._host_step = getattr(self, "_host_step", 0) + 1
         step = self._host_step
+        if self.progressive_layer_drop is not None:
+            # mirror of the jitted θ(t) — reference engine.py updates PLD state
+            # host-side each step; here it is reporting-only (the compiled
+            # step already evaluated the same schedule from state.step)
+            self.progressive_layer_drop.update_state(step)
         if self._config.steps_per_print and step % self._config.steps_per_print == 0:
             log_dist(f"step={step} loss={float(metrics.loss):.4f} "
                      f"lr={float(metrics.lr):.3e} gnorm={float(metrics.grad_norm):.3f}"
                      + (f" scale={float(metrics.loss_scale):.0f}" if self.fp16_enabled else ""),
                      ranks=[0])
+            if self._config.memory_breakdown:
+                from deepspeed_tpu.runtime.utils import see_memory_usage
+
+                see_memory_usage(f"after step {step}", force=True)
         if self.monitor.enabled:
             self.monitor.write_events([("Train/Samples/train_loss", float(metrics.loss), self.global_samples),
                                        ("Train/Samples/lr", float(metrics.lr), self.global_samples)])
@@ -1327,6 +1400,71 @@ class DeepSpeedEngine:
         fn = schedule_func_dict["get_difficulty"] \
             if isinstance(schedule_func_dict, dict) else schedule_func_dict
         self.curriculum_scheduler.set_custom_get_difficulty(fn)
+
+    def _maybe_update_eigenvalue(self, batch):
+        """Gas-boundary MoQ coupling (reference engine.py:2025-2035): every
+        ``gas_boundary_resolution`` steps while quantization stages are armed,
+        re-estimate block eigenvalues on the first microbatch and stretch the
+        per-layer quantization periods. Factors are trace-time constants, so a
+        CHANGE invalidates compiled steps — they move only when a block's
+        normalized curvature crosses a 0.25 boundary, so recompiles are rare.
+        The measurement informs steps AFTER this one (the reference computes
+        pre-step; one step of lag is the price of keeping the train step
+        free of host round-trips)."""
+        comp = getattr(self, "_compression", None)
+        step = getattr(self, "_host_step", 0)
+        if (comp is None or not comp.any_quant_armed()
+                or step % self.eigenvalue.gas_boundary_resolution
+                or not comp.any_precision_switch(step)):
+            # the reference gates on quantizer.any_precision_switch()
+            # (engine.py:2025): once every layer is at its terminal bit
+            # width the estimate can no longer change anything — stop paying
+            # for power iterations
+            return
+        mb = self.train_micro_batch_size_per_gpu()
+        micro = jax.tree.map(lambda x: x[:mb], batch)
+
+        def loss_scalar(p, b):
+            out = self._loss_fn(p, b, None) if self._loss_accepts_rng() \
+                else self._loss_fn(p, b)
+            return out[0] if isinstance(out, tuple) else out
+
+        rng = jax.random.fold_in(self.state.rng, 0xE1 + step)
+        self.block_eigenvalue = self.eigenvalue.compute_eigenvalue(
+            loss_scalar, self.state.params, micro, rng)
+        if self.block_eigenvalue:
+            raw = [ev for ev, _ in self.block_eigenvalue.values()]
+            old = getattr(comp, "_ev_factors", None)
+            factors = []
+            for l, ev in enumerate(raw):
+                new = 1 + int(ev * 4)
+                if old is not None and l < len(old) and new != old[l]:
+                    # hysteresis: power iteration restarts from random v0 and
+                    # post_process renormalizes per measurement, so estimates
+                    # near a 0.25 bucket edge wobble — accept a flip only when
+                    # 4·ev moved past the ADJACENT bucket's midpoint, else a
+                    # boundary-riding layer recompiles the train step every
+                    # gas boundary
+                    if abs(4.0 * ev - (old[l] - 0.5)) <= 1.0:
+                        new = old[l]
+                factors.append(new)
+            if comp.set_eigenvalue_factors(
+                    factors, layer_name=self.eigenvalue.layer_name, step=step):
+                self.invalidate_compiled()
+
+    def eigenvalue_enabled(self) -> bool:
+        """reference engine.py:485 name parity."""
+        return self.eigenvalue is not None
+
+    def pld_enabled(self) -> bool:
+        """reference engine.py:475 name parity."""
+        return self.progressive_layer_drop is not None
+
+    def pld_theta(self) -> float:
+        """reference engine.py:479: current θ(t) of the PLD schedule (the
+        value the NEXT step will use; the jitted step computes it on-device)."""
+        return (self.progressive_layer_drop.get_theta()
+                if self.progressive_layer_drop is not None else 1.0)
 
     def train_batch_size(self) -> int:
         return self._config.train_batch_size

@@ -78,3 +78,17 @@ def test_config_doc_in_sync(tmp_path):
     with open(out) as f, open(os.path.join(repo, "docs", "CONFIG.md")) as g:
         assert f.read() == g.read(), \
             "docs/CONFIG.md is stale: run `python bin/ds_config_doc`"
+
+
+def test_advisory_noop_keys_accepted_and_tracked():
+    """Every ADVISORY_NOOP_KEYS entry parses (no rejection) and is recorded so
+    the engine can log it; keys the user did not set are not reported."""
+    from deepspeed_tpu.runtime.config import ADVISORY_NOOP_KEYS, DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "sparse_gradients": True,
+                           "graph_harvesting": True})
+    assert set(cfg.advisory_keys_set) == {"sparse_gradients", "graph_harvesting"}
+    # the documented contract: each advisory key has a written rationale
+    for key, why in ADVISORY_NOOP_KEYS.items():
+        assert len(why) > 40, f"{key} rationale too thin"

@@ -8,13 +8,18 @@ Baseline: the north-star from BASELINE.md — ≥50% MFU for GPT-2-class ZeRO-3
 pretraining (the reference's best published efficiency is 52% of peak on V100,
 docs/_posts/2020-05-19-bert-record.md:13). vs_baseline = MFU / 0.50.
 
-Default on TPU: the BASELINE ladder — the gpt2-760m headline, gpt2-xl
-(1.5B north star, host-offload-backed on one 16G chip), gpt2-1.3b
-(offload), gpt2-moe-125m (Switch-8-expert milestone), bert-large (the
+Default on TPU: the BASELINE ladder — the gpt2-760m headline, the offload
+family (gpt2-xl 1.5B north star, gpt2-1.3b, llama3.2-1b — GQA, 128k
+vocab; all host-offload-backed on one 16G chip), bert-large (the
 reference's record family, at seq512 AND its published seq128 record
-config), llama3.2-1b (GQA, 128k vocab, offload), a serving-decode line
+config), gpt2-moe-125m (Switch-8-expert milestone), a serving-decode line
 (BENCH_SERVE_LINE=0 skips), a v5e-64 north-star projection, headline
-repeated.
+repeated. The ladder runs under BENCH_DEADLINE_S (default 1620s) with an
+explicit-skip policy, per-line regression guards against the EXPECTED
+ledger (<70% of expectation re-measures once; <85% marks
+"regression": true), and SIGTERM/SIGINT handlers that re-print the
+headline so a driver timeout still parses the right tail line
+(BENCH_r04 rc=124 post-mortem).
 Set BENCH_MODEL to bench exactly one preset (gpt2-*/gpt2-moe-*/llama-*/
 bert-*), BENCH_SUITE=0 to skip the extra presets.
 
@@ -48,13 +53,34 @@ sweet spots on one v5e chip:
   token batch. 1.3b defaults to stream_overlap (double-buffered host
   streaming, +0.018 over serial, stable over repeats); xl keeps serial
   (overlap faults its worker or collapses 3x) and gas=24/32 fault too.
+  r5 xl head-layout sweep (grad-only @bs=14, remat='attn', the n_embd=1600
+  divisor ladder — param/flop-invariant, architecture differs): 25x64
+  0.429 < 20x80 0.454 < 10x160 0.468 < 8x200 ~= 5x320, both 0.496-0.504
+  over 5 samples each (8x200 needs fb=1024; 4x400 exceeds the flash
+  kernel's vmem scratch; bs=15/16 and unroll=8 OOM HBM; unroll 2/4 and
+  fb 256/512 within noise of default). 0.499+-0.003 is the measured xl
+  single-chip compute ceiling of this kernel/remat recipe — and the term
+  that pins the v5e-64 projection at ~0.497: comm+sharded-update cost only
+  ~0.002 at gas=16. The remaining gap to 0.52+ is the remat='attn'
+  recompute tax plus n_embd=1600 spanning 12.5 MXU tiles. The xl
+  ladder line + northstar projection run 5x320
+  (registry.TPU_HEAD_OVERRIDES); BENCH_HEADS=25 benches canonical.
+  Reproducibility (r4 post-mortem): llama3.2-1b measured 0.136 under the
+  r4 driver vs 0.341 standalone same config — environmental collapse, not
+  config drift; the ladder now re-measures any line <70% of EXPECTED and
+  flags <85% as regression.
 - bert-large (the reference's own headline family): 0.561 MFU at
   bs=14/seq=512/gas=4 — 8 heads x head_dim 128 (MXU-aligned; canonical
   16x64 measured 0.463), no remat + unrolled layer loop + MLM head over
   gathered masked positions (honest accounting: skipped head flops
   subtracted); flash beats einsum at seq=512. At the reference record's
   own seq=128 phase-1 config: 0.611 (bs=48, gas=8) vs the published
-  64 TFLOPS/V100 ≈ 51% — BEATS the reference's record efficiency.
+  64 TFLOPS/V100 ≈ 51% — beats the reference's record efficiency at the
+  same seq/batch/gas config, with the TPU-native 8x128 head layout (the
+  canonical 16x64 architecture the record ran measures ~0.46-0.48 here:
+  its knob sweep — einsum 0.416, fb256 0.379, fb128 0.271, bs12 0.460,
+  bs16 0.454 — is ceiling-bound by head_dim 64 halving MXU contraction
+  utilization).
 - gpt2-moe-125m (Switch-8): 0.390 MFU at bs=12 with the MXU-aligned
   6x128 head layout (12x64 canonical: 0.328; bs=16 0.370, bs=24 0.200).
 - llama3.2-1b (GQA 32h/8kv, V=128k, tied): 0.341 MFU at bs=12/gas=32,
@@ -136,14 +162,18 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         # XLA pad-copy of the embedding table each step
         config = dataclasses.replace(config, vocab_size=vocab)
     if not heads and not model_name.startswith("llama") and on_tpu:
-        # TPU-native pretrain head layout: head_dim 128 at fixed n_embd
-        # (param/flop invariant; no-op when n_embd%128 or already aligned —
-        # 760m/1.3b presets are, xl's 1600 can't be). Measured: bert-large
-        # 0.463 -> 0.556, gpt2-moe-125m 0.328 -> 0.390. ds_tune applies the
-        # same registry.mxu_aligned helper, so tuner and bench agree;
-        # BENCH_HEADS=16 etc. benches a canonical layout instead.
-        from deepspeed_tpu.models.registry import mxu_aligned
-        config = mxu_aligned(config)
+        # TPU-native pretrain head layout (param/flop invariant, architecture
+        # differs — the relayout is LOGGED for reproducibility): head_dim 128
+        # where n_embd allows (760m 16->12 heads, bert-large 16->8, moe 12->6),
+        # measured per-preset override where it doesn't (gpt2-xl 25x64 ->
+        # 5x320: the 64-wide contractions waste half of every MXU pass; see
+        # registry.TPU_HEAD_OVERRIDES for the sweep). ds_tune applies the
+        # same helper so tuner and bench agree; BENCH_HEADS=25 etc. benches
+        # a canonical layout instead.
+        from deepspeed_tpu.models.registry import tpu_native_layout
+        config = tpu_native_layout(config, model_name,
+                                   log=lambda m: print(f"# {m}",
+                                                       file=sys.stderr))
     # measured per-family sweet spots on one v5e chip (see docstring):
     # decoders want 'attn' remat (save flash outputs, recompute the cheap
     # matmul chain); bert-large fits WITHOUT remat at bs=12 once the layer
@@ -205,11 +235,13 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         config = dataclasses.replace(config, flash_block=fb or None,
                                      scan_unroll=int(os.environ.get(
                                          "BENCH_UNROLL", 1)))
-    # offload-backed models: fewer timed steps (each is seconds), and large
-    # accumulation — the way ZeRO-Offload is actually run: the 15G fp32
-    # streamed Adam pass amortizes over the accumulation window
+    # offload-backed models: fewer timed steps (each is ~45s of wall time at
+    # gas=32 — two timed steps measure ~790k tokens, noise ±2%, and the
+    # regression guard re-measures a collapsed line), and large accumulation
+    # — the way ZeRO-Offload is actually run: the 15G fp32 streamed Adam
+    # pass amortizes over the accumulation window
     steps = int(os.environ.get("BENCH_STEPS",
-                               (3 if big else 30) if on_tpu else 3))
+                               (2 if big else 30) if on_tpu else 3))
     # bert: gas=4 amortizes the Adam HBM pass (12ms on 334M fp32 state)
     # over four 134ms microsteps — measured 0.443 → 0.464 MFU on v5e.
     # offload-backed models: gas=32 amortizes the ~32G/step host round-trip
@@ -258,7 +290,10 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     batch = make_batch(batch_size, seq, config.vocab_size, seed=0)
     batch = engine._shard_batch(batch)  # pre-place once; steps then pipeline
 
-    # warmup / compile
+    # warmup / compile: two warm steps ALWAYS — measured (r5): charging the
+    # first post-compile offload step to the timed window costs ~17% of the
+    # xl line (pinned-host buffer setup rides step 1); the ladder budget cut
+    # comes from steps 3->2 instead
     for _ in range(2):
         loss = engine.train_batch(batch)
     float(loss)  # host read = real completion barrier
@@ -307,13 +342,15 @@ def serving_line(on_tpu: bool, n_dev: int) -> dict:
 
     import deepspeed_tpu
     from deepspeed_tpu.accelerator import get_accelerator
-    from deepspeed_tpu.models.registry import mxu_aligned, resolve_family
+    from deepspeed_tpu.models.registry import resolve_family, tpu_native_layout
 
     name = os.environ.get("BENCH_MODEL", "gpt2-760m")
     model_cls, _, PRESETS = resolve_family(name)
     config = PRESETS[name]
     if not name.startswith("llama") and on_tpu:
-        config = mxu_aligned(config)
+        # same helper as training/tuning/rlhf: serving must bench the SAME
+        # architecture the other lines measure (incl. the xl 5x320 override)
+        config = tpu_native_layout(config, name)
     B = int(os.environ.get("BENCH_BS", 32))
     prompt = int(os.environ.get("BENCH_SEQ", 128))
     gen = int(os.environ.get("BENCH_GEN", 128))
@@ -346,13 +383,16 @@ def serving_line(on_tpu: bool, n_dev: int) -> dict:
     t_step = max(t_full - t_pre1, 1e-9) / (gen - 1)
     tok_s = B / t_step / n_dev
     # per-chip traffic per decode step: weights once (at the served width)
-    # plus the live KV cache (k+v, all layers, padded length, bf16)
+    # plus the live KV cache (k+v, all layers, padded length, at the CACHE
+    # dtype — it follows the model config's dtype, not BENCH_SERVE_DTYPE)
+    import jax.numpy as jnp
+
     dtype_bytes = {"float32": 4, "fp32": 4, "bfloat16": 2, "bf16": 2,
                    "float16": 2, "fp16": 2, "int8": 1}.get(serve_dtype, 2)
     param_bytes = config.num_params() * dtype_bytes
     kv_heads = getattr(config, "n_kv_head", None) or config.n_head
     kv_bytes = 2 * config.n_layer * B * (prompt + gen) * kv_heads * \
-        config.head_dim * 2
+        config.head_dim * jnp.dtype(config.dtype).itemsize
     bw = get_accelerator().memory_bandwidth()
     mbu = (param_bytes + kv_bytes) / n_dev / (bw * t_step)
     return {
@@ -365,71 +405,123 @@ def serving_line(on_tpu: bool, n_dev: int) -> dict:
     }
 
 
+def rlhf_line(on_tpu: bool, n_dev: int) -> dict:
+    """Hybrid-engine RLHF actor evidence (the reference's flagship workload,
+    blogs/deepspeed-chat/README.md:30 — OPT-13B step-3 in 9h on 8xA100):
+    alternate ``generate`` (experience collection) and ``train_batch``
+    (policy update) over the SAME live params and measure both phases.
+
+    value = experience tok/s/chip END-TO-END (response tokens generated AND
+    trained per wall second — the number that bounds RLHF step-3 wall time).
+    vs_baseline = alternation efficiency (phase-sum / end-to-end wall): the
+    hybrid engine's design claim is a zero-cost train<->generate flip (no
+    module rewrite, no gather/scatter — runtime/hybrid_engine.py docstring),
+    so this should sit at ~1.0.
+    """
+    import deepspeed_tpu
+    from deepspeed_tpu.models.registry import resolve_family, tpu_native_layout
+
+    name = os.environ.get("BENCH_MODEL", "gpt2-125m")
+    model_cls, _, PRESETS = resolve_family(name)
+    config = PRESETS[name]
+    if not name.startswith("llama") and on_tpu:
+        # same llama/GQA exclusion as every other consumer: kv_dim follows
+        # n_kv_head, so the relayout is not param-invariant there
+        config = tpu_native_layout(config, name)
+    B = int(os.environ.get("BENCH_BS", 32))
+    prompt = int(os.environ.get("BENCH_SEQ", 128))
+    gen = int(os.environ.get("BENCH_GEN", 128))
+    model = model_cls(config)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": B * n_dev,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-5}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "hybrid_engine": {"enabled": True, "max_out_tokens": prompt + gen},
+        "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, config.vocab_size, (B * n_dev, prompt),
+                           dtype=np.int32)
+
+    def one_iter():
+        t0 = time.time()
+        seqs = np.asarray(engine.generate(prompts, max_new_tokens=gen))
+        t_gen = time.time() - t0
+        mask = np.zeros(seqs.shape, np.float32)
+        mask[:, prompt:] = 1.0          # train on the response tokens only
+        t0 = time.time()
+        loss = engine.train_batch({"input_ids": seqs.astype(np.int32),
+                                   "loss_mask": mask})
+        float(loss)
+        return t_gen, time.time() - t0
+
+    # TWO warm iterations: iter 0 compiles both phases against the freshly
+    # initialized state's layouts; the donated step returns arrays whose
+    # XLA-chosen layouts differ, so iter 1 recompiles BOTH programs once
+    # more (measured: 5.3s+9.8s then 4.0s+8.6s, steady 0.39s+0.19s after)
+    for _ in range(2):
+        one_iter()
+    iters = int(os.environ.get("BENCH_STEPS", 3))
+    t0 = time.time()
+    phases = [one_iter() for _ in range(iters)]
+    e2e = (time.time() - t0) / iters
+    t_gen = sum(p[0] for p in phases) / iters
+    t_train = sum(p[1] for p in phases) / iters
+    tok_s = B * gen / e2e
+    return {
+        "metric": f"{name} rlhf actor alternation (B={B}/chip, prompt={prompt}, "
+                  f"gen={gen}, {n_dev} chip(s), gen tok/s/chip={B*gen/t_gen:.0f}, "
+                  f"train tok/s/chip={B*(prompt+gen)/t_train:.0f}, "
+                  f"iter={e2e*1e3:.0f}ms)",
+        "value": round(tok_s, 1),
+        "unit": "rlhf-tok/s/chip",
+        "vs_baseline": round((t_gen + t_train) / e2e, 4),
+    }
+
+
 def northstar_evidence(on_tpu: bool, n_dev: int) -> dict:
-    """Measured xl compute/update breakdown + v5e-64 ZeRO-3 projection
-    (profiling/scaling.py): two short gas points solve t_micro/t_update;
-    the xl compute-only MFU (host-offload streaming excluded — at 64 chips
-    the fp32 state is dp-sharded in HBM) feeds the ICI projection."""
+    """v5e-64 ZeRO-3 north-star projection from three MEASURED terms
+    (profiling/scaling.py project_northstar):
+
+    1. the per-chip microbatch (fwd+bwd) at the 64-chip compute regime —
+       fp32 state dp-sharded into HBM, so no host streaming; measured as a
+       grad-only step at the offload-free sweet spot (bs=14, remat='attn',
+       loss-chunk residuals kept) on the TPU-native xl head layout (5x320,
+       registry.TPU_HEAD_OVERRIDES — canonical 25x64 measures 0.429 in the
+       same probe; both are in the r5 sweep table in this docstring);
+    2. the per-step sharded Adam update on this chip's 1/64 state shard —
+       the term the r4 grad-only proxy silently excluded; it is serial with
+       the step (runs after the last grad), so the projection charges it
+       at every overlap level;
+    3. the ICI collective bytes (2 param all-gathers + 1 grad
+       reduce-scatter, bf16) over the public per-chip ring bandwidth.
+
+    The r4 offload-regime gas-solve breakdown (t_update 21.8s/step on one
+    16G chip — why the offload ladder needs gas=16..32) was documentary,
+    cost ~3 min of ladder budget, and is superseded by the ladder's three
+    offload lines; it was dropped to fit the driver's bench window.
+    """
     import dataclasses
+
+    import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.accelerator import get_accelerator
     from deepspeed_tpu.models.gpt2 import GPT2Model, PRESETS, synthetic_lm_batch
-    from deepspeed_tpu.profiling.scaling import (project_northstar,
-                                                 solve_breakdown)
+    from deepspeed_tpu.models.registry import tpu_native_layout
+    from deepspeed_tpu.profiling.scaling import project_northstar
 
-    config = dataclasses.replace(PRESETS["gpt2-xl"], remat="attn")
-    seq, bs = 1024, 8
-    peak = get_accelerator().peak_flops()
-    fpt = config.flops_per_token(seq)
-    # wall-clock through the measurement can be disturbed (host contention,
-    # VM scheduling): a gas=16 point that comes out FASTER per micro than
-    # gas=4 yields t_micro<=0 and a nonsense breakdown — retry once, then
-    # fail loudly (the caller prints a FAILED evidence line)
-    for attempt in range(2):
-        times = {}
-        for gas in (4, 16):
-            engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(config), config={
-                "train_batch_size": bs * n_dev * gas,
-                "gradient_accumulation_steps": gas,
-                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-                "bf16": {"enabled": True},
-                "zero_optimization": {"stage": 1,
-                                      "offload_optimizer": {"device": "cpu"}},
-                "data_types": {"grad_accum_dtype": "bf16"},
-                "gradient_clipping": 1.0, "steps_per_print": 0})
-            batch = engine._shard_batch(synthetic_lm_batch(
-                bs * n_dev * gas, seq, config.vocab_size, seed=0))
-            loss = engine.train_batch(batch)
-            float(loss)
-            t0 = time.time()
-            for _ in range(2):
-                loss = engine.train_batch(batch)
-            float(loss)
-            times[gas] = (time.time() - t0) / 2
-            _release(engine)
-
-        bd = solve_breakdown(times[4], 4, times[16], 16)
-        t_micro, t_update = bd["t_micro_s"], bd["t_update_s"]
-        compute_mfu = (bs * seq / max(t_micro, 1e-9)) * fpt / peak
-        if 0.0 < compute_mfu < 1.0:
-            break
-    else:
-        raise RuntimeError(
-            f"unstable breakdown after retry: times={times}, "
-            f"t_micro={t_micro:.4f}s (measurement disturbed)")
-
-    # The offload-regime t_micro above is the 1-chip documentary number, but
-    # it under-represents the 64-chip compute regime: there the fp32 state is
-    # dp-sharded into HBM (no streaming working set), so the per-chip micro
-    # can run the unconstrained batch with the loss-chunk residuals kept.
-    # Measure that directly — a grad-only step (params + grads + activations
-    # only) at the offload-free sweet spot — and feed IT to the projection.
-    import jax.numpy as jnp
-
+    n_chips = int(os.environ.get("BENCH_NORTHSTAR_CHIPS", 64))
+    gas = int(os.environ.get("BENCH_NORTHSTAR_GAS", 16))
     bs64 = int(os.environ.get("BENCH_NORTHSTAR_BS", 14))
-    cfg64 = dataclasses.replace(config, remat="attn", flash_block=None,
-                                remat_loss_chunks=False)
+    seq = 1024
+    peak = get_accelerator().peak_flops()
+
+    base = PRESETS["gpt2-xl"]
+    fpt = base.flops_per_token(seq)
+    cfg64 = dataclasses.replace(
+        tpu_native_layout(base, "gpt2-xl"),
+        remat="attn", flash_block=None, remat_loss_chunks=False)
     model64 = GPT2Model(cfg64)
     params64 = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
                             model64.init_params(jax.random.PRNGKey(0)))
@@ -439,7 +531,7 @@ def northstar_evidence(on_tpu: bool, n_dev: int) -> dict:
     drain = lambda r: float(jnp.asarray(jax.tree.leaves(r)[0]).ravel()[0])
     drain(grad_fn(params64, ids64))          # compile
     # host contention only ever INFLATES wall time, so take the best of two
-    # timed windows (the same disturbance the offload solve retries on)
+    # timed windows
     t_micro64 = float("inf")
     for _ in range(2):
         t0 = time.time()
@@ -448,26 +540,59 @@ def northstar_evidence(on_tpu: bool, n_dev: int) -> dict:
         drain(g)
         t_micro64 = min(t_micro64, (time.time() - t0) / 3)
     compute_mfu64 = (bs64 * seq / t_micro64) * fpt / peak
-    if not (0.0 < compute_mfu64 < 1.0):
-        raise RuntimeError(f"implausible grad-only MFU {compute_mfu64:.3f} "
-                           f"(t_micro64={t_micro64:.3f}s, disturbed?)")
     del params64, g
     jax.clear_caches()
 
+    # (2) the sharded optimizer update: fp32 AdamW on n_params/n_chips
+    # elements, measured as one fused jit (the same leaf-update math the
+    # engine compiles; HBM-bound: ~7 fp32 streams over the shard)
+    import optax
+
+    shard = int(base.num_params() // n_chips)
+    opt = optax.adamw(1e-4, weight_decay=0.01)
+    w = jnp.zeros((shard,), jnp.float32)
+    gr = jnp.ones((shard,), jnp.float32) * 1e-3
+    st = opt.init(w)
+
+    reps = 20
+
+    @jax.jit
+    def upd_loop(w, st, gr):
+        # lax.scan inside ONE jit: the ~10ms-per-call tunnel dispatch would
+        # otherwise dominate a ~1ms HBM-bound update (axon measurement rule)
+        def body(carry, _):
+            w, st = carry
+            u, st = opt.update(gr, st, w)
+            return (optax.apply_updates(w, u), st), None
+
+        (w, st), _ = jax.lax.scan(body, (w, st), None, length=reps)
+        return w, st
+
+    w2, st2 = upd_loop(w, st, gr)
+    float(w2[0])                              # compile + barrier
+    t0 = time.time()
+    w2, st2 = upd_loop(w2, st2, gr)
+    float(w2[0])
+    t_update_shard = (time.time() - t0) / reps
+    del w, w2, st, st2, gr
+    jax.clear_caches()
+
     proj = project_northstar(
-        n_params=config.num_params(),
-        tokens_per_chip_step=bs64 * seq * 16,
+        n_params=base.num_params(),
+        tokens_per_chip_step=bs64 * seq * gas,
         flops_per_token=fpt,
-        measured_mfu_1chip=min(compute_mfu64, 0.6),
-        peak_flops=peak)
+        measured_mfu_1chip=compute_mfu64,     # raises if out of (0,1)
+        peak_flops=peak,
+        n_chips=n_chips,
+        t_update_shard_s=t_update_shard)
     return {
-        "metric": "gpt2-xl v5e-64 ZeRO-3 north-star projection "
-                  f"(measured 1-chip offload regime: t_micro={t_micro*1e3:.0f}ms "
-                  f"@bs={bs}, t_update={t_update*1e3:.0f}ms/step, "
-                  f"compute-only MFU={compute_mfu:.3f}; 64-chip compute regime "
-                  f"grad-only @bs={bs64}: t_micro={t_micro64*1e3:.0f}ms, "
-                  f"MFU={compute_mfu64:.3f}; "
-                  f"projected MFU@64 no/mid/full overlap="
+        "metric": f"gpt2-xl v5e-{n_chips} ZeRO-3 north-star projection "
+                  f"(measured compute regime @bs={bs64} heads="
+                  f"{cfg64.n_head}x{cfg64.n_embd // cfg64.n_head}: "
+                  f"t_micro={t_micro64*1e3:.0f}ms MFU={compute_mfu64:.3f}; "
+                  f"measured 1/{n_chips}-shard Adam update="
+                  f"{t_update_shard*1e3:.1f}ms/step; gas={gas}; "
+                  f"projected MFU no/mid/full overlap="
                   f"{proj['projected_mfu_no_overlap']}/"
                   f"{proj['projected_mfu_mid_overlap']}/"
                   f"{proj['projected_mfu_full_overlap']}; "
@@ -483,7 +608,37 @@ def _fail_line(name, e, unit="MFU"):
             "value": 0.0, "unit": unit, "vs_baseline": 0.0}
 
 
-def _subproc_line(env_overrides, name, unit="MFU", timeout_s=1500):
+# Per-line regression ledger (VERDICT r4 #10): the measured sweet-spot values
+# this ladder is expected to reproduce (same source as the README perf
+# table). A line under 85% of its entry carries "regression": true in the
+# emitted JSON; under 70% it is re-measured once first (r4's llama line
+# measured 0.136 vs 0.341 under the driver — an environmental collapse a
+# single re-run catches).
+EXPECTED = {
+    "gpt2-760m": 0.536,
+    "gpt2-xl": 0.25,              # 5x320 TPU-native layout (25x64: 0.247)
+    "gpt2-1.3b": 0.383,
+    "llama3.2-1b": 0.341,
+    "bert-large": 0.567,
+    "bert-large seq128 record config": 0.614,
+    "gpt2-moe-125m": 0.398,
+    "serving decode": 6300.0,
+    "rlhf actor": 6800.0,
+    "northstar projection": 0.49,
+}
+
+# Wall-clock estimates per ladder line (measured r5, includes subprocess
+# start + compile), used to decide whether a line still fits the deadline.
+ESTIMATE_S = {
+    "gpt2-xl": 220, "gpt2-1.3b": 200, "llama3.2-1b": 220,
+    "bert-large": 340, "bert-large seq128 record config": 240,
+    "gpt2-moe-125m": 90, "serving decode": 100, "rlhf actor": 110,
+    "northstar projection": 160,
+}
+
+
+def _subproc_line(env_overrides, name, unit="MFU", timeout_s=1500,
+                  time_left=None):
     """Run one ladder entry in a SUBPROCESS and parse its JSON line.
 
     A TPU worker crash (observed on the offload-backed big models) kills
@@ -515,11 +670,21 @@ def _subproc_line(env_overrides, name, unit="MFU", timeout_s=1500):
     env = dict(os.environ, BENCH_SUITE="0", **env_overrides)
     last = None
     for attempt in range(2):   # worker crashes are intermittent: retry once
+        # every attempt is bounded by BOTH the per-line budget and the
+        # ladder's remaining deadline — without the second bound, a hung
+        # child + retry spends ~2x the budget and reproduces the r4 rc=124
+        att_timeout = timeout_s
+        if time_left is not None:
+            att_timeout = min(att_timeout, time_left() - 10)
+            if att_timeout < 45:
+                return last or _fail_line(
+                    name, TimeoutError("deadline exhausted before attempt"),
+                    unit)
         t0 = time.time()
         try:
             out = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                  env=env, capture_output=True, text=True,
-                                 timeout=timeout_s)
+                                 timeout=att_timeout)
             return parse(out.stdout, out.stderr)
         except subprocess.TimeoutExpired as e:
             # a child can finish the measurement and then hang in TPU
@@ -541,6 +706,7 @@ def _subproc_line(env_overrides, name, unit="MFU", timeout_s=1500):
 
 
 def main():
+    t_start = time.time()
     n_dev = len(jax.devices())
     on_tpu = jax.default_backend() == "tpu"
 
@@ -549,6 +715,9 @@ def main():
         return
     if os.environ.get("BENCH_SERVE") == "1":
         print(json.dumps(serving_line(on_tpu, n_dev)), flush=True)
+        return
+    if os.environ.get("BENCH_RLHF") == "1":
+        print(json.dumps(rlhf_line(on_tpu, n_dev)), flush=True)
         return
 
     def bench_line(name):
@@ -562,40 +731,112 @@ def main():
     if model_name is None:
         model_name = "gpt2-760m" if on_tpu else "gpt2-tiny"
         # BASELINE ladder: headline FIRST (so a driver timeout mid-ladder
-        # still leaves its line as the most recent JSON), then the 1.5B
-        # north star + 1.3B (offload-backed) + MoE + BERT (the reference's
-        # own record family) + llama3.2-1b (GQA/128k-vocab), each in an
-        # isolated subprocess, then the SAME headline line REPEATED last
-        # for the tail-line parse.
+        # still leaves its line as the most recent JSON), then the offload
+        # family (the r4 reproducibility focus: 1.5B north star, 1.3B,
+        # llama3.2-1b GQA/128k-vocab), BERT (the reference's record family,
+        # seq512 + its published seq128 record config), MoE, serving decode,
+        # the v5e-64 projection — each in an isolated subprocess — then the
+        # SAME headline line REPEATED last for the tail-line parse.
+        #
+        # The whole ladder runs under a wall-clock deadline
+        # (BENCH_DEADLINE_S, default 1620s): r4's ladder outran the driver's
+        # budget (BENCH_r04 rc=124) and the parsed metric was whatever line
+        # happened to be last. Lines that no longer fit are SKIPPED (explicit
+        # skip line), the headline always prints last, and SIGTERM/SIGINT
+        # re-print it before exit so even a hard timeout leaves the right
+        # tail line.
+        deadline = float(os.environ.get("BENCH_DEADLINE_S", 1620))
+        reserve = 25.0
+
+        def remaining():
+            return deadline - (time.time() - t_start)
+
         suite = (
             ("gpt2-xl", {"BENCH_MODEL": "gpt2-xl"}),
             ("gpt2-1.3b", {"BENCH_MODEL": "gpt2-1.3b"}),
-            ("gpt2-moe-125m", {"BENCH_MODEL": "gpt2-moe-125m"}),
+            ("llama3.2-1b", {"BENCH_MODEL": "llama3.2-1b"}),
             ("bert-large", {"BENCH_MODEL": "bert-large"}),
             # the reference's own record config (64 TFLOPS/V100 ~ 51% of
             # peak at seq=128, docs/_posts/2020-05-28): measured 0.61 here
             ("bert-large seq128 record config",
              {"BENCH_MODEL": "bert-large", "BENCH_SEQ": "128",
               "BENCH_GAS": "8"}),
-            ("llama3.2-1b", {"BENCH_MODEL": "llama3.2-1b"}),
+            ("gpt2-moe-125m", {"BENCH_MODEL": "gpt2-moe-125m"}),
         ) if on_tpu and os.environ.get("BENCH_SUITE", "1") != "0" else ()
         headline, ok = bench_line(model_name)
+        # the headline is under the same regression guard as the suite lines
+        # (it IS the line the driver records — an environmental collapse here
+        # is the worst place to go undetected)
+        h_exp = EXPECTED.get(model_name)
+        h_val = headline.get("value") or 0.0
+        if suite and h_exp and h_val < 0.70 * h_exp \
+                and deadline - (time.time() - t_start) > 1200:
+            retry, rok = bench_line(model_name)
+            if (retry.get("value") or 0.0) > h_val:
+                headline, ok, h_val = retry, rok, retry.get("value") or 0.0
+        if h_exp and h_val < 0.85 * h_exp:
+            headline["regression"] = True
+            headline["expected"] = h_exp
         print(json.dumps(headline), flush=True)
+
+        if suite:
+            import signal
+
+            def _tail_headline(signum, frame):
+                print(json.dumps(headline), flush=True)
+                sys.exit(0)
+
+            signal.signal(signal.SIGTERM, _tail_headline)
+            signal.signal(signal.SIGINT, _tail_headline)
+
+        def guarded(label, env, unit="MFU"):
+            """One ladder line under the deadline + regression guard."""
+            est = ESTIMATE_S.get(label, 240)
+            budget = remaining() - reserve
+            if budget < min(0.7 * est, 150):
+                return {"metric": f"{label} SKIPPED (deadline "
+                                  f"{deadline:.0f}s, {budget:.0f}s left)",
+                        "value": 0.0, "unit": unit, "vs_baseline": 0.0,
+                        "skipped": True}
+            time_left = lambda: remaining() - reserve
+            line = _subproc_line(env, label, unit,
+                                 timeout_s=min(900, budget),
+                                 time_left=time_left)
+            exp = EXPECTED.get(label)
+            val = line.get("value") or 0.0
+            if exp and val < 0.70 * exp and time_left() > 0.8 * est:
+                # r4's llama collapse (0.136 vs 0.341) was environmental —
+                # one fresh subprocess usually recovers the real number
+                retry = _subproc_line(env, label, unit,
+                                      timeout_s=min(900, time_left()),
+                                      time_left=time_left)
+                if (retry.get("value") or 0.0) > val:
+                    line = retry
+                    val = retry.get("value") or 0.0
+            if exp and val < 0.85 * exp:
+                line["regression"] = True
+                line["expected"] = exp
+            return line
+
         for label, env in suite:
-            print(json.dumps(_subproc_line(env, label)), flush=True)
+            print(json.dumps(guarded(label, env)), flush=True)
         if suite and os.environ.get("BENCH_SERVE_LINE", "1") != "0":
             # serving evidence: batched decode tok/s + MBU on the headline
             # model (prefill solved out) — the inference-engine counterpart
             # of the training MFU lines
-            print(json.dumps(_subproc_line(
-                {"BENCH_SERVE": "1"}, "serving decode",
-                unit="decode-tok/s/chip")), flush=True)
+            print(json.dumps(guarded("serving decode", {"BENCH_SERVE": "1"},
+                                     unit="decode-tok/s/chip")), flush=True)
+        if suite and os.environ.get("BENCH_RLHF_LINE", "1") != "0":
+            # RLHF actor evidence (VERDICT r4 #4): the reference's flagship
+            # DeepSpeed-Chat workload had zero perf lines until r5
+            print(json.dumps(guarded("rlhf actor", {"BENCH_RLHF": "1"},
+                                     unit="rlhf-tok/s/chip")), flush=True)
         if suite and os.environ.get("BENCH_SCALING", "1") != "0":
             # scaling evidence for the v5e-64 north star (VERDICT r3 #10):
-            # measured single-chip breakdown + first-order ICI projection
-            print(json.dumps(_subproc_line(
-                {"BENCH_NORTHSTAR": "1"}, "northstar projection",
-                unit="projected-MFU", timeout_s=2400)), flush=True)
+            # measured compute + sharded-update + ICI projection
+            print(json.dumps(guarded("northstar projection",
+                                     {"BENCH_NORTHSTAR": "1"},
+                                     unit="projected-MFU")), flush=True)
         if suite:
             print(json.dumps(headline), flush=True)
         if not ok:   # extras recorded, but a dead headline is a dead bench

@@ -142,6 +142,36 @@ def init_inference(model=None, config=None, **kwargs):
     elif kwargs:
         # merge stray kwargs into an already-built config (reference behavior)
         config = DeepSpeedInferenceConfig(**{**config.model_dump(), **kwargs})
+
+    # Megatron DIRECT serving (reference module_inject/containers/
+    # megatron_gpt.py:1 + inference checkpoint loading): a ds_inference
+    # config pointing `checkpoint` at a Megatron-DeepSpeed GPT checkpoint
+    # with checkpoint_config {"type": "Megatron", "n_head": N} serves it
+    # without a manual migration step — the 2D (tp x pp) grid is merged and
+    # converted in-process (checkpoint/megatron_checkpoint.py), then
+    # resharded to the serving mesh like any param tree.
+    ckpt_type = str((config.checkpoint_config or {}).get("type", "")).lower()
+    if config.checkpoint and ckpt_type == "megatron" \
+            and "params" not in engine_kwargs:
+        from deepspeed_tpu.checkpoint import load_megatron_gpt
+        from deepspeed_tpu.models.gpt2 import GPT2Model
+
+        cc = config.checkpoint_config
+        n_head = cc.get("n_head") or cc.get("num_attention_heads")
+        if not n_head:
+            raise ValueError(
+                'checkpoint_config {"type": "Megatron"} needs "n_head" (or '
+                '"num_attention_heads") — Megatron layer files do not carry '
+                "model args")
+        mcfg, mparams = load_megatron_gpt(
+            config.checkpoint, n_head=int(n_head),
+            tp_degree=cc.get("tp_degree"))
+        if model is None:
+            model = GPT2Model(mcfg)
+        engine_kwargs["params"] = mparams
+        # the params are now in-memory: the engine must not also try an
+        # orbax restore from the (torch-format) checkpoint dir
+        config = config.model_copy(update={"checkpoint": None})
     return InferenceEngine(model, config, **engine_kwargs)
 
 

@@ -167,7 +167,11 @@ PRESETS = {
     # width, so QK^T/PV tiles carry no K-dim padding (16 heads -> head_dim 96
     # pads every MXU pass 96->128; measured 0.512 -> 0.533-0.536 MFU on v5e).
     # Param count and flops_per_token are head-count invariant.
-    "gpt2-760m": GPT2Config(n_embd=1536, n_layer=24, n_head=12),
+    # canonical 16-head layout (param shapes are head-count invariant, but the
+    # grouping is architecture: checkpoints must keep their meaning). The TPU
+    # bench/tuner relayout to 12x128 heads via registry.tpu_native_layout —
+    # never by editing this preset.
+    "gpt2-760m": GPT2Config(n_embd=1536, n_layer=24, n_head=16),
     "gpt2-1.3b": GPT2Config(n_embd=2048, n_layer=24, n_head=16, n_positions=2048),
     "gpt2-xl": GPT2Config(n_embd=1600, n_layer=48, n_head=25, n_positions=1024),
     "gpt2-2.7b": GPT2Config(n_embd=2560, n_layer=32, n_head=32, n_positions=2048),

@@ -52,3 +52,35 @@ def mxu_aligned(config):
     if config.n_embd % 128 == 0 and config.n_head != config.n_embd // 128:
         return dataclasses.replace(config, n_head=config.n_embd // 128)
     return config
+
+
+# Measured TPU head layouts for presets whose n_embd is NOT a multiple of 128
+# (mxu_aligned can't derive them). gpt2-xl (1600): the 25x64 paper layout
+# wastes half of every MXU pass on the 64-wide attention contractions; the
+# v5e-measured grad-only ladder is 25x64 0.429 < 20x80 0.454 < 10x160 0.468 <
+# 8x200 0.493 < 5x320 0.500 MFU (4x400 exceeds the flash kernel's vmem
+# stack). Param/flop-invariant, but a DIFFERENT architecture — every consumer
+# must log the relayout (see tpu_native_layout).
+TPU_HEAD_OVERRIDES = {"gpt2-xl": 5}
+
+
+def tpu_native_layout(config, model_name: str = "", log=None):
+    """The layout bench.py and bin/ds_tune measure on TPU: ``mxu_aligned``
+    when n_embd allows head_dim=128, else the measured per-preset override.
+    ``log``: callable fed a one-line notice whenever the head count actually
+    changes — the knob that keeps reported configs reproducible (a result
+    measured on a relayout must SAY so)."""
+    import dataclasses
+
+    out = mxu_aligned(config)
+    heads = TPU_HEAD_OVERRIDES.get(model_name)
+    if out is config and heads and config.n_head != heads \
+            and config.n_embd % heads == 0:
+        out = dataclasses.replace(config, n_head=heads)
+    if log is not None and out is not config:
+        log(f"TPU-native head relayout: {model_name or 'model'} "
+            f"n_head {config.n_head} -> {out.n_head} (head_dim "
+            f"{config.n_embd // config.n_head} -> {out.n_embd // out.n_head}; "
+            f"param/flop-invariant, architecture differs — reproduce with "
+            f"n_head={out.n_head})")
+    return out

@@ -32,9 +32,20 @@ V5E_ICI_BYTES_PER_S = 9e10
 
 
 def solve_breakdown(t_a: float, g_a: int, t_b: float, g_b: int) -> Dict[str, float]:
-    """Solve t(g) = g*t_micro + t_update from two measured step times."""
-    t_micro = max(0.0, (t_b - t_a) / (g_b - g_a))
-    t_update = max(0.0, t_a - g_a * t_micro)
+    """Solve t(g) = g*t_micro + t_update from two measured step times.
+
+    Raises on non-physical solutions (t_micro <= 0 or t_update < -5% of t_a)
+    instead of clamping: a gas=16 point that measures faster per micro than
+    gas=4 means the measurement was disturbed, and a clamped-to-zero t_update
+    would feed a silently rosy breakdown downstream (VERDICT r4 weak #6)."""
+    t_micro = (t_b - t_a) / (g_b - g_a)
+    t_update = t_a - g_a * t_micro
+    if t_micro <= 0.0 or t_update < -0.05 * t_a:
+        raise ValueError(
+            f"non-physical breakdown: t({g_a})={t_a:.4f}s t({g_b})={t_b:.4f}s "
+            f"-> t_micro={t_micro:.4f}s t_update={t_update:.4f}s "
+            "(measurement disturbed — retry)")
+    t_update = max(0.0, t_update)   # small negative = noise, now bounded
     return {"t_micro_s": t_micro, "t_update_s": t_update,
             "update_fraction": t_update / max(t_a, 1e-12)}
 
@@ -46,35 +57,49 @@ def project_northstar(n_params: int,
                       peak_flops: float,
                       n_chips: int = 64,
                       ici_bytes_per_s: float = V5E_ICI_BYTES_PER_S,
-                      overlap_mid: float = 0.7) -> Dict:
+                      overlap_mid: float = 0.7,
+                      t_update_shard_s: float = 0.0) -> Dict:
     """First-order MFU projection for ZeRO-3 dp=n_chips.
 
     ``measured_mfu_1chip`` should be the single-chip MFU of the SAME model
     without offload (the 64-chip shape shards the fp32 state 64-way, so the
     offload ladder's host streaming disappears — each chip holds ~12n/64
-    bytes of optimizer state, comfortably in HBM).
+    bytes of optimizer state, comfortably in HBM). It must be a MEASURED
+    value — no caps or floors are applied here; out-of-range inputs raise.
+
+    ``t_update_shard_s``: MEASURED per-step optimizer-update time on this
+    chip's 1/n_chips state shard (the ZeRO-1/3 sharded Adam pass). Serial
+    with compute — the update cannot start before the last grad arrives —
+    so it is added to the step denominator regardless of comm overlap
+    (VERDICT r4 weak #3: the grad-only proxy silently excluded it).
     """
+    if not (0.0 < measured_mfu_1chip < 1.0):
+        raise ValueError(f"measured_mfu_1chip={measured_mfu_1chip} out of "
+                         "(0, 1) — measurement disturbed; re-measure instead "
+                         "of clamping")
     compute_s = (tokens_per_chip_step * flops_per_token
-                 / (peak_flops * max(measured_mfu_1chip, 1e-9)))
+                 / (peak_flops * measured_mfu_1chip))
     frac = (n_chips - 1) / n_chips
     comm_bytes = 6 * n_params * frac          # 2 AG + 1 RS of bf16 params/grads
     comm_s = comm_bytes / ici_bytes_per_s
 
     def mfu(overlap):
         exposed = (1.0 - overlap) * comm_s
-        return measured_mfu_1chip * compute_s / (compute_s + exposed)
+        return (measured_mfu_1chip * compute_s
+                / (compute_s + exposed + t_update_shard_s))
 
     return {
         "n_chips": n_chips,
         "assumed_ici_bytes_per_s": ici_bytes_per_s,
         "per_chip_step_compute_s": round(compute_s, 4),
         "per_chip_step_comm_s": round(comm_s, 4),
+        "per_chip_step_update_s": round(t_update_shard_s, 4),
         "comm_bytes_per_chip_step": int(comm_bytes),
         "projected_mfu_no_overlap": round(mfu(0.0), 4),
         "projected_mfu_mid_overlap": round(mfu(overlap_mid), 4),
         "projected_mfu_full_overlap": round(mfu(1.0), 4),
         "assumptions": "ZeRO-3 dp sharding; 2 param all-gathers + 1 grad "
-                       "reduce-scatter per step (bf16); fp32 state "
-                       "dp-sharded in HBM (no host offload at 64 chips); "
-                       f"overlap_mid={overlap_mid}",
+                       "reduce-scatter per step (bf16); fp32 state + sharded "
+                       "Adam update dp-sharded in HBM (no host offload at 64 "
+                       f"chips); overlap_mid={overlap_mid}",
     }

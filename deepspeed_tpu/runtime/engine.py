@@ -1186,6 +1186,23 @@ class DeepSpeedEngine:
         batch = self._shard_batch(batch)
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
+        trace_dir = os.environ.get("DS_TPU_TRACE_DIR")
+        if trace_dir and getattr(self, "_host_step", 0) == 2:
+            # offload-path diagnosis knob (r4: llama collapsed to 40% of its
+            # recorded MFU under the driver with no way to see WHERE the step
+            # went): capture one post-warmup step as an XLA profiler trace —
+            # the streamed pull/update/write-back DMAs are in-trace ops, so
+            # host wall-clocks cannot attribute them; the trace can
+            import jax.profiler as _prof
+
+            with _prof.trace(trace_dir):
+                loss = self._train_batch_inner(batch, gas)
+            log_dist(f"profiler trace for step 3 written to {trace_dir}",
+                     ranks=[0])
+            return loss
+        return self._train_batch_inner(batch, gas)
+
+    def _train_batch_inner(self, batch, gas):
         if self._nvme_optimizer is not None:
             metrics = self._train_batch_nvme(batch, gas)
         elif self._onebit:

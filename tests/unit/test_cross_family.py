@@ -202,8 +202,22 @@ def test_mxu_aligned_is_param_and_flop_invariant():
 
     xl = GPT2_PRESETS["gpt2-xl"]          # 1600 % 128 != 0: untouched
     assert mxu_aligned(xl) is xl
-    m760 = GPT2_PRESETS["gpt2-760m"]      # already 12 x 128: untouched
-    assert mxu_aligned(m760) is m760
+    m760 = GPT2_PRESETS["gpt2-760m"]      # canonical 16 heads -> 12 x 128
+    a760 = mxu_aligned(m760)
+    assert a760.n_head == 12 and a760.num_params() == m760.num_params()
+
+    # per-preset override where head_dim=128 is unreachable (gpt2-xl 1600):
+    # measured 5 x 320 (see registry.TPU_HEAD_OVERRIDES); logged via callback
+    from deepspeed_tpu.models.registry import tpu_native_layout
+
+    notes = []
+    nxl = tpu_native_layout(xl, "gpt2-xl", log=notes.append)
+    assert nxl.n_head == 5 and nxl.num_params() == xl.num_params()
+    assert nxl.flops_per_token(1024) == xl.flops_per_token(1024)
+    assert notes and "n_head 25 -> 5" in notes[0]
+    # unknown preset name: falls back to mxu_aligned only, no log
+    assert tpu_native_layout(xl, "not-a-preset", log=notes.append) is xl
+    assert len(notes) == 1
 
 
 def test_llama32_1b_preset_matches_hf_shape():

@@ -151,11 +151,9 @@ def init_inference(model=None, config=None, **kwargs):
     # converted in-process (checkpoint/megatron_checkpoint.py), then
     # resharded to the serving mesh like any param tree.
     ckpt_type = str((config.checkpoint_config or {}).get("type", "")).lower()
-    if config.checkpoint and ckpt_type == "megatron" \
+    ckpt_type = ckpt_type.replace("-", "").replace("_", "")
+    if config.checkpoint and ckpt_type in ("megatron", "megatronmoe") \
             and "params" not in engine_kwargs:
-        from deepspeed_tpu.checkpoint import load_megatron_gpt
-        from deepspeed_tpu.models.gpt2 import GPT2Model
-
         cc = config.checkpoint_config
         n_head = cc.get("n_head") or cc.get("num_attention_heads")
         if not n_head:
@@ -163,11 +161,30 @@ def init_inference(model=None, config=None, **kwargs):
                 'checkpoint_config {"type": "Megatron"} needs "n_head" (or '
                 '"num_attention_heads") — Megatron layer files do not carry '
                 "model args")
-        mcfg, mparams = load_megatron_gpt(
-            config.checkpoint, n_head=int(n_head),
-            tp_degree=cc.get("tp_degree"))
-        if model is None:
-            model = GPT2Model(mcfg)
+        if ckpt_type == "megatronmoe":
+            # Megatron-MoE direct serve (reference containers/
+            # megatron_gpt_moe.py:1): merge trunk + expert files, serve as
+            # MoEGPT2 with the expert bank sharded over the mesh's expert
+            # axis (config.moe.ep_size)
+            from deepspeed_tpu.checkpoint import load_megatron_moe
+            from deepspeed_tpu.models.gpt2_moe import MoEGPT2
+
+            mcfg, mparams, n_experts = load_megatron_moe(
+                config.checkpoint, n_head=int(n_head),
+                tp_degree=cc.get("tp_degree"))
+            if model is None:
+                ep = max(1, int(getattr(config.moe, "ep_size", 1)))
+                model = MoEGPT2(mcfg, num_experts=n_experts, ep_size=ep,
+                                drop_tokens=False)
+        else:
+            from deepspeed_tpu.checkpoint import load_megatron_gpt
+            from deepspeed_tpu.models.gpt2 import GPT2Model
+
+            mcfg, mparams = load_megatron_gpt(
+                config.checkpoint, n_head=int(n_head),
+                tp_degree=cc.get("tp_degree"))
+            if model is None:
+                model = GPT2Model(mcfg)
         engine_kwargs["params"] = mparams
         # the params are now in-memory: the engine must not also try an
         # orbax restore from the (torch-format) checkpoint dir

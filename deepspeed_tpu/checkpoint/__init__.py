@@ -1,7 +1,8 @@
 from deepspeed_tpu.checkpoint.meg_2d import (meg_2d_parallel_map,
                                              reshape_meg_2d_parallel)
 from deepspeed_tpu.checkpoint.megatron_checkpoint import (DeepSpeedCheckpoint,
-                                                          load_megatron_gpt)
+                                                          load_megatron_gpt,
+                                                          load_megatron_moe)
 
 __all__ = ["meg_2d_parallel_map", "reshape_meg_2d_parallel",
-           "DeepSpeedCheckpoint", "load_megatron_gpt"]
+           "DeepSpeedCheckpoint", "load_megatron_gpt", "load_megatron_moe"]

@@ -31,6 +31,10 @@ SEQUENTIAL_LAYERS = [
     # Megatron's position embedding is a plain nn.Embedding, replicated
     # across tp (only the WORD embedding is vocab-parallel)
     "position_embeddings.weight",
+    # the MoE router is replicated across tp (DeepSpeed-MoE TopKGate is a
+    # plain Linear outside the tp-partitioned regions) — the default dim-0
+    # concat would hand a (tp*E, D) gate to an E-expert model
+    "deepspeed_moe.gate.wg.weight",
 ]
 # bare final-norm file keys: replicated, but matched by EQUALITY only — a
 # suffix match on "weight" would classify every weight as replicated

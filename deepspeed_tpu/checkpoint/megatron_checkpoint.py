@@ -126,6 +126,84 @@ def _qkv_bias_meg_to_ours(b: np.ndarray, n_head: int) -> np.ndarray:
         b.reshape(n_head, 3, dh).transpose(1, 0, 2).reshape(-1))
 
 
+def _g(sd, suffix, default_shape=None):
+    """Suffix lookup in a megatron state dict; ``default_shape`` → zeros
+    when the key is absent (MoE layers carry no dense-MLP keys but the
+    scanned trunk still needs a — never used — leaf of the right shape)."""
+    for k in sd:
+        if k == suffix or k.endswith(suffix):
+            return sd[k]
+    if default_shape is not None:
+        return np.zeros(default_shape, np.float32)
+    raise KeyError(f"{suffix} not found (keys: {sorted(sd)[:6]}...)")
+
+
+def _gpt_trunk(ck: DeepSpeedCheckpoint, n_head: int, dtype,
+               mlp_optional: bool = False):
+    """Shared Megatron→GPT2 trunk conversion for the dense and MoE loaders:
+    → (GPT2Config, params, layers). ``mlp_optional`` zero-fills the dense
+    MLP leaves of layers that have none (MoE layers)."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    emb = ck.get_embedding_state()
+    wte = emb[next(k for k in emb if "word_embeddings" in k)]
+    pos_keys = [k for k in emb if "position_embeddings" in k]
+    wpe = emb[pos_keys[0]] if pos_keys else None
+    layers = [ck.get_transformer_state(i) for i in range(ck.num_layers())]
+    fin = ck.get_final_norm_state()
+
+    d = wte.shape[1]
+    qkv0 = _g(layers[0], "self_attention.query_key_value.weight")
+    # layer files carry no model args — the caller passes n_head (as the
+    # reference's conversion scripts take it from megatron args)
+    if d % n_head:
+        raise ValueError(f"n_head {n_head} does not divide hidden {d}")
+    if (3 * d) != qkv0.shape[0]:
+        raise ValueError(f"qkv rows {qkv0.shape[0]} != 3*hidden {3 * d}")
+    hid = next((_g(sd, "mlp.dense_h_to_4h.weight").shape[0] for sd in layers
+                if any("dense_h_to_4h.weight" in k for k in sd)), 4 * d)
+    fc_dflt = ((hid, d), (hid,), (d, hid), (d,)) if mlp_optional \
+        else (None, None, None, None)
+
+    stack = lambda fn: np.stack([fn(sd) for sd in layers])
+    A = lambda x: np.asarray(x, dtype=dtype)
+    params = {
+        "wte": A(wte),
+        "blocks": {
+            "ln1_g": A(stack(lambda s: _g(s, "input_layernorm.weight"))),
+            "ln1_b": A(stack(lambda s: _g(s, "input_layernorm.bias"))),
+            "qkv_w": A(stack(lambda s: _qkv_meg_to_ours(
+                _g(s, "self_attention.query_key_value.weight"), n_head))),
+            "qkv_b": A(stack(lambda s: _qkv_bias_meg_to_ours(
+                _g(s, "self_attention.query_key_value.bias"), n_head))),
+            "proj_w": A(stack(lambda s: _g(s, "self_attention.dense.weight").T)),
+            "proj_b": A(stack(lambda s: _g(s, "self_attention.dense.bias"))),
+            "ln2_g": A(stack(lambda s: _g(s, "post_attention_layernorm.weight"))),
+            "ln2_b": A(stack(lambda s: _g(s, "post_attention_layernorm.bias"))),
+            "fc_w": A(stack(lambda s: _g(
+                s, "mlp.dense_h_to_4h.weight", fc_dflt[0]).T)),
+            "fc_b": A(stack(lambda s: _g(
+                s, "mlp.dense_h_to_4h.bias", fc_dflt[1]))),
+            "fc2_w": A(stack(lambda s: _g(
+                s, "mlp.dense_4h_to_h.weight", fc_dflt[2]).T)),
+            "fc2_b": A(stack(lambda s: _g(
+                s, "mlp.dense_4h_to_h.bias", fc_dflt[3]))),
+        },
+        "lnf_g": A(_g(fin, "weight") if "weight" in fin
+                   else _g(fin, "final_layernorm.weight")),
+        "lnf_b": A(_g(fin, "bias") if "bias" in fin
+                   else _g(fin, "final_layernorm.bias")),
+    }
+    if wpe is not None:
+        params["wpe"] = A(wpe)
+    config = GPT2Config(
+        vocab_size=int(wte.shape[0]),
+        n_positions=int(wpe.shape[0]) if wpe is not None else 2048,
+        n_embd=int(d), n_layer=len(layers), n_head=int(n_head),
+        tie_embeddings=True)
+    return config, params, layers
+
+
 def load_megatron_gpt(ckpt_dir: str, n_head: int, dtype=np.float32,
                       tp_degree: Optional[int] = None) -> Tuple[Any, Dict]:
     """Megatron-DeepSpeed GPT checkpoint → (GPT2Config, stacked param tree).
@@ -135,61 +213,112 @@ def load_megatron_gpt(ckpt_dir: str, n_head: int, dtype=np.float32,
     Megatron naming/layout to the in-tree GPT2Model tree — after which the
     orbax engine reshards to ANY serving/training topology.
     """
-    from deepspeed_tpu.models.gpt2 import GPT2Config
-
     ck = DeepSpeedCheckpoint(ckpt_dir, tp_degree=tp_degree)
-    emb = ck.get_embedding_state()
-    wte = emb[next(k for k in emb if "word_embeddings" in k)]
-    pos_keys = [k for k in emb if "position_embeddings" in k]
-    wpe = emb[pos_keys[0]] if pos_keys else None
-    layers = [ck.get_transformer_state(i) for i in range(ck.num_layers())]
-    fin = ck.get_final_norm_state()
-
-    def g(sd, suffix):
-        return sd[next(k for k in sd if k == suffix or k.endswith(suffix))]
-
-    d = wte.shape[1]
-    qkv0 = g(layers[0], "self_attention.query_key_value.weight")
-    # layer files carry no model args — the caller passes n_head (as the
-    # reference's conversion scripts take it from megatron args)
-    if d % n_head:
-        raise ValueError(f"n_head {n_head} does not divide hidden {d}")
-    if (3 * d) != qkv0.shape[0]:
-        raise ValueError(f"qkv rows {qkv0.shape[0]} != 3*hidden {3 * d}")
-
-    stack = lambda fn: np.stack([fn(sd) for sd in layers])
-    A = lambda x: np.asarray(x, dtype=dtype)
-    params = {
-        "wte": A(wte),
-        "blocks": {
-            "ln1_g": A(stack(lambda s: g(s, "input_layernorm.weight"))),
-            "ln1_b": A(stack(lambda s: g(s, "input_layernorm.bias"))),
-            "qkv_w": A(stack(lambda s: _qkv_meg_to_ours(
-                g(s, "self_attention.query_key_value.weight"), n_head))),
-            "qkv_b": A(stack(lambda s: _qkv_bias_meg_to_ours(
-                g(s, "self_attention.query_key_value.bias"), n_head))),
-            "proj_w": A(stack(lambda s: g(s, "self_attention.dense.weight").T)),
-            "proj_b": A(stack(lambda s: g(s, "self_attention.dense.bias"))),
-            "ln2_g": A(stack(lambda s: g(s, "post_attention_layernorm.weight"))),
-            "ln2_b": A(stack(lambda s: g(s, "post_attention_layernorm.bias"))),
-            "fc_w": A(stack(lambda s: g(s, "mlp.dense_h_to_4h.weight").T)),
-            "fc_b": A(stack(lambda s: g(s, "mlp.dense_h_to_4h.bias"))),
-            "fc2_w": A(stack(lambda s: g(s, "mlp.dense_4h_to_h.weight").T)),
-            "fc2_b": A(stack(lambda s: g(s, "mlp.dense_4h_to_h.bias"))),
-        },
-        "lnf_g": A(g(fin, "weight") if "weight" in fin
-                   else g(fin, "final_layernorm.weight")),
-        "lnf_b": A(g(fin, "bias") if "bias" in fin
-                   else g(fin, "final_layernorm.bias")),
-    }
-    if wpe is not None:
-        params["wpe"] = A(wpe)
-    config = GPT2Config(
-        vocab_size=int(wte.shape[0]),
-        n_positions=int(wpe.shape[0]) if wpe is not None else 2048,
-        n_embd=int(d), n_layer=len(layers), n_head=int(n_head),
-        tie_embeddings=True)
-    logger.info(f"load_megatron_gpt: {len(layers)} layers, d={d}, "
-                f"vocab={wte.shape[0]}, heads={n_head} (from tp="
+    config, params, layers = _gpt_trunk(ck, n_head, dtype)
+    logger.info(f"load_megatron_gpt: {len(layers)} layers, d={config.n_embd}, "
+                f"vocab={config.vocab_size}, heads={n_head} (from tp="
                 f"{ck.tp_degree} files)")
     return config, params
+
+
+_EXPERT_RE = re.compile(r"layer_(\d+)_expert_(\d+)_mp_rank_(\d+)_model_states\.pt$")
+
+
+def load_megatron_moe(ckpt_dir: str, n_head: int, dtype=np.float32,
+                      tp_degree: Optional[int] = None
+                      ) -> Tuple[Any, Dict, int]:
+    """Megatron-DeepSpeed **MoE** GPT checkpoint → (GPT2Config, MoEGPT2 param
+    tree, num_experts) — the direct-serve path for the reference's
+    Megatron-MoE inference container (module_inject/containers/
+    megatron_gpt_moe.py:1).
+
+    Layout consumed (the reference's own save convention):
+
+    * dense trunk in ``layer_XX-model_TT-model_states.pt`` files; a layer is
+      recognized as MoE by its ``...deepspeed_moe.gate.wg.weight`` key (the
+      gate lives in the layer file; the dense MLP keys are absent there);
+    * experts in ``layer_{L}_expert_{E}_mp_rank_{MM}_model_states.pt`` files
+      (engine.py:2515 ``_get_expert_ckpt_name``), L = 0-based index among
+      the MoE layers, keys ``...deepspeed_moe.experts.deepspeed_experts.{E}
+      .dense_h_to_4h/dense_4h_to_h.*``; mp shards merge with the standard
+      Megatron MLP partition rules (meg_2d.py).
+
+    The interleave must be the Switch pattern MoEGPT2 implements (MoE MLP on
+    every other block: 1, 3, 5, ...); anything else is refused rather than
+    silently re-indexed.
+    """
+    import torch
+
+    ck = DeepSpeedCheckpoint(ckpt_dir, tp_degree=tp_degree)
+    config, params, layers = _gpt_trunk(ck, n_head, dtype, mlp_optional=True)
+
+    moe_ids = [i for i, sd in enumerate(layers)
+               if any("deepspeed_moe.gate" in k for k in sd)]
+    if moe_ids != list(range(1, len(layers), 2)):
+        raise ValueError(
+            f"MoE layers at {moe_ids} — MoEGPT2 serves the Switch interleave "
+            f"(every other block: {list(range(1, len(layers), 2))}); other "
+            "placements need a model-side layout first")
+
+    # ---- expert files -----------------------------------------------------
+    exp_files: Dict[Tuple[int, int, int], str] = {}
+    for f in os.listdir(ckpt_dir):
+        m = _EXPERT_RE.search(f)
+        if m:
+            exp_files[(int(m.group(1)), int(m.group(2)), int(m.group(3)))] = f
+    if not exp_files:
+        raise FileNotFoundError(
+            f"no layer_L_expert_E_mp_rank_MM_model_states.pt files in "
+            f"{ckpt_dir} (gate keys present → this IS an MoE checkpoint)")
+    n_experts = 1 + max(e for _, e, _ in exp_files)
+    mp_ranks = sorted({mp for _, _, mp in exp_files})
+
+    def load_expert(moe_l: int, e: int) -> Dict[str, np.ndarray]:
+        shards = []
+        for mp in mp_ranks:
+            key = (moe_l, e, mp)
+            if key not in exp_files:
+                raise FileNotFoundError(
+                    f"missing expert file layer_{moe_l}_expert_{e}_mp_rank_"
+                    f"{mp:02d}_model_states.pt")
+            sd = torch.load(os.path.join(ckpt_dir, exp_files[key]),
+                            map_location="cpu", weights_only=True)
+            ren = {}
+            for k, v in sd.items():
+                # canonicalize to the megatron MLP names so the standard
+                # partition-dim merge rules apply (col-parallel h_to_4h on
+                # dim 0, row-parallel 4h_to_h on dim 1)
+                for part in ("dense_h_to_4h", "dense_4h_to_h"):
+                    if f".{part}." in k or k.startswith(f"{part}."):
+                        ren[f"mlp.{part}." + k.rsplit(".", 1)[-1]] = _np(v)
+            shards.append(ren)
+        return merge_tp_shards(shards)
+
+    A = lambda x: np.asarray(x, dtype=dtype)
+    wi, bi, wo, bo, wg = [], [], [], [], []
+    for moe_l, lid in enumerate(moe_ids):
+        ex = [load_expert(moe_l, e) for e in range(n_experts)]
+        wi.append([e["mlp.dense_h_to_4h.weight"].T for e in ex])   # (D, H)
+        bi.append([e["mlp.dense_h_to_4h.bias"] for e in ex])
+        wo.append([e["mlp.dense_4h_to_h.weight"].T for e in ex])   # (H, D)
+        bo.append([e["mlp.dense_4h_to_h.bias"] for e in ex])
+        # torch Linear gate weight is (E, D); ours is (D, E). Replicated
+        # across tp (meg_2d SEQUENTIAL_LAYERS) — verify against the expert
+        # count so a gate/expert-file mismatch fails HERE, not at route time
+        gate = _g(layers[lid], "deepspeed_moe.gate.wg.weight").T
+        if gate.shape[-1] != n_experts:
+            raise ValueError(
+                f"gate at layer {lid} routes {gate.shape[-1]} experts but "
+                f"{n_experts} expert files were found")
+        wg.append(gate)
+
+    params["moe"] = {
+        "gate": {"wg": A(wg)},                      # (n_moe, D, E)
+        "experts": {"wi": A(wi), "bi": A(bi),       # (n_moe, E, D, H)
+                    "wo": A(wo), "bo": A(bo)},
+    }
+    logger.info(f"load_megatron_moe: {len(layers)} layers ({len(moe_ids)} "
+                f"MoE x {n_experts} experts), d={config.n_embd}, "
+                f"vocab={config.vocab_size}, heads={n_head} "
+                f"(tp={ck.tp_degree}, expert mp={mp_ranks})")
+    return config, params, n_experts

@@ -68,6 +68,27 @@ ADVISORY_NOOP_KEYS = {
         "(pipe, data, mics, expert, seq, tensor — parallel/topology.py), "
         "which already places data outermost of expert; rank-list "
         "re-ordering is a process-group concept with no mesh counterpart.",
+    "communication_data_type":
+        "gradient collectives are GSPMD-inserted at the gradient dtype; the "
+        "width grads are accumulated AND communicated in is the "
+        "data_types.grad_accum_dtype knob — set that instead.",
+    "nebula":
+        "the async-tiered checkpoint role is filled unconditionally by the "
+        "orbax AsyncCheckpointer (checkpoint_engine/engine.py — background "
+        "commit with an atomic 'latest' pointer); nebula's persistent-path/"
+        "interval knobs have no meaning for OCDBT snapshots.",
+    "zero_allow_untested_optimizer":
+        "client optimizers are first-class: any optax GradientTransformation "
+        "composes with every ZeRO stage (state sharding is planned from the "
+        "state pytree, not from a known-optimizer table) — there is no "
+        "untested-optimizer gate to bypass.",
+}
+
+# Reference keys REFUSED with a pointer (not silently accepted): accepting
+# them would promise behavior this runtime cannot deliver.
+REJECTED_KEYS = {
+    "amp": "apex automatic mixed precision is CUDA-only; use bf16 "
+           "(recommended on TPU) or fp16 with dynamic loss scaling",
 }
 
 
@@ -336,11 +357,52 @@ class DeepSpeedConfig:
         self.eigenvalue_enabled = self.eigenvalue_config.enabled
         self.pld_config = PLDConfig(**pd.get("progressive_layer_drop", {}))
         self.pld_enabled = self.pld_config.enabled
+        self.dataloader_drop_last = pd.get("dataloader_drop_last", None)
         # advisory no-ops the user actually set (engine logs them at init);
         # presence, not truthiness — an explicit false/0 is still "set"
         self.advisory_keys_set = [k for k in ADVISORY_NOOP_KEYS if k in pd]
+        self._validate_top_level_keys(pd)
 
         self._configure_train_batch_size(world_size)
+
+    # Every top-level key this config consumes (sub-blocks validate their own
+    # interiors with extra='forbid'). The union with ADVISORY_NOOP_KEYS is
+    # the full accepted surface; anything else is rejected — the same
+    # contract the sub-blocks enforce, extended to the top level (previously
+    # a typo'd top-level key like "gradient_cliping" passed silently).
+    KNOWN_TOP_LEVEL_KEYS = frozenset({
+        "fp16", "bf16", "bfloat16", "zero_optimization", "comms_logger",
+        "flops_profiler", "activation_checkpointing", "tensorboard", "wandb",
+        "csv_monitor", "pipeline", "tpu", "checkpoint", "data_types", "aio",
+        "elasticity", "hybrid_engine", "gradient_compression",
+        "compression_training", "sparse_attention", "data_efficiency",
+        "autotuning", "optimizer", "scheduler", "gradient_clipping",
+        "steps_per_print", "wall_clock_breakdown", "memory_breakdown",
+        "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
+        "train_batch_size", "train_micro_batch_size_per_gpu",
+        "train_micro_batch_size_per_chip", "gradient_accumulation_steps",
+        "curriculum_learning", "dataloader_drop_last",
+    })
+
+    def _validate_top_level_keys(self, pd):
+        accepted = self.KNOWN_TOP_LEVEL_KEYS | set(ADVISORY_NOOP_KEYS)
+        for key, why in REJECTED_KEYS.items():
+            if key in pd:
+                raise ValueError(f"ds_config key {key!r} is not supported on "
+                                 f"this runtime: {why}")
+        unknown = sorted(set(pd) - accepted)
+        if unknown:
+            import difflib
+
+            hints = []
+            for k in unknown:
+                close = difflib.get_close_matches(k, accepted, n=1)
+                hints.append(f"{k!r}" + (f" (did you mean {close[0]!r}?)"
+                                         if close else ""))
+            raise ValueError(
+                f"Unknown top-level ds_config key(s): {', '.join(hints)}. "
+                "Accepted keys are documented in docs/CONFIG.md; advisory "
+                "no-ops are listed there with their rationale.")
 
     # --------------------------------------------------------------- batch math
     def _configure_train_batch_size(self, world_size: Optional[int]):

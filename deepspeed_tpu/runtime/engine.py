@@ -436,6 +436,11 @@ class DeepSpeedEngine:
         if name is None:
             raise ValueError("No optimizer in ds_config and none passed to initialize()")
         params = dict(self._config.optimizer_params or {})
+        if self._config.optimizer_legacy_fusion:
+            log_dist("optimizer.legacy_fusion accepted (advisory no-op on "
+                     "TPU): optimizer math is XLA-fused into the train step "
+                     "by construction — there is no unfused fallback to "
+                     "select away from", ranks=[0])
         log_dist(f"Using DeepSpeed optimizer: {name}", ranks=[0])
         return build_optimizer(name, params)
 
@@ -1606,9 +1611,13 @@ class DeepSpeedEngine:
         if (data_sampler is not None and route in (None, "train")
                 and getattr(self, "_data_sampler", None) is None):
             self._data_sampler = data_sampler
+        dl_kwargs = {}
+        if self._config.dataloader_drop_last is not None:
+            # reference "dataloader_drop_last" top-level key (config.py:941)
+            dl_kwargs["drop_last"] = bool(self._config.dataloader_drop_last)
         return DeepSpeedDataLoader(dataset, batch_size=bs,
                                    collate_fn=self.collate_fn,
-                                   data_sampler=data_sampler)
+                                   data_sampler=data_sampler, **dl_kwargs)
 
     # ------------------------------------------------------------ checkpoint
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,

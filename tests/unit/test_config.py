@@ -92,3 +92,19 @@ def test_advisory_noop_keys_accepted_and_tracked():
     # the documented contract: each advisory key has a written rationale
     for key, why in ADVISORY_NOOP_KEYS.items():
         assert len(why) > 40, f"{key} rationale too thin"
+
+
+def test_unknown_top_level_key_rejected_with_hint():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    with pytest.raises(ValueError, match="gradient_clipping"):
+        DeepSpeedConfig({"train_batch_size": 8, "gradient_cliping": 1.0})
+    with pytest.raises(ValueError, match="Unknown top-level"):
+        DeepSpeedConfig({"train_batch_size": 8, "n_head": 5})
+
+
+def test_rejected_keys_refused_with_pointer():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    with pytest.raises(ValueError, match="bf16"):
+        DeepSpeedConfig({"train_batch_size": 8, "amp": {"enabled": True}})

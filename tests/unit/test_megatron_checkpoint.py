@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.checkpoint import (DeepSpeedCheckpoint, load_megatron_gpt,
+                                      load_megatron_moe,
                                       meg_2d_parallel_map,
                                       reshape_meg_2d_parallel)
 from deepspeed_tpu.checkpoint.meg_2d import merge_tp_shards, split_tp_shards
@@ -165,3 +166,162 @@ def test_megatron_direct_serving_requires_n_head(tmp_path):
         deepspeed_tpu.init_inference(config={
             "checkpoint": str(tmp_path),
             "checkpoint_config": {"type": "Megatron"}})
+
+
+def _moe_to_megatron_files(cfg, params, out_dir, n_experts):
+    """Write the reference's MoE checkpoint convention: dense trunk layer
+    files with the gate in the MoE layers (no dense-MLP keys there), plus
+    layer_{L}_expert_{E}_mp_rank_00_model_states.pt expert files
+    (reference engine.py:2515 _get_expert_ckpt_name)."""
+    d, nh = cfg.n_embd, cfg.n_head
+    dh = d // nh
+
+    def qkv_to_meg(w):
+        w = np.asarray(w).T.reshape(3, nh, dh, d)
+        return np.ascontiguousarray(w.transpose(1, 0, 2, 3).reshape(3 * d, d))
+
+    def qkv_b_to_meg(b):
+        return np.ascontiguousarray(
+            np.asarray(b).reshape(3, nh, dh).transpose(1, 0, 2).reshape(-1))
+
+    os.makedirs(out_dir, exist_ok=True)
+    save = lambda path, sd: torch.save(
+        {k: torch.from_numpy(np.ascontiguousarray(np.asarray(v)))
+         for k, v in sd.items()}, os.path.join(out_dir, path))
+
+    save("layer_00-model_00-model_states.pt",
+         {"word_embeddings.weight": params["wte"],
+          "position_embeddings.weight": params["wpe"]})
+    B = params["blocks"]
+    moe_ids = list(range(1, cfg.n_layer, 2))
+    for l in range(cfg.n_layer):
+        sd = {
+            "input_layernorm.weight": B["ln1_g"][l],
+            "input_layernorm.bias": B["ln1_b"][l],
+            "self_attention.query_key_value.weight": qkv_to_meg(B["qkv_w"][l]),
+            "self_attention.query_key_value.bias": qkv_b_to_meg(B["qkv_b"][l]),
+            "self_attention.dense.weight": np.asarray(B["proj_w"][l]).T,
+            "self_attention.dense.bias": B["proj_b"][l],
+            "post_attention_layernorm.weight": B["ln2_g"][l],
+            "post_attention_layernorm.bias": B["ln2_b"][l],
+        }
+        if l in moe_ids:
+            m = moe_ids.index(l)
+            # gate lives in the layer file; torch Linear weight is (E, D)
+            sd["mlp.deepspeed_moe.gate.wg.weight"] = \
+                np.asarray(params["moe"]["gate"]["wg"][m]).T
+        else:
+            sd.update({
+                "mlp.dense_h_to_4h.weight": np.asarray(B["fc_w"][l]).T,
+                "mlp.dense_h_to_4h.bias": B["fc_b"][l],
+                "mlp.dense_4h_to_h.weight": np.asarray(B["fc2_w"][l]).T,
+                "mlp.dense_4h_to_h.bias": B["fc2_b"][l],
+            })
+        save(f"layer_{l + 1:02d}-model_00-model_states.pt", sd)
+    save(f"layer_{cfg.n_layer + 1:02d}-model_00-model_states.pt",
+         {"final_layernorm.weight": params["lnf_g"],
+          "final_layernorm.bias": params["lnf_b"]})
+
+    E = params["moe"]["experts"]
+    pfx = "model.decoder.mlp.deepspeed_moe.experts.deepspeed_experts"
+    for m in range(len(moe_ids)):
+        for e in range(n_experts):
+            save(f"layer_{m}_expert_{e}_mp_rank_00_model_states.pt",
+                 {f"{pfx}.{e}.dense_h_to_4h.weight":
+                      np.asarray(E["wi"][m][e]).T,
+                  f"{pfx}.{e}.dense_h_to_4h.bias": E["bi"][m][e],
+                  f"{pfx}.{e}.dense_4h_to_h.weight":
+                      np.asarray(E["wo"][m][e]).T,
+                  f"{pfx}.{e}.dense_4h_to_h.bias": E["bo"][m][e]})
+
+
+def test_megatron_moe_direct_serving(tmp_path):
+    """Megatron-MoE direct serve (reference containers/megatron_gpt_moe.py:1):
+    init_inference on an MoE checkpoint dir — trunk + gate + expert files —
+    matches serving the original param tree, including over an ep=4 mesh."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2_moe import MoEGPT2
+
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32, n_layer=4,
+                     n_head=4, dtype=jnp.float32, remat=False,
+                     use_flash_attention=False)
+    model = MoEGPT2(cfg, num_experts=8, ep_size=1, drop_tokens=False)
+    params = model.init_params(jax.random.PRNGKey(3))
+    params.pop("moe_residual", None)
+    ckpt = str(tmp_path / "meg_moe")
+    _moe_to_megatron_files(cfg, params, ckpt, n_experts=8)
+
+    cfg2, params2, n_exp = load_megatron_moe(ckpt, n_head=cfg.n_head)
+    assert n_exp == 8 and cfg2.n_layer == 4 and cfg2.n_embd == 32
+    for path in (("moe", "experts", "wi"), ("moe", "gate", "wg"),
+                 ("blocks", "qkv_w")):
+        a, b = params, params2
+        for k in path:
+            a, b = a[k], b[k]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    prompts = np.random.RandomState(1).randint(
+        0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    ref = deepspeed_tpu.init_inference(
+        MoEGPT2(cfg, num_experts=8, ep_size=1, drop_tokens=False),
+        params=params, config={"dtype": "float32", "max_out_tokens": 32})
+    want = np.asarray(ref.generate(prompts, max_new_tokens=8))
+
+    from deepspeed_tpu.comm import comm
+    comm.cdb = None
+    served = deepspeed_tpu.init_inference(config={
+        "checkpoint": ckpt,
+        "checkpoint_config": {"type": "Megatron-MoE", "n_head": cfg.n_head},
+        "dtype": "float32", "max_out_tokens": 32, "moe": {"ep_size": 4}})
+    assert served.ep_world_size == 4
+    wi = served.params["moe"]["experts"]["wi"]
+    assert wi.addressable_shards[0].data.shape[1] == wi.shape[1] // 4
+    got = np.asarray(served.generate(prompts, max_new_tokens=8))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_megatron_moe_tp2_gate_replicated(tmp_path):
+    """tp=2 MoE checkpoint: the router gate is REPLICATED across tp shards
+    (a dim-0 concat would hand a (2E, D) gate to an E-expert model) while
+    expert MLPs merge with the standard col/row partition rules."""
+    cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=16, n_layer=2,
+                     n_head=2, dtype=jnp.float32, remat=False,
+                     use_flash_attention=False)
+    from deepspeed_tpu.models.gpt2_moe import MoEGPT2
+
+    model = MoEGPT2(cfg, num_experts=4, ep_size=1, drop_tokens=False)
+    params = model.init_params(jax.random.PRNGKey(5))
+    d1 = str(tmp_path / "tp1")
+    _moe_to_megatron_files(cfg, params, d1, n_experts=4)
+
+    # rewrite as tp=2: split every dense layer file and expert file
+    d2 = str(tmp_path / "tp2")
+    os.makedirs(d2)
+    for f in sorted(os.listdir(d1)):
+        sd = {k: np.asarray(v) for k, v in torch.load(
+            os.path.join(d1, f), weights_only=True).items()}
+        if "_expert_" in f:
+            # canonical names -> split -> restore prefixes per shard
+            canon = {"mlp." + k.split(".deepspeed_experts.", 1)[1]
+                     .split(".", 1)[1]: v for k, v in sd.items()}
+            prefix = {("mlp." + k.split(".deepspeed_experts.", 1)[1]
+                       .split(".", 1)[1]): k for k in sd}
+            for t, shard in enumerate(split_tp_shards(canon, 2)):
+                out = {prefix[k]: v for k, v in shard.items()}
+                torch.save({k: torch.from_numpy(np.ascontiguousarray(v))
+                            for k, v in out.items()},
+                           os.path.join(d2, f.replace("mp_rank_00",
+                                                      f"mp_rank_{t:02d}")))
+        else:
+            for t, shard in enumerate(split_tp_shards(sd, 2)):
+                torch.save({k: torch.from_numpy(np.ascontiguousarray(v))
+                            for k, v in shard.items()},
+                           os.path.join(d2, f.replace("model_00",
+                                                      f"model_{t:02d}")))
+
+    cfg1, p1, e1 = load_megatron_moe(d1, n_head=cfg.n_head)
+    cfg2, p2, e2 = load_megatron_moe(d2, n_head=cfg.n_head)
+    assert e1 == e2 == 4
+    assert p2["moe"]["gate"]["wg"].shape == p1["moe"]["gate"]["wg"].shape
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+                 p1, p2)

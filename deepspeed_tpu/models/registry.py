@@ -54,29 +54,40 @@ def mxu_aligned(config):
     return config
 
 
-# Measured TPU head layouts for presets whose n_embd is NOT a multiple of 128
-# (mxu_aligned can't derive them). gpt2-xl (1600): the 25x64 paper layout
-# wastes half of every MXU pass on the 64-wide attention contractions; the
-# v5e-measured grad-only ladder is 25x64 0.429 < 20x80 0.454 < 10x160 0.468 <
-# 8x200 0.493 < 5x320 0.500 MFU (4x400 exceeds the flash kernel's vmem
-# stack). Param/flop-invariant, but a DIFFERENT architecture — every consumer
-# must log the relayout (see tpu_native_layout).
-TPU_HEAD_OVERRIDES = {"gpt2-xl": 5}
+# Measured TPU head layouts per preset (v5e). head_dim=128 (the MXU lane
+# width) was round-4's lever; round 5 measured that FEWER, FATTER heads go
+# further — per-head grid iterations drop and the contraction stays
+# tile-aligned — up to a per-model sweet spot (beyond it the flash kernel's
+# vmem scratch or HBM gives out):
+#   gpt2-760m (1536): 12x128 0.536 < 6x256 0.545 < 3x512 0.549 < 4x384 0.569
+#     (2x768 OOM)
+#   bert-large (1024): 8x128 0.568 < 4x256 0.568 < 2x512 0.576 @seq512;
+#     2x512 lifts the seq128 record config 0.614 -> 0.694
+#   gpt2-xl (1600): 25x64 0.429 < 20x80 < 10x160 < 8x200 ~= 5x320 0.50
+#     (4x400 exceeds the kernel vmem stack)
+#   gpt2-moe-125m: no change beyond 6x128 (dispatch-bound, stays mxu_aligned)
+#   gpt2-1.3b: 8x256 within noise of 16x128 (offload-bound, stays aligned)
+# Param/flop-invariant, but a DIFFERENT architecture — every consumer must
+# log the relayout (see tpu_native_layout).
+TPU_HEAD_OVERRIDES = {"gpt2-xl": 5, "gpt2-760m": 4, "bert-large": 2}
 
 
 def tpu_native_layout(config, model_name: str = "", log=None):
-    """The layout bench.py and bin/ds_tune measure on TPU: ``mxu_aligned``
-    when n_embd allows head_dim=128, else the measured per-preset override.
+    """The layout bench.py and bin/ds_tune measure on TPU: the measured
+    per-preset override when one exists, else ``mxu_aligned`` (head_dim=128).
     ``log``: callable fed a one-line notice whenever the head count actually
     changes — the knob that keeps reported configs reproducible (a result
     measured on a relayout must SAY so)."""
     import dataclasses
 
-    out = mxu_aligned(config)
     heads = TPU_HEAD_OVERRIDES.get(model_name)
-    if out is config and heads and config.n_head != heads \
-            and config.n_embd % heads == 0:
-        out = dataclasses.replace(config, n_head=heads)
+    if heads and config.n_embd % heads == 0:
+        # idempotent: a config already at the override layout passes through
+        # (falling through to mxu_aligned would oscillate 4 -> 12 -> 4)
+        out = config if config.n_head == heads \
+            else dataclasses.replace(config, n_head=heads)
+    else:
+        out = mxu_aligned(config)
     if log is not None and out is not config:
         log(f"TPU-native head relayout: {model_name or 'model'} "
             f"n_head {config.n_head} -> {out.n_head} (head_dim "

@@ -218,6 +218,11 @@ def test_mxu_aligned_is_param_and_flop_invariant():
     # unknown preset name: falls back to mxu_aligned only, no log
     assert tpu_native_layout(xl, "not-a-preset", log=notes.append) is xl
     assert len(notes) == 1
+    # measured fat-head overrides take precedence over mxu_aligned
+    n760 = tpu_native_layout(m760, "gpt2-760m")
+    assert n760.n_head == 4 and n760.num_params() == m760.num_params()
+    bl2 = tpu_native_layout(bl, "bert-large")
+    assert bl2.n_head == 2 and bl2.num_params() == bl.num_params()
 
 
 def test_llama32_1b_preset_matches_hf_shape():

@@ -174,3 +174,39 @@ class TestDsTuneCLI:
         res = json.loads(out)
         assert res["status"] == "ok"
         assert res["tuned"]["micro_batch"] == 2
+
+
+def test_heads_axis_reaches_factory(tmp_path):
+    """The r5 fat-head axis: heads_list expands the space and the winning
+    candidate's n_head reaches the model factory (and the reported config)."""
+    import jax
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    seen = []
+
+    def factory(remat="none", n_head=None):
+        cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=16,
+                         n_layer=1, n_head=n_head or 2, remat=False,
+                         use_flash_attention=False)
+        seen.append(cfg.n_head)
+        return GPT2Model(cfg)
+
+    def batches(bs):
+        rng = np.random.RandomState(0)
+        return {"input_ids": rng.randint(0, 128, size=(bs, 16)).astype(np.int32)}
+
+    t = AutotuningConfig(enabled=True, start_profile_step=1, end_profile_step=2,
+                         results_dir=str(tmp_path / "r"),
+                         exps_dir=str(tmp_path / "e"),
+                         mbs_list=[1], zero_stage_list=[0],
+                         remat_list=["none"], heads_list=[2, 4],
+                         tuner_type="gridsearch")
+    at = Autotuner(factory, batches,
+                   {"optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "steps_per_print": 0}, t)
+    cands = at.candidate_space()
+    assert {c["_tune"]["n_head"] for c in cands} == {2, 4}
+    best = at.tune()
+    assert best is not None and best["_tuned"]["n_head"] in (2, 4)
+    assert set(seen) >= {2, 4}

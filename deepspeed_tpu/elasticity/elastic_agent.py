@@ -33,6 +33,8 @@ import signal
 import time
 from typing import Any, Callable, Iterable, Optional
 
+from deepspeed_tpu.resilience.manifest import find_restorable_tag, verify_tag
+from deepspeed_tpu.resilience.retry import RestartBackoff
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -48,12 +50,17 @@ class DSElasticAgent:
                  max_restarts: int = 3,
                  install_signal_handlers: bool = True,
                  tag: Optional[str] = None,
-                 preempt_sync_interval: Optional[int] = None):
+                 preempt_sync_interval: Optional[int] = None,
+                 restart_backoff: Optional[RestartBackoff] = None):
         self.engine_factory = engine_factory
         self.save_dir = save_dir
         self.checkpoint_interval = int(checkpoint_interval)
         self.max_restarts = int(max_restarts)
         self.tag = tag
+        # exponential restart pacing (shared resilience backoff policy): a
+        # crash-looping job should slow down, not hot-spin on a flat delay
+        self.restart_backoff = restart_backoff or RestartBackoff()
+        self.restart_log: list = []     # one record per restart attempt
         # cross-host flag sync cadence: a per-step blocking allgather would
         # sit in the hot loop for an event with a tens-of-seconds grace
         # window; default = every min(checkpoint_interval, 10) steps (all
@@ -110,16 +117,41 @@ class DSElasticAgent:
 
     # ---------------------------------------------------------- lifecycle
     def _bring_up(self, resume: bool) -> Any:
+        """``resume`` is trusted: run() evaluates _has_checkpoint() once per
+        bring-up (the load path verifies again anyway — re-hashing every
+        sidecar a third time here buys nothing)."""
         self.engine = self.engine_factory()
-        if resume and self._has_checkpoint():
-            self.engine.load_checkpoint(self.save_dir, tag=self.tag)
+        if resume:
+            path, _ = self.engine.load_checkpoint(self.save_dir, tag=self.tag)
+            if path is None:
+                # the checkpoint vanished/corrupted between the check and the
+                # load: failing loudly (→ the restart loop, → the launcher)
+                # beats silently training fresh weights as if resumed
+                raise RuntimeError(
+                    f"elastic agent: resume expected a restorable checkpoint "
+                    f"in {self.save_dir} (tag={self.tag!r}) but nothing loaded")
             log_dist(f"elastic agent: resumed at step "
                      f"{int(self.engine.state.step)} on "
                      f"{self.engine.mesh.shape}", ranks=[0])
         return self.engine
 
     def _has_checkpoint(self) -> bool:
-        return os.path.isdir(self.save_dir) and bool(os.listdir(self.save_dir))
+        """A checkpoint exists iff a tag this agent WILL load verifies as
+        restorable. A merely non-empty save_dir (dangling 'latest', stray
+        files, a half-written tag) used to trigger a resume that silently
+        loaded nothing — treating the run as fresh-but-pointed-at-garbage.
+        With an explicit ``tag`` the load path refuses to substitute another
+        checkpoint, so only THAT tag counts here."""
+        # an async save may still be committing (manifest lands last)
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        wait_for_pending_saves()
+        if self.tag is not None:
+            ok, _ = verify_tag(
+                os.path.join(os.path.abspath(self.save_dir), self.tag))
+            return ok
+        return find_restorable_tag(self.save_dir) is not None
 
     def _checkpoint(self):
         self.engine.save_checkpoint(self.save_dir, tag=self.tag)
@@ -139,9 +171,12 @@ class DSElasticAgent:
             try:
                 engine = self._bring_up(resume)
                 it = batches_factory() if callable(batches_factory) else iter(batches)
-                start_step = int(engine.state.step)
-                for local_i, batch in enumerate(it):
-                    step = start_step + local_i
+                # the ENGINE's step counter is the authority — a bad-step
+                # sentinel rewind inside train_batch moves it backwards, and
+                # an agent-side `start + i` counter would silently march past
+                # num_steps with fewer steps actually trained
+                step = int(engine.state.step)
+                for batch in it:
                     if step >= num_steps:
                         break
                     if self._preempt_sync(step):
@@ -149,21 +184,33 @@ class DSElasticAgent:
                     loss = engine.train_batch(batch)
                     if step_callback is not None:
                         step_callback(step, loss)
-                    done = step + 1
-                    if self.checkpoint_interval and \
+                    # the engine's HOST-side step mirror (synced by every
+                    # checkpoint load, incl. a sentinel rewind) — reading
+                    # state.step here would force a device sync per step
+                    done = int(getattr(engine, "_host_step", step + 1))
+                    advanced = done == step + 1
+                    if not advanced:
+                        log_dist(f"elastic agent: engine step moved "
+                                 f"{step}→{done} (sentinel rewind); "
+                                 "re-treading from there", ranks=[0])
+                    step = done
+                    # never on a rewound iteration: re-saving identical state
+                    # over the just-restored tag would drop its manifest and
+                    # risk the only good checkpoint on a crash mid-re-save
+                    if advanced and self.checkpoint_interval and \
                             done % self.checkpoint_interval == 0:
                         self._checkpoint()
+                        # a full healthy checkpoint interval ends the
+                        # incident: the next (unrelated) failure should not
+                        # pay this one's escalated delay
+                        self.restart_backoff.reset()
                 self._checkpoint()
-                return {"status": "complete",
-                        "final_step": int(engine.state.step),
-                        "restarts": self.restart_count}
+                return self._status("complete", engine)
             except PreemptionSignal:
                 self._checkpoint()
                 log_dist("elastic agent: preemption checkpoint written; "
                          "exiting cleanly", ranks=[0])
-                return {"status": "preempted",
-                        "final_step": int(self.engine.state.step),
-                        "restarts": self.restart_count}
+                return self._status("preempted", self.engine)
             except Exception as e:
                 import jax
 
@@ -178,10 +225,26 @@ class DSElasticAgent:
                                  "launcher to restart the whole job")
                     raise
                 self.restart_count += 1
+                delay = self.restart_backoff.next_delay()
+                self.restart_log.append({
+                    "restart": self.restart_count,
+                    "error": f"{type(e).__name__}: {e}",
+                    "step": int(self.engine.state.step) if self.engine is not None else None,
+                    "backoff_s": round(delay, 3),
+                })
                 logger.warning(f"elastic agent: step failure ({e}); "
-                               f"restart {self.restart_count}/{self.max_restarts}")
+                               f"restart {self.restart_count}/{self.max_restarts} "
+                               f"after {delay:.2f}s backoff")
                 if self.restart_count > self.max_restarts:
                     raise
-                resume = True
+                # one verification pass per restart: _bring_up trusts this
+                resume = self._has_checkpoint()
                 self.engine = None
-                time.sleep(0.1)
+                time.sleep(delay)
+
+    def _status(self, status: str, engine) -> dict:
+        return {"status": status,
+                "final_step": int(engine.state.step),
+                "restarts": self.restart_count,
+                "restart_reasons": [r["error"] for r in self.restart_log],
+                "restart_log": list(self.restart_log)}

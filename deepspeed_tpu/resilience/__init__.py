@@ -1,0 +1,33 @@
+"""Resilience subsystem — everything off the checkpoint happy path.
+
+The reference DeepSpeed pairs elasticity with nebula-style resilient
+checkpointing; this package is the TPU counterpart for the failure modes
+that dominate real multi-day pod-slice jobs:
+
+* ``retry``     — exponential backoff + jitter + deadline around flaky
+                  GCS/NFS filesystem I/O, plus the shared restart-backoff
+                  policy used by the elastic agent.
+* ``manifest``  — per-tag ``manifest.json`` (sha256 + byte sizes) written at
+                  save, verified before restore; ``find_restorable_tag``
+                  walks back to the newest tag that passes.
+* ``chaos``     — seedable fault injection (write failures, truncations,
+                  delays) into the checkpoint I/O path so recovery is
+                  actually testable (enable via config or ``DS_CHAOS``).
+* ``sentinel``  — the bad-step sentinel: after K consecutive
+                  non-finite/loss-spike steps the engine rewinds to the
+                  last verified checkpoint instead of burning the job.
+"""
+
+from deepspeed_tpu.resilience.chaos import (ChaosError, ChaosInjector, active_injector, install_chaos,
+                                            uninstall_chaos)
+from deepspeed_tpu.resilience.manifest import (MANIFEST_NAME, candidate_tags, find_restorable_tag, verify_tag,
+                                               write_manifest)
+from deepspeed_tpu.resilience.retry import RestartBackoff, RetryPolicy, retry
+from deepspeed_tpu.resilience.sentinel import BadStepError, BadStepSentinel
+
+__all__ = [
+    "ChaosError", "ChaosInjector", "active_injector", "install_chaos", "uninstall_chaos",
+    "MANIFEST_NAME", "candidate_tags", "find_restorable_tag", "verify_tag", "write_manifest",
+    "RestartBackoff", "RetryPolicy", "retry",
+    "BadStepError", "BadStepSentinel",
+]

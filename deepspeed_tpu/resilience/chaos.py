@@ -1,0 +1,174 @@
+"""Seedable fault injection for the checkpoint I/O path.
+
+Recovery code that is never exercised is broken code. The checkpoint
+engine routes every filesystem write through two hooks —
+``injector.before(op, path)`` (may raise :class:`ChaosError` or sleep) and
+``injector.corrupt(op, path, data)`` (may truncate the payload, a SILENT
+fault that only manifest verification can catch) — so a test or a
+game-day run can deterministically interrupt a save at any point.
+
+Ops instrumented by the checkpoint engine: ``state_save`` (the orbax
+write), ``client_state``, ``sampler_sidecar``, ``manifest``, ``latest``.
+
+Activation: ``install_chaos(injector)`` (tests / the ``resilience.chaos``
+config block at engine init), or the ``DS_CHAOS`` env var, e.g.
+``DS_CHAOS="seed=7,failure_rate=0.2,truncate_rate=0.1,ops=latest+client_state"``.
+Everything is driven by one ``random.Random(seed)`` stream, so a sweep
+seed reproduces exactly.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from collections import defaultdict
+from typing import Dict, Iterable, Optional, Sequence
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ChaosError(OSError):
+    """An injected fault (subclasses OSError so retry policies treat it
+    like the real flaky-filesystem failure it stands in for)."""
+
+
+class ChaosInjector:
+    """Deterministic fault plan for checkpoint I/O.
+
+    Two modes, composable:
+
+    * **scripted** — ``fail_at={"latest": [1, 2]}`` fails the 1st and 2nd
+      ``latest`` write, ``truncate_at={"client_state": [1]}`` truncates the
+      1st client_state payload (call counts are per-op, 1-based);
+    * **randomized** — ``failure_rate`` / ``truncate_rate`` / ``delay_rate``
+      draw per-call from ``random.Random(seed)``.
+
+    ``ops`` restricts injection to those op names (None = all).
+    """
+
+    def __init__(self, seed: int = 0, failure_rate: float = 0.0,
+                 truncate_rate: float = 0.0, delay_rate: float = 0.0,
+                 max_delay_s: float = 0.02,
+                 ops: Optional[Iterable[str]] = None,
+                 fail_at: Optional[Dict[str, Sequence[int]]] = None,
+                 truncate_at: Optional[Dict[str, Sequence[int]]] = None):
+        self._rng = random.Random(seed)
+        self.seed = seed
+        self.source = "manual"      # "config" / "env": who installed it
+        self.failure_rate = float(failure_rate)
+        self.truncate_rate = float(truncate_rate)
+        self.delay_rate = float(delay_rate)
+        self.max_delay_s = float(max_delay_s)
+        self.ops = set(ops) if ops else None
+        self.fail_at = {k: set(v) for k, v in (fail_at or {}).items()}
+        self.truncate_at = {k: set(v) for k, v in (truncate_at or {}).items()}
+        self._counts = defaultdict(int)
+        self.log: list = []          # (op, action, path) — what actually fired
+
+    @classmethod
+    def from_config(cls, cfg) -> "ChaosInjector":
+        """Build from the ``resilience.chaos`` pydantic block."""
+        inj = cls(seed=cfg.seed, failure_rate=cfg.failure_rate,
+                  truncate_rate=cfg.truncate_rate, delay_rate=cfg.delay_rate,
+                  max_delay_s=cfg.max_delay_s, ops=cfg.ops or None)
+        inj.source = "config"
+        return inj
+
+    @classmethod
+    def from_env(cls, spec: str) -> "ChaosInjector":
+        """Parse a ``DS_CHAOS`` spec: comma-separated k=v pairs; ``ops`` is
+        ``+``-separated."""
+        kw: dict = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k == "ops":
+                kw["ops"] = [o for o in v.split("+") if o]
+            elif k == "seed":
+                kw["seed"] = int(v)
+            else:
+                kw[k] = float(v)
+        return cls(**kw)
+
+    def _applies(self, op: str) -> bool:
+        return self.ops is None or op in self.ops
+
+    def before(self, op: str, path: str):
+        """Called before a write op executes; may sleep or raise ChaosError."""
+        if not self._applies(op):
+            return
+        self._counts[op] += 1
+        n = self._counts[op]
+        if n in self.fail_at.get(op, ()):
+            self.log.append((op, "fail", path))
+            raise ChaosError(f"chaos: injected failure on {op} #{n} ({path})")
+        if self.delay_rate and self._rng.random() < self.delay_rate:
+            d = self._rng.uniform(0.0, self.max_delay_s)
+            self.log.append((op, f"delay {d:.3f}s", path))
+            time.sleep(d)
+        if self.failure_rate and self._rng.random() < self.failure_rate:
+            self.log.append((op, "fail", path))
+            raise ChaosError(f"chaos: injected failure on {op} #{n} ({path})")
+
+    def corrupt(self, op: str, path: str, data: bytes) -> bytes:
+        """Called with the payload about to be written; may truncate it —
+        the write then SUCCEEDS with bad content, which only the manifest
+        check at load time can catch."""
+        if not self._applies(op) or not data:
+            return data
+        n = self._counts[op]
+        scripted = n in self.truncate_at.get(op, ())
+        randomized = self.truncate_rate and self._rng.random() < self.truncate_rate
+        if scripted or randomized:
+            cut = self._rng.randrange(0, max(1, len(data)))
+            self.log.append((op, f"truncate {len(data)}→{cut}B", path))
+            return data[:cut]
+        return data
+
+
+_installed: Optional[ChaosInjector] = None
+_env_checked = False
+
+
+def install_chaos(injector: ChaosInjector):
+    global _installed
+    logger.warning(f"chaos: fault injection ACTIVE (seed={injector.seed}, "
+                   f"failure_rate={injector.failure_rate}, "
+                   f"truncate_rate={injector.truncate_rate}, "
+                   f"delay_rate={injector.delay_rate}, ops={sorted(injector.ops) if injector.ops else 'all'})")
+    _installed = injector
+
+
+def uninstall_chaos():
+    global _installed, _env_checked
+    _installed = None
+    _env_checked = True      # an explicit uninstall also wins over DS_CHAOS
+
+
+def uninstall_config_chaos():
+    """Remove only a CONFIG-installed injector: an engine built with
+    ``resilience.chaos.enabled=false`` must not inherit a previous engine's
+    drill in the same process, but also must not clobber a DS_CHAOS env
+    switch or a test's manual install."""
+    global _installed
+    if _installed is not None and _installed.source == "config":
+        _installed = None
+
+
+def active_injector() -> Optional[ChaosInjector]:
+    """The installed injector, else one lazily built from ``DS_CHAOS``."""
+    global _env_checked, _installed
+    if _installed is not None:
+        return _installed
+    if not _env_checked:
+        _env_checked = True
+        spec = os.environ.get("DS_CHAOS", "").strip()
+        if spec and spec not in ("0", "off", "false"):
+            inj = ChaosInjector.from_env(spec)
+            inj.source = "env"
+            install_chaos(inj)
+    return _installed

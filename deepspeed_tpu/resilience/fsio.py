@@ -1,0 +1,62 @@
+"""Atomic, retried, chaos-instrumented filesystem primitives.
+
+Every metadata write in the checkpoint path goes through here: payload →
+(chaos corrupt hook) → temp file in the destination directory → fsync →
+``os.replace``. A crash at ANY point leaves either the old file or the new
+file, never a half-written one — which is what lets the per-tag manifest
+(resilience/manifest.py) reason about tag integrity at all. Transient
+failures (OSError, including injected :class:`ChaosError`) are retried
+under the caller's :class:`RetryPolicy`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Optional
+
+from deepspeed_tpu.resilience import chaos as _chaos
+from deepspeed_tpu.resilience.retry import RetryPolicy, retry
+
+
+def _write_once(path: str, data: bytes, op: str):
+    inj = _chaos.active_injector()
+    if inj is not None:
+        inj.before(op, path)
+        data = inj.corrupt(op, path, data)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_bytes(path: str, data: bytes, *, op: str,
+                       policy: Optional[RetryPolicy] = None):
+    retry(lambda: _write_once(path, data, op), policy, op=op)
+
+
+def atomic_write_text(path: str, text: str, *, op: str,
+                      policy: Optional[RetryPolicy] = None):
+    atomic_write_bytes(path, text.encode("utf-8"), op=op, policy=policy)
+
+
+def atomic_write_json(path: str, obj, *, op: str,
+                      policy: Optional[RetryPolicy] = None, **dump_kwargs) -> bytes:
+    """Serialize once, write atomically; returns the serialized bytes so the
+    caller can manifest-hash the INTENDED content (a chaos truncation then
+    shows up as a hash mismatch at load, exactly like real corruption)."""
+    data = json.dumps(obj, **dump_kwargs).encode("utf-8")
+    atomic_write_bytes(path, data, op=op, policy=policy)
+    return data

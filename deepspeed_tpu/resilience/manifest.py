@@ -1,0 +1,241 @@
+"""Per-tag checkpoint manifests: write at save, verify before restore.
+
+A tag directory is *verified* when its ``manifest.json`` — written AFTER
+the orbax state commits and BEFORE the ``latest`` pointer advances —
+matches what is on disk:
+
+* sha256 + byte size for ``client_state.json`` and every sidecar
+  (hashes are computed from the in-memory payload at save time, so a
+  truncated/corrupted write is caught even though the write "succeeded");
+* byte size for every file under ``state/`` (hashing multi-GB OCDBT shards
+  on every load would double restore time; orbax's own atomic-rename commit
+  plus size checks catch the partial-write cases), and
+* presence of the orbax commit marker (``state/_CHECKPOINT_METADATA``).
+
+``candidate_tags`` orders tags newest-first so a restart resumes at the
+newest tag that passes — a save that died between the state commit and the
+``latest`` advance costs nothing, and a corrupt newest tag costs exactly
+one checkpoint interval.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.resilience.fsio import atomic_write_json
+from deepspeed_tpu.resilience.retry import RetryPolicy
+from deepspeed_tpu.utils.logging import logger
+
+MANIFEST_NAME = "manifest.json"
+STATE_DIR = "state"
+COMMIT_MARKER = os.path.join(STATE_DIR, "_CHECKPOINT_METADATA")
+_STEP_RE = re.compile(r"(\d+)\s*$")
+
+
+def sha256_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _walk_sizes(root: str, rel_prefix: str) -> Dict[str, int]:
+    out = {}
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            p = os.path.join(dirpath, name)
+            out[os.path.join(rel_prefix, os.path.relpath(p, root))] = os.path.getsize(p)
+    return out
+
+
+def write_manifest(tag_dir: str, tag: str, files: Dict[str, bytes],
+                   policy: Optional[RetryPolicy] = None,
+                   advance_latest: bool = True) -> dict:
+    """Write ``<tag_dir>/manifest.json``. ``files`` maps sidecar filename →
+    the exact bytes that were (intended to be) written; the orbax ``state``
+    tree is size-indexed from disk (it has already committed).
+
+    ``advance_latest`` records the save's INTENT to move the 'latest'
+    pointer: it distinguishes "pointer advance crashed" (resume from this
+    tag — it is the newest committed work) from a deliberate
+    ``save_latest=False`` side checkpoint (never auto-resumed)."""
+    manifest = {
+        "version": 1,
+        "tag": tag,
+        "advance_latest": bool(advance_latest),
+        "commit_marker": COMMIT_MARKER.replace(os.sep, "/"),
+        "files": {name: {"bytes": len(data), "sha256": sha256_bytes(data)}
+                  for name, data in files.items()},
+        "state_files": {k.replace(os.sep, "/"): v
+                        for k, v in _walk_sizes(os.path.join(tag_dir, STATE_DIR),
+                                                STATE_DIR).items()},
+    }
+    atomic_write_json(os.path.join(tag_dir, MANIFEST_NAME), manifest,
+                      op="manifest", policy=policy, sort_keys=True)
+    return manifest
+
+
+def verify_tag(tag_dir: str) -> Tuple[bool, str]:
+    """Is this tag safe to restore? Returns (ok, reason).
+
+    Tags from before the manifest era (no ``manifest.json``) are accepted
+    when the orbax commit marker is present AND ``client_state.json``
+    parses — they predate verification, and rejecting them would strand
+    every existing run on upgrade; but a tag whose save died between the
+    orbax commit and the metadata write has neither file and is skipped.
+    Non-orbax engine layouts (e.g. ZeRO-Infinity's swap-file snapshots)
+    have no ``state/`` tree at all: those are accepted when
+    ``client_state.json`` parses and some payload landed beside it.
+    """
+    if not os.path.isdir(tag_dir):
+        return False, "tag directory does not exist"
+    marker = os.path.join(tag_dir, COMMIT_MARKER)
+    mpath = os.path.join(tag_dir, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        cs = os.path.join(tag_dir, "client_state.json")
+        if not os.path.isfile(cs):
+            return False, "no manifest and no client_state.json (save died mid-metadata)"
+        try:
+            with open(cs) as f:
+                json.load(f)
+        except (OSError, ValueError) as e:
+            return False, f"no manifest and client_state.json unparseable ({e})"
+        if os.path.isfile(marker):
+            return True, "no manifest (pre-manifest tag accepted: commit marker + client state intact)"
+        if not os.path.isdir(os.path.join(tag_dir, STATE_DIR)):
+            # a tag that died before ANY state landed has only metadata; a
+            # foreign-engine snapshot has its payload files beside it. Our
+            # own sidecar and orbax's uncommitted tmp dirs are NOT foreign
+            # payload — a crashed orbax save must stay rejected.
+            others = [n for n in os.listdir(tag_dir)
+                      if n not in ("client_state.json", MANIFEST_NAME,
+                                   "data_sampler_admitted.npy")
+                      and "orbax-checkpoint-tmp" not in n]
+            if others:
+                return True, ("no manifest (non-orbax layout accepted: "
+                              "client state + payload files intact)")
+        return False, "orbax state never committed (missing state/_CHECKPOINT_METADATA)"
+    if not os.path.isfile(marker):
+        return False, "orbax state never committed (missing state/_CHECKPOINT_METADATA)"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"manifest unreadable ({e})"
+    try:
+        for name, want in manifest.get("files", {}).items():
+            p = os.path.join(tag_dir, name)
+            if not os.path.isfile(p):
+                return False, f"{name} missing"
+            size = os.path.getsize(p)
+            if size != want.get("bytes"):
+                return False, f"{name} is {size}B, manifest says {want.get('bytes')}B"
+            if _sha256_file(p) != want.get("sha256"):
+                return False, f"{name} sha256 mismatch (corrupt or truncated write)"
+        for rel, want_size in manifest.get("state_files", {}).items():
+            p = os.path.join(tag_dir, rel.replace("/", os.sep))
+            if not os.path.isfile(p):
+                return False, f"state file {rel} missing"
+            size = os.path.getsize(p)
+            if size != want_size:
+                return False, f"state file {rel} is {size}B, manifest says {want_size}B"
+    except OSError as e:
+        # isfile-then-open race (concurrent retention prune, flaky NFS):
+        # an unreadable tag is an unrestorable tag, not a crash
+        return False, f"filesystem error while verifying ({e})"
+    return True, "ok"
+
+
+def _tag_sort_key(save_dir: str, tag: str):
+    """Newest-first ordering: by step parsed from the tag name
+    (``global_step<N>``-style), falling back to directory mtime."""
+    m = _STEP_RE.search(tag)
+    step = int(m.group(1)) if m else -1
+    try:
+        mtime = os.path.getmtime(os.path.join(save_dir, tag))
+    except OSError:
+        mtime = 0.0
+    return (step, mtime)
+
+
+def _intends_latest(save_dir: str, tag: str) -> bool:
+    """Did this tag's save mean to advance the 'latest' pointer? Pre-manifest
+    tags and unreadable manifests default to True (auto-resumable)."""
+    try:
+        with open(os.path.join(save_dir, tag, MANIFEST_NAME)) as f:
+            return bool(json.load(f).get("advance_latest", True))
+    except (OSError, ValueError):
+        return True
+
+
+def candidate_tags(save_dir: str, preferred: Optional[str] = None) -> List[str]:
+    """All tag directories under ``save_dir``, restore-preference order:
+
+    1. the explicitly requested tag (if any) — the caller knows best;
+    2. auto-resume tags (saved with ``save_latest=True``), newest-first.
+       The 'latest' pointer is a ranking hint, not an authority: the tag it
+       names is outranked only by tags PROVABLY newer — both tags parse a
+       step and the candidate's is greater — so a save that crashed between
+       the state commit and the pointer advance still wins, but neither a
+       non-numeric pointer tag (``tag='best'``) nor anything ranked by
+       mere mtime (no evidence of newer training progress — e.g. a
+       pre-manifest side snapshot) is demoted below / lifted above it.
+
+    ``save_latest=False`` side checkpoints are NEVER candidates for an
+    automatic resume — only an explicit ``preferred`` request includes one.
+    """
+    save_dir = os.path.abspath(save_dir)
+    if not os.path.isdir(save_dir):
+        return []
+    tags = [d for d in os.listdir(save_dir)
+            if os.path.isdir(os.path.join(save_dir, d)) and not d.startswith(".")]
+    tags = [t for t in tags if t == preferred or _intends_latest(save_dir, t)]
+    tags.sort(key=lambda t: _tag_sort_key(save_dir, t), reverse=True)
+    latest = read_latest(save_dir)
+    if latest in tags and latest != preferred:
+        lstep, _ = _tag_sort_key(save_dir, latest)
+
+        def _provably_newer(t: str) -> bool:
+            step, _ = _tag_sort_key(save_dir, t)
+            return step >= 0 and lstep >= 0 and step > lstep
+
+        tags = ([t for t in tags if _provably_newer(t)] + [latest]
+                + [t for t in tags if t != latest and not _provably_newer(t)])
+    if preferred is not None and preferred in tags:
+        tags.remove(preferred)
+        tags.insert(0, preferred)
+    return tags
+
+
+def read_latest(save_dir: str) -> Optional[str]:
+    latest = os.path.join(os.path.abspath(save_dir), "latest")
+    try:
+        with open(latest) as f:
+            tag = f.read().strip()
+        return tag or None
+    except OSError:
+        return None
+
+
+def find_restorable_tag(save_dir: str, preferred: Optional[str] = None) -> Optional[str]:
+    """Newest tag that passes :func:`verify_tag`, or None.
+
+    This is what "do we have a checkpoint?" must mean: a non-empty save_dir
+    (stray files, a dangling ``latest``, a half-written tag) is NOT a
+    checkpoint unless something in it can actually be restored.
+    """
+    for tag in candidate_tags(save_dir, preferred=preferred):
+        ok, reason = verify_tag(os.path.join(os.path.abspath(save_dir), tag))
+        if ok:
+            return tag
+        logger.warning(f"checkpoint tag {tag!r} not restorable: {reason}")
+    return None

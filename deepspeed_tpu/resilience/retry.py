@@ -1,0 +1,106 @@
+"""Retried I/O and restart backoff.
+
+One policy object serves both users: ``retry()`` wraps checkpoint-engine
+filesystem operations (a flaky GCS/NFS write should cost a few seconds of
+backoff, not the run), and ``RestartBackoff`` paces the elastic agent's
+restart-on-failure loop (a crash-looping job should slow down, not spin).
+Both are deterministic under a seed so chaos tests can assert exact
+behavior.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple, Type
+
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with jitter and a wall-clock deadline.
+
+    Attempt ``n`` (1-based) sleeps ``min(max_delay, base_delay *
+    multiplier**(n-1))`` scaled by ±``jitter`` before retrying. Gives up —
+    re-raising the LAST exception unchanged — when ``max_attempts`` calls
+    failed, or when the next sleep would cross ``deadline`` seconds since
+    the first call. Only exceptions in ``retry_on`` are retried; anything
+    else propagates immediately.
+    """
+    max_attempts: int = 4
+    base_delay: float = 0.05
+    multiplier: float = 2.0
+    max_delay: float = 2.0
+    deadline: Optional[float] = 30.0
+    jitter: float = 0.25
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,)
+    # None = OS entropy: every host/op draws DIFFERENT jitter, so a shared
+    # GCS/NFS flake doesn't make a pod slice retry in lockstep (the whole
+    # point of jitter). Set a seed only for deterministic tests.
+    seed: Optional[int] = None
+
+    def delay_for(self, attempt: int, rng: random.Random) -> float:
+        d = min(self.max_delay, self.base_delay * self.multiplier ** max(0, attempt - 1))
+        if self.jitter:
+            d *= 1.0 + self.jitter * rng.uniform(-1.0, 1.0)
+        return max(0.0, d)
+
+
+NO_RETRY = RetryPolicy(max_attempts=1, deadline=None)
+
+
+def retry(fn: Callable, policy: Optional[RetryPolicy] = None, *, op: str = "",
+          sleep: Callable[[float], None] = time.sleep,
+          clock: Callable[[], float] = time.monotonic):
+    """Call ``fn()`` under ``policy``; returns its value or re-raises its
+    last exception once attempts/deadline are exhausted. ``sleep``/``clock``
+    are injectable for tests (no real waiting)."""
+    policy = policy or RetryPolicy()
+    rng = random.Random(policy.seed)
+    start = clock()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except policy.retry_on as e:
+            attempt += 1
+            if attempt >= policy.max_attempts:
+                logger.warning(f"retry[{op}]: giving up after {attempt} attempt(s): {e}")
+                raise
+            d = policy.delay_for(attempt, rng)
+            if policy.deadline is not None and (clock() - start) + d > policy.deadline:
+                logger.warning(f"retry[{op}]: deadline {policy.deadline}s exhausted "
+                               f"after {attempt} attempt(s): {e}")
+                raise
+            logger.warning(f"retry[{op}]: attempt {attempt}/{policy.max_attempts} "
+                           f"failed ({e}); retrying in {d:.3f}s")
+            sleep(d)
+
+
+@dataclass
+class RestartBackoff:
+    """Exponential restart pacing for the elastic agent (replaces the old
+    flat ``time.sleep(0.1)``): each consecutive failure doubles the delay up
+    to ``max_delay``; ``reset()`` after a healthy stretch."""
+    base_delay: float = 0.1
+    multiplier: float = 2.0
+    max_delay: float = 30.0
+    jitter: float = 0.25
+    seed: Optional[int] = None   # None = OS entropy (see RetryPolicy.seed)
+    attempt: int = 0
+    _rng: random.Random = field(default=None, repr=False)
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def next_delay(self) -> float:
+        d = min(self.max_delay, self.base_delay * self.multiplier ** self.attempt)
+        self.attempt += 1
+        if self.jitter:
+            d *= 1.0 + self.jitter * self._rng.uniform(-1.0, 1.0)
+        return max(0.0, d)
+
+    def reset(self):
+        self.attempt = 0

@@ -18,6 +18,7 @@ load to any other, because placement is metadata, not file layout.
 
 from __future__ import annotations
 
+import io
 import json
 import os
 from typing import Any, Optional
@@ -25,11 +26,30 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from deepspeed_tpu.resilience import chaos as _chaos
+from deepspeed_tpu.resilience.fsio import atomic_write_bytes, atomic_write_text
+from deepspeed_tpu.resilience.manifest import (MANIFEST_NAME, candidate_tags,
+                                               verify_tag, write_manifest)
+from deepspeed_tpu.resilience.retry import NO_RETRY, RetryPolicy, retry
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 def _ckpt_dir(save_dir: str, tag: str) -> str:
     return os.path.join(os.path.abspath(save_dir), str(tag))
+
+
+def _retry_policy(engine) -> RetryPolicy:
+    """The engine's configured retry policy for checkpoint filesystem I/O
+    (resilience.retry block); default policy when the engine predates it."""
+    res = getattr(getattr(engine, "_config", None), "resilience", None)
+    if res is None:
+        return RetryPolicy()
+    r = res.retry
+    if not r.enabled:
+        return NO_RETRY
+    return RetryPolicy(max_attempts=r.max_attempts, base_delay=r.base_delay,
+                       multiplier=r.multiplier, max_delay=r.max_delay,
+                       deadline=r.deadline, jitter=r.jitter)
 
 
 def _flatten_state(state) -> dict:
@@ -94,17 +114,55 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     tag = tag or f"global_step{int(engine.state.step)}"
     path = _ckpt_dir(save_dir, tag)
     state = engine.state
+    policy = _retry_policy(engine)
+    inj = _chaos.active_injector()
+
+    if jax.process_index() == 0:
+        # overwriting an existing tag: its old manifest indexes the PREVIOUS
+        # save's bytes, and would invalidate the tag the moment any file is
+        # replaced underneath it. Drop it first — until the new manifest
+        # lands, a crash degrades to the pre-manifest acceptance (commit
+        # marker + parseable client_state) instead of a false corruption.
+        # (join any in-flight finalize thread so ITS manifest write cannot
+        # land after this drop)
+        stale_manifest = os.path.join(path, MANIFEST_NAME)
+        wait_for_pending_saves()
+        if os.path.exists(stale_manifest):
+            def _drop_stale():
+                try:
+                    os.remove(stale_manifest)
+                except FileNotFoundError:
+                    pass
+            retry(_drop_stale, policy, op="manifest")
 
     use_async = bool(getattr(engine._config.checkpoint_config, "async_save", False))
     if use_async:
         ckptr = _get_async_checkpointer()
         ckptr.wait_until_finished()           # one in-flight save at a time
+        if inj is not None:
+            inj.before("state_save", path)
         ckptr.save(os.path.join(path, "state"), _flatten_state(state), force=True)
     else:
-        with ocp.PyTreeCheckpointer() as ckptr:
-            ckptr.save(os.path.join(path, "state"), _flatten_state(state), force=True)
+        def _sync_save():
+            if _chaos.active_injector() is not None:
+                _chaos.active_injector().before("state_save", path)
+            with ocp.PyTreeCheckpointer() as c:
+                c.save(os.path.join(path, "state"), _flatten_state(state), force=True)
+
+        if jax.process_count() > 1:
+            # the orbax save is a cross-host collective: re-running it on ONE
+            # host after a local fault would desynchronize the commit barrier
+            # while the other hosts have already passed it — fail uniformly
+            # and let the launcher restart the whole job
+            _sync_save()
+        else:
+            retry(_sync_save, policy, op="state_save")
 
     if jax.process_index() == 0:
+        # sidecar + metadata payloads are hashed IN MEMORY into the per-tag
+        # manifest, so a write that lands corrupt (crash, chaos truncation)
+        # fails verification at load time and the restore walks back
+        manifest_files = {}
         sampler_sd = (engine._data_sampler.state_dict()
                       if getattr(engine, "_data_sampler", None) else None)
         if sampler_sd is not None and isinstance(
@@ -112,8 +170,9 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             # the admitted draw order is O(admitted-samples) int64 — sidecar
             # it as .npy (the reference's on-disk data_cluster files role)
             # instead of bloating client_state.json
-            np.save(os.path.join(path, "data_sampler_admitted.npy"),
-                    sampler_sd.pop("admitted"))
+            buf = io.BytesIO()
+            np.save(buf, sampler_sd.pop("admitted"))
+            manifest_files["data_sampler_admitted.npy"] = buf.getvalue()
             sampler_sd["admitted_file"] = "data_sampler_admitted.npy"
         meta = {
             "tag": tag,
@@ -129,27 +188,52 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             # client_sd): rng + draw order + position → mid-epoch resume
             "data_sampler": sampler_sd,
         }
-        with open(os.path.join(path, "client_state.json"), "w") as f:
-            json.dump(meta, f, default=str)
+        manifest_files["client_state.json"] = json.dumps(
+            meta, default=str).encode("utf-8")
 
-        def _advance_latest():
-            with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
-                f.write(tag)
+        def _finalize():
+            # ordering is the whole point: orbax state has COMMITTED before
+            # this runs → sidecars + client_state → manifest (indexes them)
+            # → 'latest' pointer last. NOTHING lands in the tag dir before
+            # the commit, so a crashed save can never present metadata that
+            # makes a state-less tag look restorable; a crash anywhere
+            # leaves either the previous tag fully intact or this tag
+            # verifiable — never a pointer to a tag that cannot be restored.
+            if "data_sampler_admitted.npy" in manifest_files:
+                atomic_write_bytes(
+                    os.path.join(path, "data_sampler_admitted.npy"),
+                    manifest_files["data_sampler_admitted.npy"],
+                    op="sampler_sidecar", policy=policy)
+            atomic_write_bytes(os.path.join(path, "client_state.json"),
+                               manifest_files["client_state.json"],
+                               op="client_state", policy=policy)
+            write_manifest(path, tag, manifest_files, policy=policy,
+                           advance_latest=save_latest)
+            if save_latest:
+                atomic_write_text(os.path.join(os.path.abspath(save_dir), "latest"),
+                                  tag, op="latest", policy=policy)
 
-        if save_latest and use_async:
-            # the 'latest' pointer must only move AFTER the background write
-            # commits (orbax's atomic rename): otherwise a crash mid-write
-            # strands a restart on a tag whose state/ never materialized
+        if use_async:
+            # the manifest and 'latest' pointer must only land AFTER the
+            # background write commits (orbax's atomic rename): otherwise a
+            # crash mid-write strands a restart on a tag whose state/ never
+            # materialized
             import threading
 
-            t = threading.Thread(
-                target=lambda: (_get_async_checkpointer().wait_until_finished(),
-                                _advance_latest()),
-                daemon=True)
+            def _deferred():
+                try:
+                    _get_async_checkpointer().wait_until_finished()
+                    _finalize()
+                except Exception as e:      # daemon thread: surface, don't die silent
+                    logger.error(f"async checkpoint {tag}: commit/finalize failed "
+                                 f"({e}); 'latest' was not advanced and the tag "
+                                 "may not verify")
+
+            t = threading.Thread(target=_deferred, daemon=True)
             t.start()
             _pending_latest_threads.append(t)
-        elif save_latest:
-            _advance_latest()
+        else:
+            _finalize()
     log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
     return True
 
@@ -202,30 +286,79 @@ def load_inference_params(load_dir: str, abstract_params: Any,
 def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                            load_optimizer_states: bool = True,
                            load_module_only: bool = False):
+    """Verified restore with last-good fallback.
+
+    Candidate tags are tried newest-first (an explicitly requested ``tag``
+    first): each must pass the manifest check (``resilience.verify_on_load``)
+    and then actually restore — orbax exceptions and corrupt metadata demote
+    to the next candidate rather than stranding the run. The 'latest'
+    pointer is a hint, not an authority: a tag whose save died between the
+    state commit and the pointer advance is still found and restored.
+    """
     wait_for_pending_saves()              # an async save may still be writing
     import orbax.checkpoint as ocp
 
-    if tag is None:
-        latest = os.path.join(os.path.abspath(load_dir), "latest")
-        if not os.path.isfile(latest):
-            logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
+    res = getattr(getattr(engine, "_config", None), "resilience", None)
+    verify = res.verify_on_load if res is not None else True
+    fallback = res.fallback_to_last_good if res is not None else True
+
+    # the 'latest' pointer is a hint that candidate_tags deliberately
+    # outranks with any newer committed auto-resume tag
+    # (crash-between-commit-and-advance)
+    candidates = candidate_tags(load_dir, preferred=tag)
+    if tag is not None:
+        # an explicit tag is a contract: restoring a DIFFERENT checkpoint
+        # than the one asked for would be silent wrong-weights corruption —
+        # fail instead of falling back
+        if tag not in candidates:
+            logger.warning(f"checkpoint {_ckpt_dir(load_dir, tag)} not found")
             return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
-    path = _ckpt_dir(load_dir, tag)
-    if not os.path.isdir(path):
-        logger.warning(f"checkpoint {path} not found")
+        candidates = [tag]
+    if not candidates:
+        logger.warning(f"no checkpoint tags in {load_dir}; nothing loaded")
         return None, {}
+    if not fallback:
+        candidates = candidates[:1]
 
     # Restore directly into the engine's current shardings (reshard-on-load).
     abstract = jax.tree.map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         engine.state, engine.state_shardings)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        restored_flat = ckptr.restore(
-            os.path.join(path, "state"),
-            restore_args=ocp.checkpoint_utils.construct_restore_args(_flatten_state(abstract)))
-    restored = _unflatten_like(engine.state, restored_flat)
+    skipped = []
+    for cand in candidates:
+        path = _ckpt_dir(load_dir, cand)
+        if verify:
+            ok, reason = verify_tag(path)
+            if not ok:
+                logger.warning(f"skipping checkpoint {cand!r}: {reason}")
+                skipped.append(cand)
+                continue
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                restored_flat = ckptr.restore(
+                    os.path.join(path, "state"),
+                    restore_args=ocp.checkpoint_utils.construct_restore_args(_flatten_state(abstract)))
+            restored = _unflatten_like(engine.state, restored_flat)
+            meta = {}
+            meta_path = os.path.join(path, "client_state.json")
+            if os.path.isfile(meta_path):
+                with open(meta_path) as f:
+                    meta = json.load(f)
+            sampler_sd = meta.get("data_sampler")
+            if sampler_sd and sampler_sd.get("admitted_file"):
+                sampler_sd["admitted"] = np.load(
+                    os.path.join(path, sampler_sd.pop("admitted_file")))
+        except Exception as e:
+            # half-written orbax dirs, unparseable JSON, truncated sidecars:
+            # everything restore-side demotes to the next-newest candidate
+            logger.warning(f"skipping checkpoint {cand!r}: restore failed ({e})")
+            skipped.append(cand)
+            continue
+        break
+    else:
+        logger.warning(f"no restorable checkpoint in {load_dir} "
+                       f"(tried {candidates}); nothing loaded")
+        return None, {}
 
     if load_module_only or not load_optimizer_states:
         state = engine.state._replace(params=restored.params,
@@ -234,20 +367,12 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         state = restored
     engine.state = state
 
-    meta = {}
-    meta_path = os.path.join(path, "client_state.json")
-    if os.path.isfile(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+    if meta:
         engine.global_samples = meta.get("global_samples", 0)
         engine.micro_steps = meta.get("micro_steps", 0)
         if engine.lr_scheduler is not None and meta.get("lr_scheduler"):
             engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-        sampler_sd = meta.get("data_sampler")
         if sampler_sd:
-            adm_file = sampler_sd.pop("admitted_file", None)
-            if adm_file:
-                sampler_sd["admitted"] = np.load(os.path.join(path, adm_file))
             if getattr(engine, "_data_sampler", None) is not None:
                 engine._data_sampler.load_state_dict(sampler_sd)
             else:
@@ -267,5 +392,8 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         # the jitted step reads θ(t) from the restored state.step; re-sync the
         # host-side reporting mirror so pld_theta() matches it after resume
         pld.update_state(engine._host_step)
-    log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
+    if skipped:
+        log_dist(f"checkpoint fallback: restored {cand!r} after skipping "
+                 f"{skipped} (corrupt/unverified)", ranks=[0])
+    log_dist(f"loaded checkpoint {cand} from {load_dir}", ranks=[0])
     return path, meta.get("client_state", {})

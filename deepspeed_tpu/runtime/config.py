@@ -278,6 +278,53 @@ class ElasticityConfig(DeepSpeedConfigModel):
     prefer_larger_batch_size: bool = True
 
 
+class ResilienceRetryConfig(DeepSpeedConfigModel):
+    """Retry policy for checkpoint-engine filesystem I/O (state writes,
+    sidecars, manifest, 'latest' pointer): exponential backoff + jitter +
+    deadline around OSError-class failures (flaky GCS/NFS)."""
+    enabled: bool = Field(True, description="retry checkpoint I/O on OSError; off = fail fast")
+    max_attempts: int = Field(4, ge=1, description="total tries per operation")
+    base_delay: float = Field(0.05, ge=0.0, description="first backoff sleep (s)")
+    multiplier: float = Field(2.0, ge=1.0, description="backoff growth per attempt")
+    max_delay: float = Field(2.0, ge=0.0, description="backoff ceiling (s)")
+    deadline: float = Field(30.0, gt=0.0, description="give up when the next sleep would cross this wall-clock budget (s)")
+    jitter: float = Field(0.25, ge=0.0, le=1.0, description="±fraction of randomization on each sleep")
+
+
+class ResilienceSentinelConfig(DeepSpeedConfigModel):
+    """Bad-step sentinel (resilience/sentinel.py): after ``patience``
+    consecutive non-finite / overflow-skipped / loss-spike steps, the engine
+    rewinds to the last verified checkpoint instead of burning the job."""
+    enabled: bool = Field(False, description="watch step metrics and rewind on a bad streak (adds one host sync per step)")
+    patience: int = Field(3, ge=1, description="consecutive bad steps before rewinding")
+    spike_factor: float = Field(0.0, ge=0.0, description="also flag loss > factor × recent-good mean (0 = non-finite/overflow only)")
+    window: int = Field(20, ge=2, description="recent-good-loss window for spike detection")
+    max_rewinds: int = Field(2, ge=0, description="rewinds before giving up with BadStepError")
+
+
+class ResilienceChaosConfig(DeepSpeedConfigModel):
+    """Seedable fault injection into checkpoint I/O (resilience/chaos.py) —
+    for recovery drills and tests only; also switchable via the ``DS_CHAOS``
+    env var without touching the config."""
+    enabled: bool = Field(False, description="install the fault injector at engine init")
+    seed: int = Field(0, description="RNG seed — a run's fault pattern reproduces exactly")
+    failure_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-write probability of a raised ChaosError")
+    truncate_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-write probability of silently truncating the payload")
+    delay_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-write probability of an injected delay")
+    max_delay_s: float = Field(0.02, ge=0.0, description="upper bound of an injected delay (s)")
+    ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest); empty = all")
+
+
+class ResilienceConfig(DeepSpeedConfigModel):
+    """Verified checkpoints + recovery policy (resilience/ package). See
+    docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
+    verify_on_load: bool = Field(True, description="check the per-tag manifest (sha256/sizes/commit marker) before restoring")
+    fallback_to_last_good: bool = Field(True, description="on a failed/unverified tag, walk back to the newest tag that passes")
+    retry: ResilienceRetryConfig = {}
+    sentinel: ResilienceSentinelConfig = {}
+    chaos: ResilienceChaosConfig = {}
+
+
 class DeepSpeedConfig:
     """Parsed + validated ds_config. Accepts a dict or a path to a JSON file."""
 
@@ -311,6 +358,7 @@ class DeepSpeedConfig:
         self.data_types_config = DataTypesConfig(**pd.get("data_types", {}))
         self.aio_config = AioConfig(**pd.get("aio", {}))
         self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}))
+        self.resilience = ResilienceConfig(**pd.get("resilience", {}))
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -376,7 +424,7 @@ class DeepSpeedConfig:
         "csv_monitor", "pipeline", "tpu", "checkpoint", "data_types", "aio",
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
-        "autotuning", "optimizer", "scheduler", "gradient_clipping",
+        "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience",
         "steps_per_print", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",

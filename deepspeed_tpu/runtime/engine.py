@@ -327,6 +327,31 @@ class DeepSpeedEngine:
         self.global_samples = 0
         self.gradient_accumulation_steps = lambda: self._config.gradient_accumulation_steps
 
+        # ---- resilience --------------------------------------------------
+        # bad-step sentinel: after K consecutive non-finite/overflow/spike
+        # steps, rewind to the last verified checkpoint instead of burning
+        # the rest of the job (resilience/sentinel.py)
+        res_cfg = self._config.resilience
+        self._bad_step_sentinel = None
+        self._sentinel_rewinds = 0
+        self._ckpt_save_dir = None           # last save/load dir = rewind target
+        if res_cfg.sentinel.enabled:
+            from deepspeed_tpu.resilience.sentinel import BadStepSentinel
+
+            self._bad_step_sentinel = BadStepSentinel(
+                patience=res_cfg.sentinel.patience,
+                spike_factor=res_cfg.sentinel.spike_factor,
+                window=res_cfg.sentinel.window,
+                max_rewinds=res_cfg.sentinel.max_rewinds)
+        from deepspeed_tpu.resilience import chaos as _chaos_mod
+
+        if res_cfg.chaos.enabled:
+            _chaos_mod.install_chaos(_chaos_mod.ChaosInjector.from_config(res_cfg.chaos))
+        else:
+            # don't inherit a previous engine's config-installed drill (env
+            # and manual installs are deliberately left alone)
+            _chaos_mod.uninstall_config_chaos()
+
         # ---- telemetry ---------------------------------------------------
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
         self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
@@ -1221,6 +1246,8 @@ class DeepSpeedEngine:
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
         self._post_step(metrics)
+        if self._bad_step_sentinel is not None:
+            self._check_bad_step(metrics)
         if self.eigenvalue is not None:
             self._maybe_update_eigenvalue(batch)
         self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics.loss)
@@ -1366,6 +1393,8 @@ class DeepSpeedEngine:
         self._last_metrics = metrics
         self.global_samples += self.train_batch_size()
         self._post_step(metrics)
+        if self._bad_step_sentinel is not None:
+            self._check_bad_step(metrics)
         self.timers(STEP_GLOBAL_TIMER).stop(sync_obj=metrics.loss)
 
     def eval_batch(self, batch):
@@ -1405,6 +1434,39 @@ class DeepSpeedEngine:
         if self.monitor.enabled:
             self.monitor.write_events([("Train/Samples/train_loss", float(metrics.loss), self.global_samples),
                                        ("Train/Samples/lr", float(metrics.lr), self.global_samples)])
+
+    def _check_bad_step(self, metrics: StepMetrics):
+        """Bad-step sentinel (resilience.sentinel config block): feed the
+        host-side loss/overflow to the sentinel; when it trips, rewind to the
+        last verified checkpoint (the load path walks back past corrupt tags
+        itself). With no checkpoint to rewind to, or past the rewind budget,
+        raise BadStepError for the elastic agent / launcher to handle."""
+        from deepspeed_tpu.resilience.sentinel import BadStepError
+
+        sentinel = self._bad_step_sentinel
+        if not sentinel.observe(float(metrics.loss), overflow=bool(metrics.overflow)):
+            return
+        reason = sentinel.last_reason
+        if self._ckpt_save_dir is None:
+            raise BadStepError(
+                f"bad-step sentinel tripped ({reason}, patience="
+                f"{sentinel.patience}) and no checkpoint has been saved or "
+                "loaded this run — nothing to rewind to")
+        if self._sentinel_rewinds >= sentinel.max_rewinds:
+            raise BadStepError(
+                f"bad-step sentinel tripped ({reason}) after "
+                f"{self._sentinel_rewinds} rewind(s) — giving up")
+        self._sentinel_rewinds += 1
+        logger.warning(f"bad-step sentinel: {reason} for {sentinel.patience} "
+                       f"consecutive step(s); rewinding to last verified "
+                       f"checkpoint in {self._ckpt_save_dir} "
+                       f"(rewind {self._sentinel_rewinds}/{sentinel.max_rewinds})")
+        path, _ = self.load_checkpoint(self._ckpt_save_dir)
+        if path is None:
+            raise BadStepError(
+                f"bad-step sentinel tripped ({reason}) but no restorable "
+                f"checkpoint was found in {self._ckpt_save_dir}")
+        sentinel.reset()
 
     # ------------------------------------------------------------ accessors
     def curriculum_learning_enabled(self) -> bool:
@@ -1624,6 +1686,7 @@ class DeepSpeedEngine:
                         exclude_frozen_parameters=False):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_checkpoint
 
+        self._ckpt_save_dir = save_dir      # the bad-step sentinel's rewind target
         return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
                                       save_latest=save_latest)
 
@@ -1632,6 +1695,10 @@ class DeepSpeedEngine:
                         load_module_only=False, custom_load_fn=None):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
 
-        return load_engine_checkpoint(self, load_dir, tag=tag,
-                                      load_optimizer_states=load_optimizer_states,
-                                      load_module_only=load_module_only)
+        path, client_state = load_engine_checkpoint(
+            self, load_dir, tag=tag,
+            load_optimizer_states=load_optimizer_states,
+            load_module_only=load_module_only)
+        if path is not None:
+            self._ckpt_save_dir = load_dir  # the bad-step sentinel's rewind target
+        return path, client_state

@@ -1,0 +1,563 @@
+"""Resilience subsystem tests — verified checkpoints with last-good
+fallback, retried I/O, chaos injection, and the bad-step sentinel.
+
+All CPU-only and deterministic: faults come from the seedable injector in
+resilience/chaos.py (or direct on-disk corruption), never from timing. The
+long randomized sweep (test_randomized_chaos_sweep) is listed in
+tests/slow_tests.txt so tier-1 stays fast.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.elasticity import DSElasticAgent
+from deepspeed_tpu.models.simple import SimpleModel
+from deepspeed_tpu.resilience import (BadStepError, BadStepSentinel, ChaosError, ChaosInjector, RestartBackoff,
+                                      RetryPolicy, find_restorable_tag, install_chaos, retry, uninstall_chaos,
+                                      verify_tag)
+from deepspeed_tpu.resilience.manifest import candidate_tags
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+HIDDEN = 16
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_chaos():
+    yield
+    uninstall_chaos()
+
+
+def _engine(resilience=None, async_save=False):
+    comm.cdb = None
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "tpu": {"data": 8},
+           "checkpoint": {"async_save": async_save},
+           "steps_per_print": 0}
+    if resilience is not None:
+        cfg["resilience"] = resilience
+    engine, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=2), config=cfg)
+    return engine
+
+
+def _batch(seed=0, bad=False):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(8, HIDDEN).astype(np.float32)
+    y = rng.randn(8, HIDDEN).astype(np.float32)
+    if bad:
+        x[0, 0] = np.nan
+    return (x, y)
+
+
+FAST_RETRY = {"max_attempts": 3, "base_delay": 0.001, "max_delay": 0.002,
+              "deadline": 5.0}
+
+
+# --------------------------------------------------------------- retry unit
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls = {"n": 0}
+        sleeps = []
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("flaky fs")
+            return "ok"
+
+        out = retry(fn, RetryPolicy(max_attempts=5, base_delay=1.0, jitter=0.0,
+                                    deadline=100.0),
+                    sleep=sleeps.append, clock=lambda: 0.0)
+        assert out == "ok"
+        assert calls["n"] == 3
+        assert sleeps == [1.0, 2.0]      # exponential, jitter disabled
+
+    def test_gives_up_after_max_attempts(self):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise OSError("always down")
+
+        with pytest.raises(OSError, match="always down"):
+            retry(fn, RetryPolicy(max_attempts=3, base_delay=0.0, deadline=None),
+                  sleep=lambda d: None)
+        assert calls["n"] == 3
+
+    def test_gives_up_after_deadline(self):
+        t = {"now": 0.0}
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise OSError("slow fs")
+
+        # delays 1, 2, 4...: the 3rd attempt's sleep would cross the 5s
+        # deadline, so exactly 3 calls happen even with 100 attempts allowed
+        with pytest.raises(OSError, match="slow fs"):
+            retry(fn, RetryPolicy(max_attempts=100, base_delay=1.0, multiplier=2.0,
+                                  max_delay=100.0, jitter=0.0, deadline=5.0),
+                  sleep=lambda d: t.__setitem__("now", t["now"] + d),
+                  clock=lambda: t["now"])
+        assert calls["n"] == 3
+
+    def test_non_retryable_error_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise ValueError("logic bug, not I/O")
+
+        with pytest.raises(ValueError):
+            retry(fn, RetryPolicy(max_attempts=5), sleep=lambda d: None)
+        assert calls["n"] == 1
+
+    def test_restart_backoff_grows_capped_and_resets(self):
+        b = RestartBackoff(base_delay=0.1, multiplier=2.0, max_delay=1.0, jitter=0.0)
+        assert [round(b.next_delay(), 3) for _ in range(5)] == [0.1, 0.2, 0.4, 0.8, 1.0]
+        b.reset()
+        assert round(b.next_delay(), 3) == 0.1
+
+
+# ------------------------------------------------------------ sentinel unit
+class TestSentinelUnit:
+    def test_trips_after_patience_consecutive_bad(self):
+        s = BadStepSentinel(patience=3)
+        s.observe(1.0)                    # one clean step (ends scale warmup)
+        assert not s.observe(float("nan"))
+        assert not s.observe(1.0, overflow=True)
+        assert s.observe(float("inf"))
+        assert s.trips == 1
+
+    def test_loss_scale_warmup_overflows_exempt(self):
+        """A fresh fp16 run overflows for its first steps while the dynamic
+        loss scale settles — that must never trip the sentinel; overflows
+        AFTER the first clean step are real divergence signals."""
+        s = BadStepSentinel(patience=2)
+        for _ in range(10):
+            assert not s.observe(1.0, overflow=True)
+        assert s.trips == 0 and s.bad_streak == 0
+        s.observe(1.0)                    # scale settled
+        assert not s.observe(1.0, overflow=True)
+        assert s.observe(1.0, overflow=True)
+        assert s.trips == 1
+
+    def test_good_step_resets_streak(self):
+        s = BadStepSentinel(patience=2)
+        assert not s.observe(float("nan"))
+        assert not s.observe(0.5)                 # streak broken
+        assert not s.observe(float("nan"))
+        assert s.observe(float("nan"))
+
+    def test_loss_spike_detection(self):
+        s = BadStepSentinel(patience=2, spike_factor=10.0, window=8)
+        for _ in range(4):
+            assert not s.observe(1.0)
+        assert not s.observe(50.0)                # spike 1
+        assert s.observe(50.0)                    # spike 2 → trip
+        assert "spike" in s.last_reason
+
+
+# --------------------------------------------------------------- chaos unit
+class TestChaos:
+    def test_scripted_fail_at_is_exact(self):
+        inj = ChaosInjector(fail_at={"latest": [2]})
+        inj.before("latest", "p")                 # call 1: fine
+        with pytest.raises(ChaosError):
+            inj.before("latest", "p")             # call 2: injected
+        inj.before("latest", "p")                 # call 3: fine again
+        inj.before("client_state", "p")           # other ops untouched
+
+    def test_seed_reproduces_fault_pattern(self):
+        def pattern(seed):
+            inj = ChaosInjector(seed=seed, failure_rate=0.5)
+            out = []
+            for _ in range(20):
+                try:
+                    inj.before("latest", "p")
+                    out.append(0)
+                except ChaosError:
+                    out.append(1)
+            return out
+
+        assert pattern(7) == pattern(7)
+        assert pattern(7) != pattern(8)
+
+    def test_truncation_shortens_payload(self):
+        inj = ChaosInjector(truncate_at={"client_state": [1]})
+        inj.before("client_state", "p")
+        data = b"x" * 100
+        assert len(inj.corrupt("client_state", "p", data)) < 100
+        inj.before("client_state", "p")
+        assert inj.corrupt("client_state", "p", data) == data  # only call 1
+
+    def test_env_spec_parsing(self):
+        inj = ChaosInjector.from_env("seed=3,failure_rate=0.5,ops=latest+manifest")
+        assert inj.seed == 3
+        assert inj.failure_rate == 0.5
+        assert inj.ops == {"latest", "manifest"}
+
+
+# ------------------------------------------------------------ config block
+def test_resilience_config_parses_and_rejects_unknown():
+    c = DeepSpeedConfig({"train_batch_size": 8,
+                         "resilience": {"verify_on_load": False,
+                                        "retry": {"max_attempts": 2},
+                                        "sentinel": {"enabled": True, "patience": 5}}})
+    assert not c.resilience.verify_on_load
+    assert c.resilience.retry.max_attempts == 2
+    assert c.resilience.sentinel.patience == 5
+    with pytest.raises(Exception):
+        DeepSpeedConfig({"train_batch_size": 8, "resilience": {"bogus_knob": 1}})
+
+
+# ----------------------------------------- restorable-tag detection (no engine)
+def test_has_checkpoint_requires_restorable_tag(tmp_path):
+    save = tmp_path / "ckpt"
+    save.mkdir()
+    agent = DSElasticAgent(lambda: None, str(save), install_signal_handlers=False)
+    assert not agent._has_checkpoint()            # empty dir
+
+    (save / "latest").write_text("global_step5")  # dangling pointer
+    (save / "stray.txt").write_text("junk")
+    assert not agent._has_checkpoint()            # non-empty but nothing loadable
+
+    tag = save / "global_step5"
+    tag.mkdir()
+    (tag / "client_state.json").write_text("{}")
+    assert not agent._has_checkpoint()            # half-written: state never committed
+
+    st = tag / "state"
+    st.mkdir()
+    (st / "_CHECKPOINT_METADATA").write_text("{}")
+    assert agent._has_checkpoint()                # committed (pre-manifest layout)
+
+    # an explicit tag is a contract: another restorable tag existing must
+    # not make the agent claim (and then fail/skip) a resume of THIS tag
+    tagged = DSElasticAgent(lambda: None, str(save), tag="ckpt",
+                            install_signal_handlers=False)
+    assert not tagged._has_checkpoint()           # 'ckpt' itself isn't there
+
+
+def _premanifest_orbax_tag(save, name):
+    tag = save / name
+    (tag / "state").mkdir(parents=True)
+    (tag / "state" / "_CHECKPOINT_METADATA").write_text("{}")
+    (tag / "client_state.json").write_text("{}")
+    return tag
+
+
+def test_premanifest_side_tag_does_not_outrank_latest(tmp_path):
+    """Upgrade path: tags from before the manifest era carry no
+    advance_latest intent, so a non-numeric side snapshot with a newer
+    mtime must not beat the tag the 'latest' pointer names — only a tag
+    with a provably greater step (crash-before-advance) outranks it."""
+    save = tmp_path / "ckpt"
+    save.mkdir()
+    _premanifest_orbax_tag(save, "global_step100")
+    (save / "latest").write_text("global_step100")
+    _premanifest_orbax_tag(save, "best")          # newer mtime, no step
+    assert candidate_tags(str(save))[0] == "global_step100"
+    _premanifest_orbax_tag(save, "global_step101")  # newer committed work
+    assert candidate_tags(str(save))[0] == "global_step101"
+
+
+def test_non_orbax_layout_accepted(tmp_path):
+    """ZeRO-Infinity-style snapshots (swap files + shared.npz, no orbax
+    state/ tree) must still count as restorable for the elastic agent."""
+    save = tmp_path / "ckpt"
+    save.mkdir()
+    tag = save / "global_step3"
+    tag.mkdir()
+    (tag / "client_state.json").write_text('{"global_steps": 3}')
+    (tag / "shared.npz").write_bytes(b"\x93NUMPY")
+    (tag / "layer_0.swp").write_bytes(b"\x00" * 8)
+    ok, reason = verify_tag(str(tag))
+    assert ok, reason
+    agent = DSElasticAgent(lambda: None, str(save), install_signal_handlers=False)
+    assert agent._has_checkpoint()
+
+
+# --------------------------------------------- verified save/load round trips
+@pytest.mark.chaos
+class TestVerifiedCheckpoint:
+    def test_save_writes_manifest_and_latest_last(self, tmp_path):
+        engine = _engine()
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save)
+        tag_dir = os.path.join(save, "global_step1")
+        ok, reason = verify_tag(tag_dir)
+        assert ok, reason
+        with open(os.path.join(tag_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert "client_state.json" in manifest["files"]
+        assert any(k.startswith("state/") for k in manifest["state_files"])
+        with open(os.path.join(save, "latest")) as f:
+            assert f.read().strip() == "global_step1"
+
+    def test_async_save_finalizes_manifest_after_commit(self, tmp_path):
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        engine = _engine(async_save=True)
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save)
+        wait_for_pending_saves()
+        ok, reason = verify_tag(os.path.join(save, "global_step1"))
+        assert ok, reason
+        assert find_restorable_tag(save) == "global_step1"
+
+    def test_corrupt_sidecar_falls_back_to_previous_tag(self, tmp_path):
+        engine = _engine()
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save)              # global_step1, clean
+        engine.train_batch(_batch(1))
+        engine.save_checkpoint(save)              # global_step2
+        # corrupt the newest tag's metadata on disk (bit-rot / torn write)
+        meta = os.path.join(save, "global_step2", "client_state.json")
+        with open(meta, "r+b") as f:
+            f.truncate(max(1, os.path.getsize(meta) // 2))
+        ok, reason = verify_tag(os.path.join(save, "global_step2"))
+        assert not ok and "client_state.json" in reason
+        path, _ = engine.load_checkpoint(save)
+        assert path is not None and path.endswith("global_step1")
+        assert int(engine.state.step) == 1
+
+    def test_chaos_truncated_write_caught_at_load(self, tmp_path):
+        engine = _engine(resilience={"retry": FAST_RETRY})
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save)              # global_step1, clean
+        engine.train_batch(_batch(1))
+        # the 1st client_state write of the next save lands truncated — a
+        # SILENT fault: the save itself reports success
+        install_chaos(ChaosInjector(truncate_at={"client_state": [1]}))
+        engine.save_checkpoint(save)              # global_step2, corrupt
+        uninstall_chaos()
+        assert find_restorable_tag(save) == "global_step1"
+        path, _ = engine.load_checkpoint(save)
+        assert path.endswith("global_step1")
+        assert int(engine.state.step) == 1
+
+    def test_crash_between_state_commit_and_latest_advance(self, tmp_path):
+        engine = _engine(resilience={"retry": FAST_RETRY})
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save)              # global_step1: latest → step1
+        engine.train_batch(_batch(1))
+        # every attempt at the 'latest' advance fails → save raises AFTER the
+        # state committed and the manifest was written (the crash window)
+        install_chaos(ChaosInjector(fail_at={"latest": range(1, 20)}))
+        with pytest.raises(OSError):
+            engine.save_checkpoint(save)
+        uninstall_chaos()
+        with open(os.path.join(save, "latest")) as f:
+            assert f.read().strip() == "global_step1"   # pointer never moved
+        # the newest tag still verifies and wins over the stale pointer: the
+        # interrupted save costs nothing
+        assert find_restorable_tag(save) == "global_step2"
+        path, _ = engine.load_checkpoint(save)
+        assert path.endswith("global_step2")
+        assert int(engine.state.step) == 2
+
+    def test_side_checkpoint_does_not_hijack_resume(self, tmp_path):
+        engine = _engine()
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save)              # global_step1, auto-resume tag
+        engine.train_batch(_batch(1))
+        # deliberate side save: newer, but must never win an automatic resume
+        engine.save_checkpoint(save, tag="side_eval", save_latest=False)
+        path, _ = engine.load_checkpoint(save)
+        assert path.endswith("global_step1")
+        path, _ = engine.load_checkpoint(save, tag="side_eval")
+        assert path.endswith("side_eval")         # explicit request still honored
+        assert int(engine.state.step) == 2
+        # a side tag is NEVER an auto-resume candidate — not even as a last
+        # resort once every auto-resume tag is gone (restoring a deliberate
+        # side snapshot unasked would be silent wrong-weights substitution)
+        import shutil
+        shutil.rmtree(os.path.join(save, "global_step1"))
+        assert candidate_tags(save) == []
+        assert find_restorable_tag(save) is None
+        path, _ = engine.load_checkpoint(save, tag="side_eval")
+        assert path.endswith("side_eval")
+
+    def test_named_latest_tag_wins_auto_resume(self, tmp_path):
+        """A non-numeric tag named by the 'latest' pointer must not be
+        demoted below older global_stepN tags just because no step parses
+        from its name."""
+        engine = _engine()
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save)              # global_step1
+        engine.train_batch(_batch(1))
+        engine.save_checkpoint(save, tag="best")  # newest; latest → 'best'
+        assert candidate_tags(save)[0] == "best"
+        path, _ = engine.load_checkpoint(save)
+        assert path.endswith("best")
+        assert int(engine.state.step) == 2
+
+    def test_resave_same_tag_drops_stale_manifest(self, tmp_path):
+        """Re-saving to a fixed tag drops the previous save's manifest up
+        front: a crash mid-overwrite must degrade to the pre-manifest
+        acceptance, not fail verification against mixed generations."""
+        engine = _engine(resilience={"retry": FAST_RETRY})
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save, tag="ckpt")
+        engine.train_batch(_batch(1))
+        # the re-save writes the new client_state but dies at the manifest:
+        # the OLD manifest would have hash-rejected the new client_state
+        install_chaos(ChaosInjector(fail_at={"manifest": range(1, 20)}))
+        with pytest.raises(OSError):
+            engine.save_checkpoint(save, tag="ckpt")
+        uninstall_chaos()
+        tag_dir = os.path.join(save, "ckpt")
+        assert not os.path.isfile(os.path.join(tag_dir, "manifest.json"))
+        ok, reason = verify_tag(tag_dir)
+        assert ok, reason                          # compat acceptance
+        path, _ = engine.load_checkpoint(save, tag="ckpt")
+        assert path is not None and path.endswith("ckpt")
+
+    def test_chaos_failed_state_write_leaves_run_restorable(self, tmp_path):
+        engine = _engine(resilience={"retry": FAST_RETRY})
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save)              # global_step1, clean
+        engine.train_batch(_batch(1))
+        install_chaos(ChaosInjector(fail_at={"state_save": range(1, 20)}))
+        with pytest.raises(OSError):
+            engine.save_checkpoint(save)          # dies before any commit
+        uninstall_chaos()
+        assert find_restorable_tag(save) == "global_step1"
+        path, _ = engine.load_checkpoint(save)
+        assert path.endswith("global_step1")
+
+
+# ------------------------------------------------------- bad-step sentinel
+class TestSentinelInEngine:
+    def test_rewinds_after_k_bad_steps(self, tmp_path):
+        engine = _engine(resilience={"sentinel": {"enabled": True, "patience": 2,
+                                                  "max_rewinds": 2}})
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.train_batch(_batch(1))
+        engine.save_checkpoint(save)
+        assert int(engine.state.step) == 2
+        engine.train_batch(_batch(2, bad=True))   # streak 1 (step skipped, counter advances)
+        engine.train_batch(_batch(3, bad=True))   # streak 2 → rewind
+        assert int(engine.state.step) == 2        # back at the checkpoint
+        assert engine._sentinel_rewinds == 1
+        loss = engine.train_batch(_batch(4))      # training continues cleanly
+        assert np.isfinite(float(loss))
+        assert int(engine.state.step) == 3
+
+    def test_raises_without_any_checkpoint(self):
+        engine = _engine(resilience={"sentinel": {"enabled": True, "patience": 1}})
+        with pytest.raises(BadStepError, match="nothing to rewind"):
+            engine.train_batch(_batch(bad=True))
+
+    def test_gives_up_after_max_rewinds(self, tmp_path):
+        engine = _engine(resilience={"sentinel": {"enabled": True, "patience": 1,
+                                                  "max_rewinds": 1}})
+        save = str(tmp_path / "ck")
+        engine.train_batch(_batch())
+        engine.save_checkpoint(save)
+        engine.train_batch(_batch(1, bad=True))   # trip 1 → rewind
+        assert engine._sentinel_rewinds == 1
+        with pytest.raises(BadStepError, match="giving up"):
+            engine.train_batch(_batch(2, bad=True))   # trip 2 → budget spent
+
+
+# ------------------------------------------------- elastic agent integration
+def test_agent_surfaces_restart_reasons(tmp_path):
+    attempts = {"n": 0}
+
+    def flaky_batches():
+        attempts["n"] += 1
+        first = attempts["n"] == 1
+        for i in range(1000):
+            if first and i == 2:
+                raise RuntimeError("injected step failure")
+            yield _batch(i % 4)
+
+    def factory():
+        return _engine()
+
+    agent = DSElasticAgent(factory, str(tmp_path / "ckpt"),
+                           checkpoint_interval=1, max_restarts=2,
+                           install_signal_handlers=False,
+                           restart_backoff=RestartBackoff(base_delay=0.0, jitter=0.0))
+    out = agent.run(flaky_batches, num_steps=4)
+    assert out["status"] == "complete"
+    assert out["restarts"] == 1
+    assert len(out["restart_reasons"]) == 1
+    assert "injected step failure" in out["restart_reasons"][0]
+    assert out["restart_log"][0]["restart"] == 1
+    assert out["restart_log"][0]["backoff_s"] == 0.0
+    # a healthy checkpoint interval after the restart ends the incident:
+    # the escalated delay must not carry over to the next unrelated failure
+    assert agent.restart_backoff.attempt == 0
+
+
+def test_agent_accounts_for_sentinel_rewind(tmp_path):
+    """A sentinel rewind inside train_batch moves the engine's step counter
+    backwards; the agent must follow it and keep training until num_steps
+    are ACTUALLY trained, not until its own batch count runs out."""
+    def batches():
+        yield _batch(0)
+        yield _batch(1)
+        yield _batch(2, bad=True)        # nan loss → sentinel trips → rewind
+        for i in range(100):
+            yield _batch(3 + i)
+
+    def factory():
+        return _engine(resilience={"sentinel": {"enabled": True, "patience": 1,
+                                                "max_rewinds": 2}})
+
+    agent = DSElasticAgent(factory, str(tmp_path / "ckpt"),
+                           checkpoint_interval=1, max_restarts=0,
+                           install_signal_handlers=False)
+    out = agent.run(batches, num_steps=4)
+    assert out["status"] == "complete"
+    assert out["final_step"] == 4        # rewound step was re-trained
+    assert agent.engine._sentinel_rewinds == 1
+
+
+# ---------------------------------------------------- randomized chaos sweep
+@pytest.mark.chaos
+def test_randomized_chaos_sweep(tmp_path):
+    """Game-day: random write failures/truncations/delays across repeated
+    saves must NEVER leave the run unrestorable — load always lands on a tag
+    that verifies. Long; listed in tests/slow_tests.txt (tier-2)."""
+    engine = _engine(resilience={"retry": {"max_attempts": 2, "base_delay": 0.001,
+                                           "max_delay": 0.002, "deadline": 2.0}})
+    for seed in range(6):
+        save = str(tmp_path / f"sweep{seed}")
+        engine.train_batch(_batch(seed))
+        engine.save_checkpoint(save)              # clean baseline tag
+        install_chaos(ChaosInjector(seed=seed, failure_rate=0.15,
+                                    truncate_rate=0.25, delay_rate=0.1,
+                                    max_delay_s=0.005))
+        for i in range(3):
+            engine.train_batch(_batch(seed * 10 + i))
+            try:
+                engine.save_checkpoint(save)
+            except OSError:
+                pass                              # an injected unrecoverable fault
+        uninstall_chaos()
+        tag = find_restorable_tag(save)
+        assert tag is not None, f"seed {seed}: no restorable tag in {candidate_tags(save)}"
+        path, _ = engine.load_checkpoint(save)
+        assert path is not None and path.endswith(tag), \
+            f"seed {seed}: loaded {path}, expected tag {tag}"

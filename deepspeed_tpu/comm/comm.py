@@ -41,6 +41,10 @@ from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.parallel.topology import (ALL_AXES, DP_AXES, build_mesh)
 from deepspeed_tpu.utils.logging import log_dist, logger
 
+# jax.shard_map graduated from jax.experimental in 0.5; the shared compat
+# shim (utils.shard_map_compat) maps the modern spelling back on old jax
+from deepspeed_tpu.utils import shard_map_compat as _shard_map
+
 
 class ReduceOp:
     """cf. reference comm/comm.py:33."""
@@ -219,34 +223,91 @@ def new_group(axes: AxisName) -> CommGroup:
 # --------------------------------------------------------------------------- #
 # comms logging (reference utils/comms_logging.py + timed_op comm.py:104)
 # --------------------------------------------------------------------------- #
+def _busbw_factor(op_name: str, n: int) -> float:
+    """Bus-bandwidth correction (reference utils/comms_logging.py get_bw):
+    what the interconnect actually moved per link, vs the algorithmic bytes.
+    ``n`` = group size; n<=1 means no wire traffic at all."""
+    if n <= 1:
+        return 1.0
+    if "all_reduce" in op_name or "inference_all_reduce" in op_name:
+        return 2.0 * (n - 1) / n
+    if ("all_gather" in op_name or "reduce_scatter" in op_name
+            or "all_to_all" in op_name):
+        return (n - 1) / n
+    return 1.0
+
+
 class CommsLogger:
+    STRAGGLER_WINDOW = 64       # recent-latency window per (op, size)
+    STRAGGLER_SKEW = 3.0        # max/mean ratio that flags a straggler
+
     def __init__(self, verbose=False, debug=False, prof_all=True, prof_ops=None):
         self.verbose = verbose
         self.debug = debug
         self.prof_all = prof_all
         self.prof_ops = prof_ops or []
         self.comms_dict = {}
+        # (raw_name, msg_size) -> deque of the last STRAGGLER_WINDOW latencies
+        self._recent = {}
 
-    def append(self, raw_name, record_name, latency, msg_size):
+    def append(self, raw_name, record_name, latency, msg_size, n=1):
         entry = self.comms_dict.setdefault(raw_name, {})
+        # per-size record: [count, latencies, algo GB/s, bus GB/s] — same
+        # 4-slot layout as the reference's comms_dict
         sizes = entry.setdefault(msg_size, [0, [], [], []])
-        n = sizes[0] + 1
-        sizes[0] = n
+        sizes[0] += 1
         sizes[1].append(latency)
-        # algo bandwidth GB/s; bus bw left to log analysis
         if latency > 0:
-            sizes[2].append(msg_size / latency / 1e9)
+            algbw = msg_size / latency / 1e9
+            sizes[2].append(algbw)
+            sizes[3].append(algbw * _busbw_factor(raw_name, n))
+        key = (raw_name, msg_size)
+        recent = self._recent.get(key)
+        if recent is None:
+            from collections import deque
+
+            self._recent[key] = recent = deque(maxlen=self.STRAGGLER_WINDOW)
+        recent.append(latency)
         if self.verbose:
             log_dist(f"comm op: {record_name} | msg size: {msg_size} | latency(ms): {latency*1000:.2f}", ranks=[0])
+
+    def straggler_report(self):
+        """Per-(op, size) max-vs-mean latency skew over the recent window.
+
+        Deviation from the reference (which diffs wall-clocks ACROSS ranks
+        under a barrier): XLA collectives rendezvous internally, so a slow
+        participant stretches everyone's latency — skew across the recent
+        TIME window of the same op exposes the same intermittent straggler
+        without adding barriers. Returns [(op, size, n, mean, max, skew)].
+        """
+        rows = []
+        for (op, size), lats in sorted(self._recent.items()):
+            if not lats:
+                continue
+            mean = sum(lats) / len(lats)
+            worst = max(lats)
+            rows.append((op, size, len(lats), mean, worst,
+                         worst / mean if mean > 0 else 0.0))
+        return rows
 
     def log_all(self, print_log=True, show_straggler=False):
         lines = ["Comms summary:"]
         for op, per_size in self.comms_dict.items():
-            for size, (count, lats, bws, _) in sorted(per_size.items()):
+            for size, (count, lats, bws, busbws) in sorted(per_size.items()):
                 avg_lat = sum(lats) / max(1, len(lats))
                 avg_bw = sum(bws) / max(1, len(bws)) if bws else 0.0
+                avg_busbw = sum(busbws) / max(1, len(busbws)) if busbws else 0.0
                 lines.append(f"  {op:26s} size={size:>12d} count={count:>6d} "
-                             f"avg_lat={avg_lat*1e3:8.3f}ms algo_bw={avg_bw:8.2f}GB/s")
+                             f"avg_lat={avg_lat*1e3:8.3f}ms algo_bw={avg_bw:8.2f}GB/s "
+                             f"bus_bw={avg_busbw:8.2f}GB/s")
+        if show_straggler:
+            lines.append(f"Straggler skew (max vs mean latency, last "
+                         f"{self.STRAGGLER_WINDOW} calls per op/size):")
+            for op, size, cnt, mean, worst, skew in self.straggler_report():
+                flag = "  <-- straggler" if skew >= self.STRAGGLER_SKEW and cnt >= 4 else ""
+                lines.append(f"  {op:26s} size={size:>12d} window={cnt:>4d} "
+                             f"mean={mean*1e3:8.3f}ms max={worst*1e3:8.3f}ms "
+                             f"skew={skew:5.2f}x{flag}")
         if print_log:
             log_dist("\n".join(lines), ranks=[0])
         return self.comms_dict
@@ -273,15 +334,42 @@ def _nbytes(x) -> int:
 
 
 def timed_op(func):
+    """Time eager collectives into the comms logger AND the telemetry
+    histograms (per-op / per-size latency + bytes). In-trace calls pass
+    through untouched — XLA owns that timing (comm.py:104 role)."""
+    import inspect
+
+    # position of `group` in the wrapped signature varies per collective
+    # (all_reduce: 3rd, all_gather: 2nd, ...) — resolve it once so a
+    # positionally-passed group still yields the right bus-bw group size
+    params = list(inspect.signature(func).parameters)
+    group_idx = params.index("group") - 1 if "group" in params else None
+
     @functools.wraps(func)
     def wrapper(tensor, *args, **kwargs):
-        if comms_logger is None or isinstance(tensor, jax.core.Tracer):
+        from deepspeed_tpu import telemetry
+
+        registry = telemetry.get_registry()
+        if ((comms_logger is None and not registry.enabled)
+                or isinstance(tensor, jax.core.Tracer)):
             return func(tensor, *args, **kwargs)
         t0 = time.time()
         result = func(tensor, *args, **kwargs)
         jax.block_until_ready(result)
-        comms_logger.append(func.__name__, kwargs.get("log_name", func.__name__),
-                            time.time() - t0, _nbytes(tensor))
+        latency = time.time() - t0
+        size = _nbytes(tensor)
+        group = kwargs.get("group")
+        if group is None and group_idx is not None and group_idx < len(args):
+            group = args[group_idx]
+        n = get_world_size(group)
+        if comms_logger is not None:
+            comms_logger.append(func.__name__, kwargs.get("log_name", func.__name__),
+                                latency, size, n=n)
+        if registry.enabled:
+            registry.histogram("comm/op_latency_seconds",
+                               labels={"op": func.__name__, "size": str(size)}).observe(latency)
+            registry.counter("comm/op_calls", labels={"op": func.__name__}).inc()
+            registry.counter("comm/op_bytes", labels={"op": func.__name__}).inc(size)
         return result
 
     return wrapper
@@ -317,8 +405,8 @@ def _eager_shard_map(fn, group, x, extra_leading_out: bool = False):
     spec = P(axes)
     in_spec = P(axes, *([None] * (x.ndim - 1)))
     out_first = axes if extra_leading_out else None
-    shard_fn = jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
-                             out_specs=P(out_first, *([None] * (x.ndim - 1))))
+    shard_fn = _shard_map(fn, mesh=mesh, in_specs=in_spec,
+                          out_specs=P(out_first, *([None] * (x.ndim - 1))))
     return jax.jit(shard_fn)(x)
 
 
@@ -366,7 +454,9 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, async_op: bool = Fals
 
 @timed_op
 def inference_all_reduce(tensor, op=ReduceOp.SUM, group=None, log_name="inference_all_reduce"):
-    return all_reduce(tensor, op=op, group=group)
+    # the UNdecorated all_reduce: nesting two timed_op wrappers would log the
+    # same wire traffic under both op names (and sync twice)
+    return all_reduce.__wrapped__(tensor, op=op, group=group)
 
 
 @timed_op

@@ -225,6 +225,9 @@ class DSElasticAgent:
                                  "launcher to restart the whole job")
                     raise
                 self.restart_count += 1
+                from deepspeed_tpu import telemetry
+
+                telemetry.get_registry().counter("resilience/elastic_restarts").inc()
                 delay = self.restart_backoff.next_delay()
                 self.restart_log.append({
                     "restart": self.restart_count,

@@ -21,6 +21,7 @@ ids, cache) → (logits, cache), decode_step(params, token, cache) →
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -29,6 +30,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu import comm as dist
+from deepspeed_tpu import telemetry as _telemetry
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.parallel.topology import build_mesh
 from deepspeed_tpu.utils.logging import log_dist
@@ -58,19 +60,50 @@ def build_generate_fn(module, max_new_tokens: int, do_sample: bool,
     """The jittable prefill + scan-decode generation program, shared by
     InferenceEngine.generate and DeepSpeedHybridEngine.generate.
     ``param_transform`` preprocesses the param tree inside the trace (e.g.
-    the training engine's host-offload stream-in)."""
-    eos = -1 if eos_token_id is None else int(eos_token_id)
+    the training engine's host-offload stream-in). Composed from
+    ``build_generate_parts`` (ONE source of the generation logic, so the
+    fused fast path and the observed split path cannot diverge), with the
+    transform hoisted so it runs once in the single program."""
+    prefill, decode = build_generate_parts(
+        module, max_new_tokens, do_sample, temperature, top_k, top_p,
+        eos_token_id, param_transform=None)
 
     def gen(params, ids, rng):
         if param_transform is not None:
             params = param_transform(params)
+        logits, cache = prefill(params, ids)
+        return decode(params, ids, logits, cache, rng)
+
+    return gen
+
+
+def build_generate_parts(module, max_new_tokens: int, do_sample: bool,
+                         temperature: float, top_k: int, top_p: float,
+                         eos_token_id: Optional[int], param_transform=None):
+    """Generation split at the prefill/decode boundary so the host can
+    observe TTFT (time to first token) and the decode tail separately —
+    the two numbers that define serving latency. Used directly when
+    telemetry or ``profile_model_time`` is active; ``build_generate_fn``
+    composes the same two pieces into the fused single-program fast path.
+    ``param_transform`` (dequant / offload stream-in) runs inside each
+    program, so numerics match the fused path exactly."""
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    def prefill(params, ids):
+        if param_transform is not None:
+            params = param_transform(params)
         B, T = ids.shape
-        max_len = T + max_new_tokens
-        cache = module.init_cache(B, max_len)
+        cache = module.init_cache(B, T + max_new_tokens)
         if hasattr(module, "cache_partition_specs"):
             cache = jax.lax.with_sharding_constraint(
                 cache, module.cache_partition_specs())
         logits, cache = module.prefill(params, ids, cache)
+        return logits, cache
+
+    def decode(params, ids, logits, cache, rng):
+        if param_transform is not None:
+            params = param_transform(params)
+        B = ids.shape[0]
 
         def step(carry, _):
             logits, cache, done, rng = carry
@@ -87,7 +120,7 @@ def build_generate_fn(module, max_new_tokens: int, do_sample: bool,
                                None, length=max_new_tokens)
         return jnp.concatenate([ids, toks.T.astype(ids.dtype)], axis=1)
 
-    return gen
+    return prefill, decode
 
 
 class InferenceEngine:
@@ -213,6 +246,8 @@ class InferenceEngine:
                          f"{quantized_nbytes(self.params)/1e6:.1f}MB "
                          f"(int{bits})", ranks=[0])
         self._compiled = {}
+        self._model_profile_enabled = False
+        self._model_times = []
         ep_tag = f", ep={self.ep_world_size}" if self.ep_world_size > 1 else ""
         log_dist(f"InferenceEngine ready: dtype={jnp.dtype(self.dtype).name}, "
                  f"tp={self.mp_world_size}{ep_tag}", ranks=[0])
@@ -239,8 +274,13 @@ class InferenceEngine:
                 return jnp.asarray(np.asarray(a))
 
         xs = [to_dev(a) for a in (input_ids, *args)]
+        t0 = time.perf_counter()
         with self.mesh:
-            return self._compiled[key](self.params, *xs)
+            out = self._compiled[key](self.params, *xs)
+        if self._model_profile_enabled:
+            jax.block_until_ready(out)
+            self._model_times.append(time.perf_counter() - t0)
+        return out
 
     __call__ = forward
 
@@ -260,22 +300,84 @@ class InferenceEngine:
         if max_len > self._config.max_out_tokens:
             raise ValueError(f"sequence {max_len} exceeds max_out_tokens "
                              f"{self._config.max_out_tokens} (reference engine raises too)")
-        # B and T are NOT in the key: jit re-specializes per input shape, and
-        # gen derives them from ids inside the trace.
-        key = ("gen", max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
+        rng = jax.random.PRNGKey(seed)
+        session = _telemetry.get_session()
+        observed = self._model_profile_enabled or (
+            session is not None and session.cfg.inference)
+        if not observed:
+            # fast path: ONE compiled program (prefill + scan decode), no
+            # host round-trip between first token and decode
+            # B and T are NOT in the key: jit re-specializes per input shape,
+            # and gen derives them from ids inside the trace.
+            key = ("gen", max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
+            if key not in self._compiled:
+                self._compiled[key] = jax.jit(build_generate_fn(
+                    self.module, max_new_tokens, do_sample, temperature, top_k,
+                    top_p, eos_token_id, param_transform=self._dequant))
+            with self.mesh:
+                return self._compiled[key](self.params, ids, rng)
+        return self._generate_observed(ids, rng, max_new_tokens, do_sample,
+                                       temperature, top_k, top_p, eos_token_id)
+
+    def _generate_observed(self, ids, rng, max_new_tokens, do_sample,
+                           temperature, top_k, top_p, eos_token_id):
+        """Two-program generation (prefill | scan decode) with a host sync at
+        the boundary: TTFT and per-token decode latency become observable.
+        The extra sync costs one dispatch gap per request — the price of
+        measuring, only paid when telemetry or profile_model_time asks."""
+        key = ("gen2", max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
         if key not in self._compiled:
-            self._compiled[key] = jax.jit(build_generate_fn(
+            pf, df = build_generate_parts(
                 self.module, max_new_tokens, do_sample, temperature, top_k,
-                top_p, eos_token_id, param_transform=self._dequant))
+                top_p, eos_token_id, param_transform=self._dequant)
+            self._compiled[key] = (jax.jit(pf), jax.jit(df))
+        pf, df = self._compiled[key]
+        tracer = _telemetry.get_tracer()
+        t0 = time.perf_counter()
         with self.mesh:
-            return self._compiled[key](self.params, ids, jax.random.PRNGKey(seed))
+            with tracer.span("prefill", cat="inference", tokens=int(ids.shape[1])):
+                logits, cache = pf(self.params, ids)
+                jax.block_until_ready(logits)
+            ttft = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            with tracer.span("decode", cat="inference", tokens=int(max_new_tokens)):
+                out = df(self.params, ids, logits, cache, rng)
+                jax.block_until_ready(out)
+            decode_s = time.perf_counter() - t1
+        total = time.perf_counter() - t0
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            B = int(ids.shape[0])
+            reg.counter("inference/requests").inc(B)
+            reg.counter("inference/generated_tokens").inc(B * int(max_new_tokens))
+            reg.histogram("inference/ttft_seconds").observe(ttft)
+            reg.histogram("inference/decode_per_token_seconds").observe(
+                decode_s / max(1, int(max_new_tokens)))
+            reg.histogram("inference/request_seconds").observe(total)
+        if self._model_profile_enabled:
+            self._model_times.append(total)
+        return out
 
     # -------------------------------------------------------------- DS parity
     def _create_model_parallel_group(self):
         return dist.new_group(("tensor",))
 
     def profile_model_time(self, use_cuda_events: bool = False):
-        pass
+        """Record per-request model time (reference engine.py:277 stores
+        ``_model_times`` for ``model_times()``). ``use_cuda_events`` is
+        accepted for parity; on TPU the sync is ``block_until_ready``.
+        Also switches generate() onto the split prefill/decode path, so
+        TTFT/decode show up in telemetry when a session is active."""
+        self._model_profile_enabled = True
+        self._model_times = []
+
+    def model_times(self):
+        """Drain and return the list of per-request model times (seconds)."""
+        assert self._model_profile_enabled, \
+            "model_times() requires profile_model_time() first (reference contract)"
+        times = self._model_times
+        self._model_times = []
+        return times
 
     @property
     def mp_group(self):

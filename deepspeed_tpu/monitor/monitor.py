@@ -18,10 +18,14 @@ from deepspeed_tpu.utils.logging import logger
 
 
 class Monitor:
+    """ABC. ``write_events(event_list, flush=True)`` is the one method —
+    every subclass takes the same signature (``flush`` batches writes when
+    the caller will flush itself later, e.g. the telemetry exporter)."""
+
     def __init__(self, config):
         self.monitor_config = config
 
-    def write_events(self, event_list: List[Tuple]):
+    def write_events(self, event_list: List[Tuple], flush: bool = True):
         raise NotImplementedError
 
 
@@ -63,7 +67,9 @@ class WandbMonitor(Monitor):
                 logger.warning(f"wandb unavailable: {e}")
                 self.enabled = False
 
-    def write_events(self, event_list):
+    def write_events(self, event_list, flush=True):
+        # wandb batches/uploads on its own schedule; flush is accepted for
+        # signature parity and ignored
         if not self.enabled:
             return
         for tag, value, step in event_list:
@@ -71,26 +77,54 @@ class WandbMonitor(Monitor):
 
 
 class csvMonitor(Monitor):
+    """One CSV file per tag. Handles are opened once and cached — the old
+    open-per-event pattern paid an open/close syscall pair per scalar per
+    step, which on a network filesystem dominated the write itself."""
+
     def __init__(self, config):
         super().__init__(config)
         self.enabled = config.enabled and jax.process_index() == 0
-        self.filenames = {}
+        self.filenames = {}          # fname -> True (kept: the tag inventory)
+        self._files = {}             # fname -> (handle, csv.writer)
         if self.enabled:
             self.log_dir = os.path.join(config.output_path or "./csv_logs", config.job_name)
             os.makedirs(self.log_dir, exist_ok=True)
 
-    def write_events(self, event_list):
-        if not self.enabled:
-            return
-        for tag, value, step in event_list:
-            fname = os.path.join(self.log_dir, tag.replace("/", "_") + ".csv")
+    def _writer(self, tag: str):
+        fname = os.path.join(self.log_dir, tag.replace("/", "_") + ".csv")
+        cached = self._files.get(fname)
+        if cached is None:
             new = fname not in self.filenames and not os.path.exists(fname)
             self.filenames[fname] = True
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", tag])
-                w.writerow([int(step), float(value)])
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", tag])
+            self._files[fname] = cached = (f, w)
+        return cached
+
+    def write_events(self, event_list, flush=True):
+        if not self.enabled:
+            return
+        touched = []
+        for tag, value, step in event_list:
+            f, w = self._writer(tag)
+            w.writerow([int(step), float(value)])
+            touched.append(f)
+        if flush:
+            for f in touched:
+                f.flush()
+
+    def close(self):
+        for f, _ in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files = {}
+
+    def __del__(self):
+        self.close()
 
 
 class MonitorMaster(Monitor):
@@ -102,12 +136,12 @@ class MonitorMaster(Monitor):
         self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
                         or self.csv_monitor.enabled)
 
-    def write_events(self, event_list):
+    def write_events(self, event_list, flush=True):
         if jax.process_index() != 0 or not self.enabled:
             return
         if self.tb_monitor.enabled:
-            self.tb_monitor.write_events(event_list)
+            self.tb_monitor.write_events(event_list, flush=flush)
         if self.wandb_monitor.enabled:
-            self.wandb_monitor.write_events(event_list)
+            self.wandb_monitor.write_events(event_list, flush=flush)
         if self.csv_monitor.enabled:
-            self.csv_monitor.write_events(event_list)
+            self.csv_monitor.write_events(event_list, flush=flush)

@@ -34,6 +34,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import SEQ_AXIS
+from deepspeed_tpu.utils import shard_map_compat
 
 NEG_INF = -1e30
 
@@ -59,10 +60,10 @@ def ulysses_attention(attn_fn: Callable, q, k, v, mesh, seq_axis: str = SEQ_AXIS
         o = attn_fn(scatter_heads(q), scatter_heads(k), scatter_heads(v))
         return gather_heads(o)
 
-    sm = jax.shard_map(inner, mesh=mesh,
-                       in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
-                       out_specs=P(None, seq_axis),
-                       axis_names={seq_axis}, check_vma=False)
+    sm = shard_map_compat(inner, mesh=mesh,
+                          in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
+                          out_specs=P(None, seq_axis),
+                          axis_names={seq_axis}, check_vma=False)
     return sm(q, k, v)
 
 
@@ -149,8 +150,8 @@ def ring_attention(q, k, v, mesh, causal: bool = True, scale: Optional[float] = 
         l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
         return (acc / l_safe.transpose(0, 2, 1)[..., None].astype(acc.dtype))
 
-    sm = jax.shard_map(inner, mesh=mesh,
-                       in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
-                       out_specs=P(None, seq_axis),
-                       axis_names={seq_axis}, check_vma=False)
+    sm = shard_map_compat(inner, mesh=mesh,
+                          in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
+                          out_specs=P(None, seq_axis),
+                          axis_names={seq_axis}, check_vma=False)
     return sm(q, k, v)

@@ -97,6 +97,12 @@ class ChaosInjector:
     def _applies(self, op: str) -> bool:
         return self.ops is None or op in self.ops
 
+    def _count(self, op: str, action: str):
+        from deepspeed_tpu import telemetry
+
+        telemetry.get_registry().counter(
+            "resilience/chaos_injections", labels={"op": op, "action": action}).inc()
+
     def before(self, op: str, path: str):
         """Called before a write op executes; may sleep or raise ChaosError."""
         if not self._applies(op):
@@ -105,13 +111,16 @@ class ChaosInjector:
         n = self._counts[op]
         if n in self.fail_at.get(op, ()):
             self.log.append((op, "fail", path))
+            self._count(op, "fail")
             raise ChaosError(f"chaos: injected failure on {op} #{n} ({path})")
         if self.delay_rate and self._rng.random() < self.delay_rate:
             d = self._rng.uniform(0.0, self.max_delay_s)
             self.log.append((op, f"delay {d:.3f}s", path))
+            self._count(op, "delay")
             time.sleep(d)
         if self.failure_rate and self._rng.random() < self.failure_rate:
             self.log.append((op, "fail", path))
+            self._count(op, "fail")
             raise ChaosError(f"chaos: injected failure on {op} #{n} ({path})")
 
     def corrupt(self, op: str, path: str, data: bytes) -> bytes:
@@ -126,6 +135,7 @@ class ChaosInjector:
         if scripted or randomized:
             cut = self._rng.randrange(0, max(1, len(data)))
             self.log.append((op, f"truncate {len(data)}→{cut}B", path))
+            self._count(op, "truncate")
             return data[:cut]
         return data
 

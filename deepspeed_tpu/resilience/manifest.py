@@ -85,6 +85,17 @@ def write_manifest(tag_dir: str, tag: str, files: Dict[str, bytes],
 
 
 def verify_tag(tag_dir: str) -> Tuple[bool, str]:
+    """Is this tag safe to restore? Returns (ok, reason). Failures feed the
+    ``resilience/verify_failures`` telemetry counter."""
+    ok, reason = _verify_tag(tag_dir)
+    if not ok:
+        from deepspeed_tpu import telemetry
+
+        telemetry.get_registry().counter("resilience/verify_failures").inc()
+    return ok, reason
+
+
+def _verify_tag(tag_dir: str) -> Tuple[bool, str]:
     """Is this tag safe to restore? Returns (ok, reason).
 
     Tags from before the manifest era (no ``manifest.json``) are accepted

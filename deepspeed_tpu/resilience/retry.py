@@ -65,15 +65,23 @@ def retry(fn: Callable, policy: Optional[RetryPolicy] = None, *, op: str = "",
         try:
             return fn()
         except policy.retry_on as e:
+            from deepspeed_tpu import telemetry
+
             attempt += 1
             if attempt >= policy.max_attempts:
+                telemetry.get_registry().counter(
+                    "resilience/retry_exhausted", labels={"op": op or "unknown"}).inc()
                 logger.warning(f"retry[{op}]: giving up after {attempt} attempt(s): {e}")
                 raise
             d = policy.delay_for(attempt, rng)
             if policy.deadline is not None and (clock() - start) + d > policy.deadline:
+                telemetry.get_registry().counter(
+                    "resilience/retry_exhausted", labels={"op": op or "unknown"}).inc()
                 logger.warning(f"retry[{op}]: deadline {policy.deadline}s exhausted "
                                f"after {attempt} attempt(s): {e}")
                 raise
+            telemetry.get_registry().counter(
+                "resilience/retries", labels={"op": op or "unknown"}).inc()
             logger.warning(f"retry[{op}]: attempt {attempt}/{policy.max_attempts} "
                            f"failed ({e}); retrying in {d:.3f}s")
             sleep(d)

@@ -315,6 +315,26 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
     ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest); empty = all")
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """Unified telemetry (deepspeed_tpu/telemetry/): process-wide metrics
+    registry (counters / gauges / p50-p90-p99 histograms) + Chrome-trace
+    step spans, exported to JSONL (``bin/ds_metrics`` renders it),
+    Prometheus text exposition, and the MonitorMaster fan-out. Zero
+    overhead when disabled (no-op registry); file exporters write from
+    process 0 only. See docs/CONFIG.md 'telemetry' section."""
+    enabled: bool = Field(False, description="install the telemetry session at engine init")
+    output_dir: str = Field("./ds_telemetry", description="rank-0 output directory for metrics.jsonl / metrics.prom / trace.json")
+    jsonl: bool = Field(True, description="append a JSONL metrics snapshot every flush (bin/ds_metrics summarizes it)")
+    prometheus: bool = Field(True, description="rewrite a Prometheus text-exposition file every flush (textfile-collector convention)")
+    trace: bool = Field(True, description="record host-side step spans and write Chrome-trace/Perfetto JSON every flush")
+    monitor: bool = Field(False, description="fan registry series out through the monitor writers (TensorBoard/W&B/CSV) as Telemetry/* tags")
+    inference: bool = Field(True, description="observe generate(): split prefill/decode programs for TTFT + per-token latency — adds one host sync per request and re-applies any weight transform (dequant/offload stream-in) per phase; false keeps serving on the fused single-program path")
+    flush_interval: int = Field(50, gt=0, description="flush exporters every N global steps (and once at exit)")
+    histogram_max_samples: int = Field(512, gt=0, description="reservoir size per histogram — bounds memory, keeps p50/p90/p99 representative")
+    histogram_buckets: list = Field([], description="explicit histogram bucket upper bounds (seconds for latency series); empty = summary quantiles only")
+    max_trace_events: int = Field(100_000, gt=0, description="span cap per run; overflow spans are counted and dropped")
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Verified checkpoints + recovery policy (resilience/ package). See
     docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
@@ -359,6 +379,7 @@ class DeepSpeedConfig:
         self.aio_config = AioConfig(**pd.get("aio", {}))
         self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}))
         self.resilience = ResilienceConfig(**pd.get("resilience", {}))
+        self.telemetry = TelemetryConfig(**pd.get("telemetry", {}))
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -425,7 +446,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience",
-        "steps_per_print", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

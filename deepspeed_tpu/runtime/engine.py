@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu import comm as dist
+from deepspeed_tpu import telemetry as _telemetry
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.ops.optimizers import build_optimizer
 from deepspeed_tpu.parallel.topology import DATA_AXIS, EXPERT_AXIS, ParallelGrid, build_mesh
@@ -357,10 +358,18 @@ class DeepSpeedEngine:
         self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
         self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size(),
                                           steps_per_output=self._config.steps_per_print,
-                                          sync_every_step=self.wall_clock_breakdown)
+                                          sync_every_step=self.wall_clock_breakdown,
+                                          flops_estimator=self._estimate_step_flops)
         from deepspeed_tpu.monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(self._config.monitor_config)
+        # unified telemetry session (telemetry/__init__.py): metrics registry
+        # + step tracing + exporters; None when the block is disabled — every
+        # per-step hook below guards on that, and module-level consumers
+        # (comm timed_op, resilience counters) see the noop registry
+        self.telemetry = _telemetry.configure(self._config.telemetry,
+                                              monitor=self.monitor)
+        self._flops_probe = None
         dist.configure(self._config)
         self.flops_profiler_cfg = self._config.flops_profiler_config
         if self._config.activation_checkpointing_config.partition_activations or \
@@ -1079,10 +1088,12 @@ class DeepSpeedEngine:
             batch_specs = jax.tree.map(lambda x: P(DATA_AXIS, *([None] * (x.ndim - 1))), batch)
             repl = jax.tree.map(lambda _: P(), jax.eval_shape(lambda: StepMetrics(
                 jnp.float32(0), jnp.float32(0), jnp.float32(0), jnp.float32(0), jnp.bool_(False))))
-            return jax.shard_map(local_step, mesh=mesh,
-                                 in_specs=(state_specs, batch_specs),
-                                 out_specs=(state_specs, repl),
-                                 check_vma=False)(state, batch)
+            from deepspeed_tpu.utils import shard_map_compat
+
+            return shard_map_compat(local_step, mesh=mesh,
+                                    in_specs=(state_specs, batch_specs),
+                                    out_specs=(state_specs, repl),
+                                    check_vma=False)(state, batch)
 
         return step_fn
 
@@ -1202,18 +1213,19 @@ class DeepSpeedEngine:
         The idiomatic entry point (reference PipelineEngine.train_batch:286 has
         the same contract). Returns the mean loss.
         """
-        if batch is None:
-            assert data_iter is not None, "train_batch needs a batch or data_iter"
-            batch = next(data_iter)
         gas = self._config.gradient_accumulation_steps
-        if self.curriculum_scheduler is not None:
-            from deepspeed_tpu.runtime.data_pipeline.data_sampling import \
-                apply_seqlen_curriculum
+        with _telemetry.get_tracer().span("data", step=getattr(self, "_host_step", 0)):
+            if batch is None:
+                assert data_iter is not None, "train_batch needs a batch or data_iter"
+                batch = next(data_iter)
+            if self.curriculum_scheduler is not None:
+                from deepspeed_tpu.runtime.data_pipeline.data_sampling import \
+                    apply_seqlen_curriculum
 
-            difficulty = self.curriculum_scheduler.update_difficulty(
-                getattr(self, "_host_step", 0) + 1)
-            batch = apply_seqlen_curriculum(batch, difficulty)
-        batch = self._shard_batch(batch)
+                difficulty = self.curriculum_scheduler.update_difficulty(
+                    getattr(self, "_host_step", 0) + 1)
+                batch = apply_seqlen_curriculum(batch, difficulty)
+            batch = self._shard_batch(batch)
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         trace_dir = os.environ.get("DS_TPU_TRACE_DIR")
@@ -1233,25 +1245,34 @@ class DeepSpeedEngine:
         return self._train_batch_inner(batch, gas)
 
     def _train_batch_inner(self, batch, gas):
-        if self._nvme_optimizer is not None:
-            metrics = self._train_batch_nvme(batch, gas)
-        elif self._onebit:
-            phase = self.optimizer.phase_for_step(getattr(self, "_host_step", 0))
-            with self.mesh:
-                self.state, metrics = self._get_compiled_onebit(gas, phase)(self.state, batch)
-        else:
-            with self.mesh:
-                self.state, metrics = self._get_compiled_train_batch(gas)(self.state, batch)
-        self._last_metrics = metrics
-        self.micro_steps += gas
-        self.global_samples += self.train_batch_size()
-        self._post_step(metrics)
-        if self._bad_step_sentinel is not None:
-            self._check_bad_step(metrics)
-        if self.eigenvalue is not None:
-            self._maybe_update_eigenvalue(batch)
-        self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics.loss)
-        self.tput_timer.stop(global_step=True, sync_obj=metrics.loss)
+        if self._flops_probe is None:
+            # abstract batch shape for the lazy TFLOPs estimate (holds no
+            # device buffers; see _estimate_step_flops)
+            self._flops_probe = (jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch), gas)
+        with _telemetry.get_tracer().span("train_batch",
+                                          step=getattr(self, "_host_step", 0)):
+            if self._nvme_optimizer is not None:
+                metrics = self._train_batch_nvme(batch, gas)
+            elif self._onebit:
+                phase = self.optimizer.phase_for_step(getattr(self, "_host_step", 0))
+                with self.mesh:
+                    self.state, metrics = self._get_compiled_onebit(gas, phase)(self.state, batch)
+            else:
+                with self.mesh:
+                    self.state, metrics = self._get_compiled_train_batch(gas)(self.state, batch)
+            self._last_metrics = metrics
+            self.micro_steps += gas
+            self.global_samples += self.train_batch_size()
+            self._post_step(metrics)
+            if self._bad_step_sentinel is not None:
+                self._check_bad_step(metrics)
+            if self.eigenvalue is not None:
+                self._maybe_update_eigenvalue(batch)
+            # the timer stop syncs on the loss, so the enclosing span's
+            # duration covers the device step, not just its dispatch
+            self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics.loss)
+            self.tput_timer.stop(global_step=True, sync_obj=metrics.loss)
         if self.flops_profiler_cfg.enabled and \
                 getattr(self, "_host_step", 0) == self.flops_profiler_cfg.profile_step:
             self._run_flops_profiler(batch, gas)
@@ -1319,50 +1340,52 @@ class DeepSpeedEngine:
     def forward(self, batch, *args, **kwargs):
         """Compute loss AND stash this microbatch's gradients (fused — same
         cost as the reference's forward+backward pair; see module docstring)."""
-        self.timers(FORWARD_GLOBAL_TIMER).start()
         if self._onebit:
             raise NotImplementedError("1-bit optimizers use the fused train_batch() "
                                       "path (grads must stay worker-local)")
-        if self._compiled_fwd_bwd is None:
-            def fwd_bwd(state: TrainState, batch):
-                scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
-                rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step),
-                                         jnp.int32(0))
-                loss, grads = self._micro_loss_and_grads(
-                    self._compute_params(state.params, step=state.step),
-                    batch, rng, scale, step=state.step)
-                grads = jax.lax.with_sharding_constraint(grads, self.plan.grad_specs)
-                return loss, grads
+        with _telemetry.get_tracer().span("fwd", step=getattr(self, "_host_step", 0)):
+            self.timers(FORWARD_GLOBAL_TIMER).start()
+            if self._compiled_fwd_bwd is None:
+                def fwd_bwd(state: TrainState, batch):
+                    scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
+                    rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step),
+                                             jnp.int32(0))
+                    loss, grads = self._micro_loss_and_grads(
+                        self._compute_params(state.params, step=state.step),
+                        batch, rng, scale, step=state.step)
+                    grads = jax.lax.with_sharding_constraint(grads, self.plan.grad_specs)
+                    return loss, grads
 
-            self._compiled_fwd_bwd = jax.jit(fwd_bwd)
-        batch = self._shard_batch(batch)
-        with self.mesh:
-            loss, grads = self._compiled_fwd_bwd(self.state, batch)
-        self._pending_grads = grads
-        self.timers(FORWARD_GLOBAL_TIMER).stop(sync_obj=loss)
+                self._compiled_fwd_bwd = jax.jit(fwd_bwd)
+            batch = self._shard_batch(batch)
+            with self.mesh:
+                loss, grads = self._compiled_fwd_bwd(self.state, batch)
+            self._pending_grads = grads
+            self.timers(FORWARD_GLOBAL_TIMER).stop(sync_obj=loss)
         return loss
 
     __call__ = forward
 
     def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
         """Accumulate the stashed microbatch grads into the grad buffer."""
-        self.timers(BACKWARD_GLOBAL_TIMER).start()
-        assert getattr(self, "_pending_grads", None) is not None, \
-            "backward() must follow forward() (grads are computed fused)"
-        grads = self._pending_grads
-        self._pending_grads = None
-        if self._grad_buffer is None:
-            self._grad_buffer = grads
-        else:
-            if self._compiled_accum is None:
-                self._compiled_accum = jax.jit(
-                    lambda a, g: jax.tree.map(lambda x, y: x + y.astype(x.dtype), a, g),
-                    donate_argnums=(0,))
-            with self.mesh:
-                self._grad_buffer = self._compiled_accum(self._grad_buffer, grads)
-        self._micro_loss = loss
-        self.micro_steps += 1
-        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        with _telemetry.get_tracer().span("bwd", step=getattr(self, "_host_step", 0)):
+            self.timers(BACKWARD_GLOBAL_TIMER).start()
+            assert getattr(self, "_pending_grads", None) is not None, \
+                "backward() must follow forward() (grads are computed fused)"
+            grads = self._pending_grads
+            self._pending_grads = None
+            if self._grad_buffer is None:
+                self._grad_buffer = grads
+            else:
+                if self._compiled_accum is None:
+                    self._compiled_accum = jax.jit(
+                        lambda a, g: jax.tree.map(lambda x, y: x + y.astype(x.dtype), a, g),
+                        donate_argnums=(0,))
+                with self.mesh:
+                    self._grad_buffer = self._compiled_accum(self._grad_buffer, grads)
+            self._micro_loss = loss
+            self.micro_steps += 1
+            self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss
 
     _compiled_accum = None
@@ -1376,26 +1399,27 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             self.timers(STEP_GLOBAL_TIMER).stop()
             return  # mid-accumulation: reference engine also no-ops the model step
-        assert self._grad_buffer is not None, "step() called with no accumulated gradients"
-        gas = self._config.gradient_accumulation_steps
-        if self._compiled_apply is None:
-            def apply_fn(state, grads, loss):
-                grads = jax.tree.map(lambda g: g / gas, grads)
-                return self._apply_grads(state, grads, loss)
+        with _telemetry.get_tracer().span("step", step=getattr(self, "_host_step", 0)):
+            assert self._grad_buffer is not None, "step() called with no accumulated gradients"
+            gas = self._config.gradient_accumulation_steps
+            if self._compiled_apply is None:
+                def apply_fn(state, grads, loss):
+                    grads = jax.tree.map(lambda g: g / gas, grads)
+                    return self._apply_grads(state, grads, loss)
 
-            self._compiled_apply = jax.jit(apply_fn, donate_argnums=(0, 1),
-                                           in_shardings=(self.state_shardings, None, None),
-                                           out_shardings=(self.state_shardings, None))
-        loss = self._micro_loss if self._micro_loss is not None else jnp.float32(0.0)
-        with self.mesh:
-            self.state, metrics = self._compiled_apply(self.state, self._grad_buffer, loss)
-        self._grad_buffer = None
-        self._last_metrics = metrics
-        self.global_samples += self.train_batch_size()
-        self._post_step(metrics)
-        if self._bad_step_sentinel is not None:
-            self._check_bad_step(metrics)
-        self.timers(STEP_GLOBAL_TIMER).stop(sync_obj=metrics.loss)
+                self._compiled_apply = jax.jit(apply_fn, donate_argnums=(0, 1),
+                                               in_shardings=(self.state_shardings, None, None),
+                                               out_shardings=(self.state_shardings, None))
+            loss = self._micro_loss if self._micro_loss is not None else jnp.float32(0.0)
+            with self.mesh:
+                self.state, metrics = self._compiled_apply(self.state, self._grad_buffer, loss)
+            self._grad_buffer = None
+            self._last_metrics = metrics
+            self.global_samples += self.train_batch_size()
+            self._post_step(metrics)
+            if self._bad_step_sentinel is not None:
+                self._check_bad_step(metrics)
+            self.timers(STEP_GLOBAL_TIMER).stop(sync_obj=metrics.loss)
 
     def eval_batch(self, batch):
         """Loss without grads (for eval loops)."""
@@ -1434,6 +1458,55 @@ class DeepSpeedEngine:
         if self.monitor.enabled:
             self.monitor.write_events([("Train/Samples/train_loss", float(metrics.loss), self.global_samples),
                                        ("Train/Samples/lr", float(metrics.lr), self.global_samples)])
+        session = _telemetry.get_session()
+        if session is not None:
+            self._record_step_telemetry(session, metrics, step)
+
+    def _record_step_telemetry(self, session, metrics: StepMetrics, step: int):
+        """Per-step registry updates + exporter flush cadence. Gated on the
+        LIVE session (not the construction-time self.telemetry), so sessions
+        installed via telemetry.install_session() get the same series; the
+        float() reads force one host sync per step — the same cost the
+        monitor fan-out already pays, and what the user opted into by
+        enabling telemetry."""
+        reg = session.registry
+        reg.counter("train/steps").inc()
+        reg.counter("train/samples").inc(self.train_batch_size())
+        reg.gauge("train/loss").set(float(metrics.loss))
+        reg.gauge("train/grad_norm").set(float(metrics.grad_norm))
+        reg.gauge("train/lr").set(float(metrics.lr))
+        if self.fp16_enabled:
+            reg.gauge("train/loss_scale").set(float(metrics.loss_scale))
+        if bool(metrics.overflow):
+            reg.counter("train/overflow_steps").inc()
+        sps = self.tput_timer.avg_samples_per_sec()
+        if sps > 0:
+            reg.gauge("train/samples_per_sec").set(sps)
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            reg.gauge("device/bytes_in_use").set(float(stats.get("bytes_in_use", 0)))
+            reg.gauge("device/peak_bytes_in_use").set(float(stats.get("peak_bytes_in_use", 0)))
+        except Exception:
+            pass  # memory_stats is backend-dependent (absent on CPU)
+        session.step_end(step)
+
+    def _estimate_step_flops(self) -> float:
+        """Analytical FLOPs of ONE global train batch (jaxpr matmul/conv walk,
+        profiling/flops_profiler). Called lazily by the ThroughputTimer's
+        first log line and cached there; 0.0 when nothing can be traced yet
+        (no batch seen / host-stepped NVMe path / 1-bit shard_map step)."""
+        if self._flops_probe is None or self._nvme_optimizer is not None \
+                or self._onebit:
+            return 0.0
+        from deepspeed_tpu.profiling.flops_profiler.profiler import \
+            count_jaxpr_flops
+
+        batch_shapes, gas = self._flops_probe
+        with self.mesh:
+            flops, _ = count_jaxpr_flops(
+                self._build_train_batch_fn(gas), self.state, batch_shapes)
+        _telemetry.get_registry().gauge("train/flops_per_batch").set(float(flops))
+        return float(flops)
 
     def _check_bad_step(self, metrics: StepMetrics):
         """Bad-step sentinel (resilience.sentinel config block): feed the
@@ -1457,6 +1530,9 @@ class DeepSpeedEngine:
                 f"bad-step sentinel tripped ({reason}) after "
                 f"{self._sentinel_rewinds} rewind(s) — giving up")
         self._sentinel_rewinds += 1
+        _telemetry.get_registry().counter("resilience/sentinel_rewinds").inc()
+        _telemetry.get_tracer().instant("sentinel_rewind", cat="resilience",
+                                        reason=reason)
         logger.warning(f"bad-step sentinel: {reason} for {sentinel.patience} "
                        f"consecutive step(s); rewinding to last verified "
                        f"checkpoint in {self._ckpt_save_dir} "
@@ -1687,18 +1763,20 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_checkpoint
 
         self._ckpt_save_dir = save_dir      # the bad-step sentinel's rewind target
-        return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
-                                      save_latest=save_latest)
+        with _telemetry.get_tracer().span("save_checkpoint", cat="checkpoint"):
+            return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
+                                          save_latest=save_latest)
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False, custom_load_fn=None):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
 
-        path, client_state = load_engine_checkpoint(
-            self, load_dir, tag=tag,
-            load_optimizer_states=load_optimizer_states,
-            load_module_only=load_module_only)
+        with _telemetry.get_tracer().span("load_checkpoint", cat="checkpoint"):
+            path, client_state = load_engine_checkpoint(
+                self, load_dir, tag=tag,
+                load_optimizer_states=load_optimizer_states,
+                load_module_only=load_module_only)
         if path is not None:
             self._ckpt_save_dir = load_dir  # the bad-step sentinel's rewind target
         return path, client_state

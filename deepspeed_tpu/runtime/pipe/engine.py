@@ -41,6 +41,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import PIPE_AXIS
 from deepspeed_tpu.runtime.pipe import p2p
+from deepspeed_tpu.utils import shard_map_compat
 
 
 def pipelined_loss_fn(stage_fn: Callable,
@@ -119,12 +120,12 @@ def pipelined_loss_fn(stage_fn: Callable,
             # only the last stage holds the loss; share it with everyone
             return jax.lax.psum(loss_sum, PIPE_AXIS) / num_micro
 
-        sm = jax.shard_map(partial(inner),
-                           mesh=mesh,
-                           in_specs=(P(PIPE_AXIS), P(), P()),
-                           out_specs=P(),
-                           axis_names={PIPE_AXIS},
-                           check_vma=False)
+        sm = shard_map_compat(partial(inner),
+                              mesh=mesh,
+                              in_specs=(P(PIPE_AXIS), P(), P()),
+                              out_specs=P(),
+                              axis_names={PIPE_AXIS},
+                              check_vma=False)
         return sm(params["stages"], params["shared"], mbs)
 
     return loss
@@ -277,11 +278,11 @@ def pipelined_loss_fn_1f1b(stage_fn: Callable,
             g_stage = jax.tree.map(lambda g: g[None] / num_micro, g_stage)
             return loss, g_stage, g_shared
 
-        sm = jax.shard_map(inner, mesh=mesh,
-                           in_specs=(P(PIPE_AXIS), P(), P()),
-                           out_specs=(P(), P(PIPE_AXIS), P()),
-                           axis_names={PIPE_AXIS},
-                           check_vma=False)
+        sm = shard_map_compat(inner, mesh=mesh,
+                              in_specs=(P(PIPE_AXIS), P(), P()),
+                              out_specs=(P(), P(PIPE_AXIS), P()),
+                              axis_names={PIPE_AXIS},
+                              check_vma=False)
         loss, g_stages, g_shared = sm(params["stages"], params["shared"], mbs)
         return loss, {"stages": g_stages, "shared": g_shared}
 

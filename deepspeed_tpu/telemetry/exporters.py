@@ -1,0 +1,127 @@
+"""Exporters: registry snapshots → JSONL event log / Prometheus text
+exposition / MonitorMaster fan-out.
+
+File exporters are rank-0-gated by the session (multi-host runs share a
+filesystem; one writer). The Prometheus file is rewritten atomically each
+flush (node-exporter textfile-collector convention); the JSONL log is
+append-only, one JSON object per metric per flush, and ``bin/ds_metrics``
+renders it into a table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    n = _PROM_NAME.sub("_", name)
+    return n if not n[:1].isdigit() else "_" + n
+
+
+def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    items = {**(labels or {}), **(extra or {})}
+    if not items:
+        return ""
+    body = ",".join(f'{_prom_name(str(k))}="{str(v)}"' for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+class JSONLExporter:
+    """Append-only event log: one line per metric per flush, each stamped
+    with wall-clock ``ts`` and the training ``step`` of the flush."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def export(self, snapshot: List[dict], step: Optional[int] = None) -> None:
+        ts = time.time()
+        with open(self.path, "a") as f:
+            for rec in snapshot:
+                line = {"ts": ts, "step": step, **rec}
+                f.write(json.dumps(line) + "\n")
+
+
+class PrometheusExporter:
+    """Text exposition format, rewritten whole each flush (tmp + replace so
+    a scraper never reads a torn file). Histograms with configured bounds
+    render as native prometheus histograms (cumulative ``_bucket{le=}``);
+    unbounded ones render as summaries with p50/p90/p99 quantiles."""
+
+    def __init__(self, path: str, prefix: str = "ds_"):
+        self.path = path
+        self.prefix = prefix
+
+    def render(self, snapshot: List[dict]) -> str:
+        lines = []
+        typed = set()
+        for rec in snapshot:
+            name = self.prefix + _prom_name(rec["name"])
+            kind = rec["kind"]
+            labels = rec.get("labels") or {}
+            if kind in ("counter", "gauge"):
+                if name not in typed:
+                    typed.add(name)
+                    lines.append(f"# TYPE {name} {kind}")
+                lines.append(f"{name}{_prom_labels(labels)} {rec['value']:.10g}")
+            elif kind == "histogram":
+                is_hist = rec.get("bounds") is not None
+                if name not in typed:
+                    typed.add(name)
+                    lines.append(f"# TYPE {name} {'histogram' if is_hist else 'summary'}")
+                if is_hist:
+                    cum = 0
+                    for b, c in zip(rec["bounds"], rec["bucket_counts"]):
+                        cum += c
+                        lines.append(f"{name}_bucket{_prom_labels(labels, {'le': f'{b:.10g}'})} {cum}")
+                    lines.append(f"{name}_bucket{_prom_labels(labels, {'le': '+Inf'})} {rec['count']}")
+                else:
+                    for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                        lines.append(f"{name}{_prom_labels(labels, {'quantile': q})} {rec[key]:.10g}")
+                lines.append(f"{name}_sum{_prom_labels(labels)} {rec['sum']:.10g}")
+                lines.append(f"{name}_count{_prom_labels(labels)} {rec['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def export(self, snapshot: List[dict], step: Optional[int] = None) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.render(snapshot))
+        os.replace(tmp, self.path)
+
+
+class MonitorExporter:
+    """Fan the registry out through the existing MonitorMaster
+    (monitor/monitor.py), so TensorBoard / W&B / CSV writers get the
+    telemetry series for free. Gauges and counters export their value;
+    histograms export their p50 under ``<tag>/p50``. Tags are namespaced
+    ``Telemetry/<name>`` to keep them apart from the engine's own
+    ``Train/Samples/*`` events."""
+
+    def __init__(self, monitor):
+        self.monitor = monitor
+
+    def export(self, snapshot: List[dict], step: Optional[int] = None) -> None:
+        if not getattr(self.monitor, "enabled", False):
+            return
+        s = int(step or 0)
+        events = []
+        for rec in snapshot:
+            tag = "Telemetry/" + rec["name"]
+            if rec.get("labels"):
+                tag += "/" + "/".join(f"{k}={v}" for k, v in sorted(rec["labels"].items()))
+            if rec["kind"] in ("counter", "gauge"):
+                events.append((tag, float(rec["value"]), s))
+            else:
+                events.append((tag + "/p50", float(rec["p50"]), s))
+        if events:
+            try:
+                self.monitor.write_events(events)
+            except Exception as e:  # a wedged TB writer must not kill training
+                logger.warning(f"telemetry monitor fan-out failed: {e}")

@@ -1,0 +1,113 @@
+"""Span-based step tracing → Chrome-trace / Perfetto JSON.
+
+Spans mark host-visible phases of a step (data / fwd / bwd / step /
+train_batch, checkpoint save/load, inference prefill/decode); the writer
+emits the Chrome Trace Event Format (``{"traceEvents": [...]}``, complete
+events ``ph="X"`` with microsecond ``ts``/``dur``) that both
+``chrome://tracing`` and https://ui.perfetto.dev open directly. Device-side
+op timing stays the XLA profiler's job (``DS_TPU_TRACE_DIR``,
+runtime/engine.py); these spans are the cheap always-on host skeleton that
+tells you WHICH phase of WHICH step to zoom into.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import List, Optional
+
+
+class StepTracer:
+    """Collects complete-span events; bounded by ``max_events`` (overflow is
+    counted, never grows memory without bound on a long run)."""
+
+    def __init__(self, max_events: int = 100_000, pid: int = 0):
+        self._t0 = time.perf_counter()
+        self.pid = int(pid)
+        self.max_events = int(max_events)
+        self.events: List[dict] = []
+        self.dropped = 0
+        self._written_state = None      # (len(events), dropped) at last write
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _emit(self, ev: dict) -> None:
+        if len(self.events) >= self.max_events:
+            self.dropped += 1
+            return
+        self.events.append(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "train", **args):
+        """``with tracer.span("fwd", step=3): ...`` — records one complete
+        event covering the block (exceptions still close the span)."""
+        ts = self._now_us()
+        try:
+            yield self
+        finally:
+            self._emit({"name": name, "cat": cat, "ph": "X", "ts": ts,
+                        "dur": self._now_us() - ts, "pid": self.pid, "tid": 0,
+                        "args": args})
+
+    def instant(self, name: str, cat: str = "train", **args) -> None:
+        self._emit({"name": name, "cat": cat, "ph": "i", "s": "p",
+                    "ts": self._now_us(), "pid": self.pid, "tid": 0,
+                    "args": args})
+
+    def to_chrome_trace(self) -> dict:
+        meta = [{"name": "process_name", "ph": "M", "pid": self.pid, "tid": 0,
+                 "args": {"name": f"deepspeed_tpu rank {self.pid}"}}]
+        return {"traceEvents": meta + self.events, "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> None:
+        """Atomic dump (tmp + replace): a reader mid-run never sees a
+        half-written JSON. No-op when nothing changed since the last write —
+        the whole-file dump is O(spans so far), and a capped buffer late in a
+        long run would otherwise pay it every flush for no new data."""
+        # dropped is deliberately NOT part of the state: past the event cap
+        # only `dropped` moves, and it is not serialized — rewriting an
+        # identical file every flush is the exact cost this guard avoids
+        state = len(self.events)
+        if state == self._written_state:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+        self._written_state = state
+
+
+class _NullCtx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class NoopTracer:
+    """Zero-overhead stand-in when tracing is off."""
+
+    events: List[dict] = []
+    dropped = 0
+
+    def span(self, name: str, cat: str = "train", **args):
+        return _NULL
+
+    def instant(self, name: str, cat: str = "train", **args) -> None:
+        pass
+
+    def to_chrome_trace(self) -> dict:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> None:
+        pass
+
+
+NOOP_TRACER = NoopTracer()

@@ -171,7 +171,7 @@ class ThroughputTimer:
 
     def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
                  monitor_memory: bool = False, logging_fn=None,
-                 sync_every_step: bool = True):
+                 sync_every_step: bool = True, flops_estimator=None):
         self.start_time = 0.0
         self.end_time = 0.0
         self.started = False
@@ -194,6 +194,27 @@ class ThroughputTimer:
         # can read high when the input pipeline stalls — enable
         # wall_clock_breakdown for strict per-step accounting.
         self.sync_every_step = sync_every_step
+        # TFLOPs column: flops_estimator() -> analytical FLOPs of one global
+        # batch (the engine wires profiling/flops_profiler's jaxpr counter).
+        # Called LAZILY on the first emitted log line only — runs that never
+        # log throughput never pay for the trace.
+        self.flops_estimator = flops_estimator
+        self.flops_per_batch = None
+
+    def set_flops_per_batch(self, flops: float):
+        """Explicit override for callers that already know the model cost."""
+        self.flops_per_batch = float(flops)
+
+    def _tflops_suffix(self, per_step_time: float) -> str:
+        if self.flops_per_batch is None and self.flops_estimator is not None:
+            try:
+                self.flops_per_batch = float(self.flops_estimator() or 0.0)
+            except Exception as e:  # estimation must never break the log line
+                log_dist(f"throughput: flops estimate unavailable ({e})", ranks=[0])
+                self.flops_per_batch = 0.0
+        if not self.flops_per_batch or per_step_time <= 0:
+            return ""
+        return f", EstTFLOPs={self.flops_per_batch / per_step_time / 1e12:.2f}"
 
     def update_epoch_count(self):
         self.epoch_count += 1
@@ -230,7 +251,8 @@ class ThroughputTimer:
                     f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                     f"global_step={self.global_step_count}, "
                     f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.3f}, "
-                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time * self.steps_per_output:.3f}")
+                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time * self.steps_per_output:.3f}"
+                    + self._tflops_suffix(self.step_elapsed_time / self.steps_per_output))
                 self.step_elapsed_time = 0.0
 
     def avg_samples_per_sec(self) -> float:

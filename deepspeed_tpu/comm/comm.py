@@ -124,6 +124,14 @@ def init_distributed(dist_backend: str = "xccl",
     launcher sets). Single-process single-host needs no rendezvous at all.
     """
     global cdb
+    if timeout is not None:
+        try:
+            timeout = float(timeout.total_seconds())  # datetime.timedelta (reference contract)
+        except AttributeError:
+            timeout = float(timeout)
+        if timeout <= 0:
+            raise ValueError(f"init_distributed(timeout={timeout!r}): timeout "
+                             "must be a positive number of seconds")
     if cdb is not None and mesh is None:
         # same-process topology change: a different mesh_config rebuilds the
         # backend (engine construction passes mesh_config; driver scripts
@@ -170,7 +178,7 @@ def init_distributed(dist_backend: str = "xccl",
         pid = rank if rank >= 0 else \
             (_env_int("DSTPU_PROCESS_ID", "JAX_PROCESS_ID", "RANK") or 0)
         try:
-            jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+            jax.distributed.initialize(**_jax_init_kwargs(coord, nproc, pid, timeout))
             if verbose:
                 log_dist(f"jax.distributed initialized: {nproc} processes via {coord}", ranks=[0])
         except Exception as e:  # already initialized or single-host
@@ -182,6 +190,30 @@ def init_distributed(dist_backend: str = "xccl",
     if verbose:
         log_dist(f"xccl backend ready: mesh={dict(mesh.shape)} on {get_accelerator().device_kind()}", ranks=[0])
     return cdb
+
+
+def _jax_init_kwargs(coord: str, nproc: int, pid: int, timeout=None) -> dict:
+    """kwargs for ``jax.distributed.initialize``: the rendezvous triple plus
+    ``initialization_timeout`` when the caller set one (the reference passes
+    its ``timeout`` into the NCCL rendezvous, torch.py:84 — here it bounds
+    the coordinator handshake). Omitted on a jax too old to accept it."""
+    kwargs = dict(coordinator_address=coord, num_processes=nproc, process_id=pid)
+    if timeout is not None:
+        import inspect as _inspect
+
+        try:
+            params = _inspect.signature(jax.distributed.initialize).parameters
+        except (TypeError, ValueError):
+            params = {}
+        if "initialization_timeout" in params:
+            kwargs["initialization_timeout"] = max(1, int(timeout))
+        else:
+            logger.warning("init_distributed: this jax has no "
+                           "initialization_timeout — the rendezvous timeout "
+                           "is dropped (barrier deadlines come from "
+                           "watchdog.barrier_timeout / monitored_barrier's "
+                           "own timeout arg, not from here)")
+    return kwargs
 
 
 def get_mesh() -> Mesh:
@@ -553,8 +585,118 @@ def barrier(group=None, log_name="barrier"):
         jax.effects_barrier()
 
 
-def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
-    barrier(group)
+_default_barrier_timeout: Optional[float] = None
+_default_barrier_timeout_source: Optional[str] = None
+_monitored_barrier_seq = 0
+
+
+def set_default_barrier_timeout(timeout: Optional[float],
+                                source: str = "manual") -> None:
+    """Default deadline for ``monitored_barrier`` calls that pass none —
+    the engine sets this from the ``watchdog.barrier_timeout`` knob with
+    ``source="config"``. Source tracking mirrors ``uninstall_config_chaos``:
+    an engine built WITHOUT the watchdog block clears only a previous
+    engine's CONFIG-installed default, never a manual install."""
+    global _default_barrier_timeout, _default_barrier_timeout_source
+    if timeout is not None and timeout <= 0:
+        raise ValueError(f"barrier timeout must be positive, got {timeout!r}")
+    _default_barrier_timeout = timeout
+    _default_barrier_timeout_source = None if timeout is None else source
+
+
+def clear_config_barrier_timeout() -> None:
+    """Remove only a CONFIG-installed barrier default (engine init with the
+    watchdog block absent); manual installs are deliberately left alone."""
+    global _default_barrier_timeout, _default_barrier_timeout_source
+    if _default_barrier_timeout_source == "config":
+        _default_barrier_timeout = None
+        _default_barrier_timeout_source = None
+
+
+def _dist_client():
+    """The jax coordination-service client (None single-host / pre-init)."""
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        return getattr(_jax_distributed.global_state, "client", None)
+    except ImportError:      # private module moved
+        return None
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False,
+                      log_name="monitored_barrier"):
+    """Barrier with a real deadline (reference comm.py monitored_barrier —
+    which this port used to silently strip of BOTH its arguments).
+
+    Single process: a plain :func:`barrier` — no threads, no deadline
+    (there is nobody to wait for). Multi-process with a ``timeout`` (or a
+    default installed via :func:`set_default_barrier_timeout`): the sync
+    runs under a background-thread deadline; on expiry every thread's stack
+    is dumped via faulthandler, ``resilience/watchdog_timeouts`` is
+    counted, and :class:`~deepspeed_tpu.resilience.watchdog.WatchdogTimeout`
+    is raised — the caller gets control back while the wedged sync thread
+    is disowned. ``wait_all_ranks=True`` records each process's arrival in
+    the jax coordination-service KV store (a host-side agreement round)
+    so the timeout message NAMES the processes that never reached the
+    barrier instead of just "it hung".
+    """
+    global _monitored_barrier_seq
+    if timeout is not None:
+        try:
+            timeout = float(timeout.total_seconds())  # timedelta (reference contract)
+        except AttributeError:
+            timeout = float(timeout)
+        if timeout <= 0:
+            raise ValueError(f"monitored_barrier(timeout={timeout!r}): timeout must be positive")
+    if jax.process_count() == 1:
+        return barrier(group, log_name=log_name)
+    if timeout is None:
+        timeout = _default_barrier_timeout
+    if timeout is None:
+        return barrier(group, log_name=log_name)
+
+    from deepspeed_tpu.resilience.watchdog import run_with_deadline
+
+    _monitored_barrier_seq += 1
+    seq = _monitored_barrier_seq    # all ranks call in lockstep → keys align
+    roster = None
+    client = _dist_client()
+    if wait_all_ranks and client is not None:
+        roster = f"ds_tpu/monitored_barrier/{log_name}/{seq}"
+        try:
+            client.key_value_set(f"{roster}/{jax.process_index()}", "1")
+        except Exception as e:
+            logger.warning(f"monitored_barrier: arrival roster unavailable ({e})")
+            roster = None
+
+    def _missing_info() -> str:
+        if not wait_all_ranks:
+            return ""
+        if roster is None:
+            return " (arrival roster unavailable — no coordination-service KV store)"
+        try:
+            entries = client.key_value_dir_get(roster)
+            arrived = {int(str(k).rsplit("/", 1)[-1]) for k, _ in entries}
+        except Exception as e:
+            return f" (arrival roster unreadable: {e})"
+        missing = sorted(set(range(jax.process_count())) - arrived)
+        if missing:
+            return f"; processes that never reached the barrier: {missing}"
+        return "; every process arrived — the sync itself wedged"
+
+    out = run_with_deadline(lambda: barrier(group, log_name=log_name),
+                            timeout=timeout,
+                            name=f"{log_name}[{seq}]",
+                            on_timeout_info=_missing_info)
+    if roster is not None:
+        # each rank retires its own arrival key on success — thousands of
+        # barriers over a multi-day job must not grow the coordinator's KV
+        # store without bound (on timeout the keys stay for post-mortems)
+        try:
+            client.key_value_delete(f"{roster}/{jax.process_index()}")
+        except Exception:
+            pass
+    return out
 
 
 def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None):

@@ -24,6 +24,17 @@ failures. So the agent is a supervision loop around the training engine:
   one controller restarting in-process would mismatch the surviving hosts'
   collectives, so whole-job restart is the launcher's responsibility (the
   reference agent's torchelastic rendezvous plays that role).
+* **hang recovery** — a ``WatchdogTimeout`` from the engine's step
+  watchdog (resilience/watchdog.py) is a restartable failure like any
+  other: recorded in ``restart_reasons``, paced by the shared restart
+  backoff, resumed from the last verified tag. The dead engine's watchdog
+  monitor thread is closed before the new engine comes up.
+
+Operator signal: at agent start (``install_signal_handlers=True``) a
+``faulthandler`` handler is registered on **SIGUSR1** — ``kill -USR1
+<pid>`` makes a live (possibly wedged) process dump every thread's stack
+to stderr WITHOUT killing it, the first thing to reach for when a job
+looks stuck and you need to see where.
 """
 
 from __future__ import annotations
@@ -75,6 +86,7 @@ class DSElasticAgent:
         self.engine = None
         if install_signal_handlers:
             self._install_handlers()
+            self._install_stack_dump_signal()
 
     # ------------------------------------------------------------- signals
     def _install_handlers(self):
@@ -85,6 +97,21 @@ class DSElasticAgent:
                 logger.warning("elastic agent: cannot install signal handlers "
                                "outside the main thread")
                 return
+
+    @staticmethod
+    def _install_stack_dump_signal():
+        """SIGUSR1 → faulthandler all-thread stack dump to stderr: operators
+        inspect a live wedged process (``kill -USR1 <pid>``) without killing
+        it. ``chain=True`` keeps any user handler working."""
+        import faulthandler
+
+        if not hasattr(signal, "SIGUSR1"):      # pragma: no cover - windows
+            return
+        try:
+            faulthandler.register(signal.SIGUSR1, all_threads=True, chain=True)
+        except (ValueError, OSError, RuntimeError) as e:
+            logger.warning(f"elastic agent: cannot register SIGUSR1 stack-dump "
+                           f"handler: {e}")
 
     def _on_preempt(self, signum, frame):
         logger.warning(f"elastic agent: received signal {signum} — will "
@@ -167,6 +194,19 @@ class DSElasticAgent:
         """
         batches_factory = batches if callable(batches) else (lambda: iter(batches))
         resume = self._has_checkpoint()
+        try:
+            return self._run_supervised(batches, batches_factory, num_steps,
+                                        step_callback, resume)
+        finally:
+            # the engine's watchdog monitor thread dies with the run on
+            # EVERY exit path (complete/preempted/raise) — close() is
+            # reversible, a later arm() restarts it
+            wd = getattr(self.engine, "_watchdog", None)
+            if wd is not None:
+                wd.close()
+
+    def _run_supervised(self, batches, batches_factory, num_steps,
+                        step_callback, resume) -> dict:
         while True:
             try:
                 engine = self._bring_up(resume)
@@ -214,6 +254,17 @@ class DSElasticAgent:
             except Exception as e:
                 import jax
 
+                from deepspeed_tpu.resilience.watchdog import WatchdogTimeout
+
+                # the dead engine's watchdog monitor thread must not outlive
+                # it (one leaked daemon per restart otherwise)
+                wd = getattr(self.engine, "_watchdog", None)
+                if wd is not None:
+                    wd.close()
+                if isinstance(e, WatchdogTimeout):
+                    logger.error("elastic agent: hung step detected by the "
+                                 f"watchdog ({e}); treating as a restartable "
+                                 "failure")
                 if jax.process_count() > 1:
                     # a host-LOCAL failure cannot be healed by an in-process
                     # restart on one controller: the surviving hosts keep

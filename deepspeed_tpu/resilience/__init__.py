@@ -16,18 +16,34 @@ that dominate real multi-day pod-slice jobs:
 * ``sentinel``  — the bad-step sentinel: after K consecutive
                   non-finite/loss-spike steps the engine rewinds to the
                   last verified checkpoint instead of burning the job.
+* ``watchdog``  — live hang defense: arm/disarm step deadlines (moving-
+                  percentile policy), deadline-wrapped barriers, all-thread
+                  faulthandler stack dumps, heartbeat files for the
+                  launcher's supervision loop — a stalled rank ends in a
+                  clean ``WatchdogTimeout``/restart, never a silent wedge.
+* ``consistency`` — cross-rank desync guard: config/topology/code
+                  fingerprint agreement at init, periodic (step, loss
+                  bits, RNG hash) agreement during training; a mismatch
+                  raises ``DesyncError`` naming the divergent rank.
 """
 
 from deepspeed_tpu.resilience.chaos import (ChaosError, ChaosInjector, active_injector, install_chaos,
                                             uninstall_chaos)
+from deepspeed_tpu.resilience.consistency import (DesyncError, check_step_agreement, config_fingerprint,
+                                                  step_digest, verify_startup_consistency)
 from deepspeed_tpu.resilience.manifest import (MANIFEST_NAME, candidate_tags, find_restorable_tag, verify_tag,
                                                write_manifest)
 from deepspeed_tpu.resilience.retry import RestartBackoff, RetryPolicy, retry
 from deepspeed_tpu.resilience.sentinel import BadStepError, BadStepSentinel
+from deepspeed_tpu.resilience.watchdog import (StepWatchdog, WatchdogTimeout, dump_all_stacks,
+                                               run_with_deadline, touch_heartbeat)
 
 __all__ = [
     "ChaosError", "ChaosInjector", "active_injector", "install_chaos", "uninstall_chaos",
     "MANIFEST_NAME", "candidate_tags", "find_restorable_tag", "verify_tag", "write_manifest",
     "RestartBackoff", "RetryPolicy", "retry",
     "BadStepError", "BadStepSentinel",
+    "StepWatchdog", "WatchdogTimeout", "dump_all_stacks", "run_with_deadline", "touch_heartbeat",
+    "DesyncError", "check_step_agreement", "config_fingerprint", "step_digest",
+    "verify_startup_consistency",
 ]

@@ -1,14 +1,28 @@
-"""Seedable fault injection for the checkpoint I/O path.
+"""Seedable fault injection for the checkpoint I/O path and the step loop.
 
 Recovery code that is never exercised is broken code. The checkpoint
 engine routes every filesystem write through two hooks —
-``injector.before(op, path)`` (may raise :class:`ChaosError` or sleep) and
-``injector.corrupt(op, path, data)`` (may truncate the payload, a SILENT
-fault that only manifest verification can catch) — so a test or a
-game-day run can deterministically interrupt a save at any point.
+``injector.before(op, path)`` (may raise :class:`ChaosError`, sleep, hang,
+or kill the process) and ``injector.corrupt(op, path, data)`` (may
+truncate the payload, a SILENT fault that only manifest verification can
+catch) — so a test or a game-day run can deterministically interrupt a
+save at any point.
 
 Ops instrumented by the checkpoint engine: ``state_save`` (the orbax
 write), ``client_state``, ``sampler_sidecar``, ``manifest``, ``latest``.
+The training engine additionally calls ``before("train_step", ...)`` at
+each step — but only when :meth:`ChaosInjector.targets` says a fault
+class aims there (an existing checkpoint-I/O drill must not silently
+expand into the step path). The step-oriented fault classes:
+
+* ``hang`` (``hang_at`` scripted / ``hang_rate`` randomized) — stall for
+  ``hang_s`` seconds in an INTERRUPTIBLE sleep loop, so the step
+  watchdog's in-thread :class:`WatchdogTimeout` can cut it short exactly
+  like it would a real host-side wedge;
+* ``delay`` (``delay_at`` scripted, plus the existing ``delay_rate``) — a
+  bounded stall under the watchdog deadline (latency, not a hang);
+* ``kill`` (``kill_at``) — SIGKILL the process mid-step: the launcher's
+  liveness/heartbeat supervision is the only thing that can notice.
 
 Activation: ``install_chaos(injector)`` (tests / the ``resilience.chaos``
 config block at engine init), or the ``DS_CHAOS`` env var, e.g.
@@ -50,9 +64,13 @@ class ChaosInjector:
     def __init__(self, seed: int = 0, failure_rate: float = 0.0,
                  truncate_rate: float = 0.0, delay_rate: float = 0.0,
                  max_delay_s: float = 0.02,
+                 hang_rate: float = 0.0, hang_s: float = 3600.0,
                  ops: Optional[Iterable[str]] = None,
                  fail_at: Optional[Dict[str, Sequence[int]]] = None,
-                 truncate_at: Optional[Dict[str, Sequence[int]]] = None):
+                 truncate_at: Optional[Dict[str, Sequence[int]]] = None,
+                 hang_at: Optional[Dict[str, Sequence[int]]] = None,
+                 delay_at: Optional[Dict[str, Sequence[int]]] = None,
+                 kill_at: Optional[Dict[str, Sequence[int]]] = None):
         self._rng = random.Random(seed)
         self.seed = seed
         self.source = "manual"      # "config" / "env": who installed it
@@ -60,9 +78,14 @@ class ChaosInjector:
         self.truncate_rate = float(truncate_rate)
         self.delay_rate = float(delay_rate)
         self.max_delay_s = float(max_delay_s)
+        self.hang_rate = float(hang_rate)
+        self.hang_s = float(hang_s)
         self.ops = set(ops) if ops else None
         self.fail_at = {k: set(v) for k, v in (fail_at or {}).items()}
         self.truncate_at = {k: set(v) for k, v in (truncate_at or {}).items()}
+        self.hang_at = {k: set(v) for k, v in (hang_at or {}).items()}
+        self.delay_at = {k: set(v) for k, v in (delay_at or {}).items()}
+        self.kill_at = {k: set(v) for k, v in (kill_at or {}).items()}
         self._counts = defaultdict(int)
         self.log: list = []          # (op, action, path) — what actually fired
 
@@ -71,7 +94,8 @@ class ChaosInjector:
         """Build from the ``resilience.chaos`` pydantic block."""
         inj = cls(seed=cfg.seed, failure_rate=cfg.failure_rate,
                   truncate_rate=cfg.truncate_rate, delay_rate=cfg.delay_rate,
-                  max_delay_s=cfg.max_delay_s, ops=cfg.ops or None)
+                  max_delay_s=cfg.max_delay_s, hang_rate=cfg.hang_rate,
+                  hang_s=cfg.hang_s, ops=cfg.ops or None)
         inj.source = "config"
         return inj
 
@@ -97,14 +121,39 @@ class ChaosInjector:
     def _applies(self, op: str) -> bool:
         return self.ops is None or op in self.ops
 
+    def targets(self, op: str) -> bool:
+        """Does any fault class aim at ``op``? The engine's step hook only
+        fires when one does: a checkpoint-I/O drill (``ops`` unset, rates
+        only) must not silently expand its blast radius into the step path
+        — ``train_step`` faults require naming the op in ``ops``, a
+        scripted ``*_at`` entry, or the (new, step-oriented) ``hang_rate``."""
+        if self.ops is not None:
+            return op in self.ops
+        if any(op in d for d in (self.fail_at, self.truncate_at,
+                                 self.hang_at, self.delay_at, self.kill_at)):
+            return True
+        return self.hang_rate > 0
+
     def _count(self, op: str, action: str):
         from deepspeed_tpu import telemetry
 
         telemetry.get_registry().counter(
             "resilience/chaos_injections", labels={"op": op, "action": action}).inc()
 
+    def _hang(self, op: str, n: int, path: str):
+        """Interruptible stall: sleep in POLL-sized slices so an async
+        WatchdogTimeout delivered into this thread lands between bytecodes
+        — the same way it would interrupt a real host-side stall."""
+        self.log.append((op, f"hang {self.hang_s:.1f}s", path))
+        self._count(op, "hang")
+        logger.warning(f"chaos: injected hang on {op} #{n} for {self.hang_s:.1f}s ({path})")
+        deadline = time.monotonic() + self.hang_s
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+
     def before(self, op: str, path: str):
-        """Called before a write op executes; may sleep or raise ChaosError."""
+        """Called before an op executes; may sleep, hang, kill the process,
+        or raise ChaosError."""
         if not self._applies(op):
             return
         self._counts[op] += 1
@@ -113,6 +162,27 @@ class ChaosInjector:
             self.log.append((op, "fail", path))
             self._count(op, "fail")
             raise ChaosError(f"chaos: injected failure on {op} #{n} ({path})")
+        if n in self.kill_at.get(op, ()):
+            import os as _os
+            import signal as _signal
+
+            self.log.append((op, "kill", path))
+            self._count(op, "kill")
+            logger.warning(f"chaos: injected SIGKILL on {op} #{n} ({path})")
+            _os.kill(_os.getpid(), _signal.SIGKILL)
+        # randomized hangs are step-oriented (the targets() contract): with
+        # ops unset they never hit checkpoint I/O, where a default-hang_s
+        # stall would run OUTSIDE any armed watchdog region — an explicit
+        # ops list opts whichever ops it names into the drill
+        rate_hang = (self.hang_rate
+                     and (self.ops is not None or op == "train_step")
+                     and self._rng.random() < self.hang_rate)
+        if n in self.hang_at.get(op, ()) or rate_hang:
+            self._hang(op, n, path)
+        if n in self.delay_at.get(op, ()):
+            self.log.append((op, f"delay {self.max_delay_s:.3f}s", path))
+            self._count(op, "delay")
+            time.sleep(self.max_delay_s)
         if self.delay_rate and self._rng.random() < self.delay_rate:
             d = self._rng.uniform(0.0, self.max_delay_s)
             self.log.append((op, f"delay {d:.3f}s", path))

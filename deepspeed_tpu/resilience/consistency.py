@@ -1,0 +1,165 @@
+"""Cross-rank consistency guard: catch silent desync before it corrupts training.
+
+A multi-controller SPMD job has one failure mode worse than a hang: two
+ranks that keep running but have silently diverged — a host that resumed a
+different checkpoint, loaded a different config, or runs different code
+issues collectives that still *complete*, and training corrupts without a
+single error. The guard makes divergence loud at two points:
+
+* **init** — every rank computes a sha256 fingerprint of its (config,
+  mesh topology, code versions); rank 0's is broadcast
+  (``comm.broadcast_object_list``) and each rank compares, raising
+  :class:`DesyncError` naming itself on mismatch *before* the first step.
+* **every N steps** (``watchdog.consistency_interval``) — ranks allgather a
+  digest of (step counter, loss **bits**, RNG-key hash). SPMD replicates
+  all three, so the digests must be byte-identical; a mismatch raises
+  :class:`DesyncError` identifying the divergent rank(s) (majority vote;
+  ties resolve toward rank 0's value) instead of letting the run rot.
+
+Loss enters as its float32 *bit pattern*, not a printed value — drift
+smaller than any repr rounding still trips the guard. Single-process runs
+skip the agreement rounds (nothing to diverge from) but still compute
+digests so the engine path stays exercised.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class DesyncError(RuntimeError):
+    """Two ranks disagree on state that SPMD requires to be identical
+    (config/topology/code at init; step counter, loss bits, or RNG key
+    during training). Not restartable in-process: the job must restart
+    whole (the launcher / scheduler's role) after the divergence cause is
+    fixed."""
+
+
+def _code_versions() -> dict:
+    import jax
+
+    import deepspeed_tpu
+
+    return {"deepspeed_tpu": getattr(deepspeed_tpu, "__version__", "0"),
+            "jax": jax.__version__}
+
+
+def config_fingerprint(param_dict: dict, mesh=None, extra=None) -> str:
+    """sha256 over the canonical JSON of (ds_config, mesh shape, code
+    versions[, extra]) — what every rank of one job must agree on."""
+    payload = {
+        "config": param_dict,
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+        "code": _code_versions(),
+        "extra": extra,
+    }
+    blob = json.dumps(payload, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def step_digest(step: int, loss: float, rng_bytes: bytes = b"") -> str:
+    """Digest of the per-step agreement tuple. ``loss`` is hashed as its
+    float32 BIT PATTERN (non-finite safe, sub-repr drift visible)."""
+    h = hashlib.sha256()
+    h.update(np.int64(step).tobytes())
+    h.update(np.float32(loss).tobytes())
+    h.update(rng_bytes)
+    return h.hexdigest()
+
+
+def find_divergent(rows) -> List[int]:
+    """Indices whose row differs from the majority value (ties resolve
+    toward the first — i.e. rank 0's — value)."""
+    from collections import Counter
+
+    keys = [bytes(bytearray(np.asarray(r, dtype=np.uint8))) for r in rows]
+    majority, _ = Counter(keys).most_common(1)[0]
+    return [i for i, k in enumerate(keys) if k != majority]
+
+
+def _gather_rows(digest_hex: str) -> np.ndarray:
+    """Allgather this process's digest; returns (nproc, 32) uint8 rows.
+    (Factored out so tests can fabricate rosters without multiple hosts.)"""
+    from jax.experimental import multihost_utils
+
+    buf = np.frombuffer(bytes.fromhex(digest_hex), dtype=np.uint8)
+    rows = np.asarray(multihost_utils.process_allgather(buf))
+    return rows.reshape(-1, buf.size)
+
+
+def _count_desync(kind: str) -> None:
+    from deepspeed_tpu import telemetry
+
+    telemetry.get_registry().counter(
+        "resilience/desync_detected", labels={"kind": kind}).inc()
+    telemetry.get_tracer().instant("desync_detected", cat="resilience", kind=kind)
+
+
+def verify_startup_consistency(param_dict: dict, mesh=None, extra=None,
+                               timeout: Optional[float] = None) -> str:
+    """All-rank agreement on the config/topology/code fingerprint, run once
+    at engine init. Returns the fingerprint; raises :class:`DesyncError`
+    on the mismatching rank(s) before any training collective runs.
+
+    ``timeout`` bounds the broadcast itself (the engine passes its
+    ``watchdog.barrier_timeout``): this runs BEFORE the step watchdog is
+    armed and before any heartbeat touch, so a peer that died between
+    rendezvous and engine init must produce a ``WatchdogTimeout`` here —
+    an unbounded wait would be exactly the wedge the watchdog exists to
+    kill. (The periodic step agreement needs no own deadline: it runs
+    inside the armed step region.)"""
+    import jax
+
+    fp = config_fingerprint(param_dict, mesh=mesh, extra=extra)
+    if jax.process_count() == 1:
+        return fp
+    from deepspeed_tpu.comm import comm as _comm
+
+    bcast = lambda: _comm.broadcast_object_list([fp], src=0)
+    if timeout is not None:
+        from deepspeed_tpu.resilience.watchdog import run_with_deadline
+
+        ref = run_with_deadline(bcast, timeout=timeout,
+                                name="startup_fingerprint_broadcast")[0]
+    else:
+        ref = bcast()[0]
+    if ref != fp:
+        _count_desync("startup_fingerprint")
+        raise DesyncError(
+            f"rank {jax.process_index()}: config/topology/code fingerprint "
+            f"{fp[:12]}… does not match rank 0's {ref[:12]}… — this process "
+            "is running a different config, mesh, or code version than the "
+            "rest of the job; refusing to train into silent corruption")
+    return fp
+
+
+def check_step_agreement(step: int, loss: float, rng=None) -> str:
+    """Every-N-steps agreement round on (step counter, loss bits, RNG-key
+    hash). Returns the digest; raises :class:`DesyncError` naming the
+    divergent rank(s) on mismatch. Single-process: digest only, no
+    collective."""
+    import jax
+
+    rng_bytes = b"" if rng is None else np.asarray(rng).tobytes()
+    digest = step_digest(step, loss, rng_bytes)
+    if jax.process_count() == 1:
+        return digest
+    rows = _gather_rows(digest)
+    bad = find_divergent(rows)
+    if bad:
+        _count_desync("step_agreement")
+        me = jax.process_index()
+        role = "this rank is divergent" if me in bad else "this rank agrees with the majority"
+        logger.error(f"consistency guard: desync at step {step}: rank(s) {bad} "
+                     f"disagree on (step, loss bits, rng hash); {role}")
+        raise DesyncError(
+            f"cross-rank desync at step {step}: rank(s) {bad} disagree on "
+            "(step counter, loss bits, RNG-key hash) — training state has "
+            "silently diverged; aborting before it corrupts further")
+    return digest

@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Optional, Union
 
-from pydantic import Field
+from pydantic import Field, field_validator
 
 from deepspeed_tpu.runtime.config_utils import (DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys)
 from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
@@ -312,7 +312,9 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
     truncate_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-write probability of silently truncating the payload")
     delay_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-write probability of an injected delay")
     max_delay_s: float = Field(0.02, ge=0.0, description="upper bound of an injected delay (s)")
-    ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest); empty = all")
+    hang_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-op probability of an injected interruptible HANG (watchdog detection drills)")
+    hang_s: float = Field(3600.0, ge=0.0, description="duration of an injected hang (s); the watchdog is expected to fire well before it ends")
+    ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/train_step); empty = all")
 
 
 class TelemetryConfig(DeepSpeedConfigModel):
@@ -333,6 +335,37 @@ class TelemetryConfig(DeepSpeedConfigModel):
     histogram_max_samples: int = Field(512, gt=0, description="reservoir size per histogram — bounds memory, keeps p50/p90/p99 representative")
     histogram_buckets: list = Field([], description="explicit histogram bucket upper bounds (seconds for latency series); empty = summary quantiles only")
     max_trace_events: int = Field(100_000, gt=0, description="span cap per run; overflow spans are counted and dropped")
+
+
+class WatchdogConfig(DeepSpeedConfigModel):
+    """Distributed watchdog (resilience/watchdog.py + consistency.py): live
+    hang detection and cross-rank desync detection. A stalled step or
+    barrier ends in an all-thread stack dump + a clean ``WatchdogTimeout``
+    (restartable by the elastic agent / launcher) instead of an indefinite
+    wedge; a silently diverged rank raises ``DesyncError`` before it
+    corrupts training. Strict no-op when the block is absent: no watchdog
+    thread, no heartbeat writes, no agreement collectives. See
+    docs/CONFIG.md 'watchdog' section for the detection-latency table."""
+    enabled: bool = Field(False, description="arm the step watchdog + consistency guard at engine init")
+    step_timeout_factor: float = Field(3.0, gt=0.0, description="step deadline = factor × moving percentile of recent step times")
+    step_timeout_percentile: float = Field(0.95, gt=0.0, le=1.0, description="which percentile of the recent-step window feeds the deadline")
+    window: int = Field(32, ge=4, description="recent step-time window the percentile is taken over")
+    min_step_timeout: float = Field(60.0, gt=0.0, description="deadline floor (s) — set above your recompile time so a mid-run recompile never false-positives")
+    startup_timeout: float = Field(600.0, gt=0.0, description="deadline (s) before any step time has been observed (the first step compiles)")
+    barrier_timeout: float = Field(300.0, gt=0.0, description="default deadline (s) for comm.monitored_barrier when the caller passes none")
+    on_timeout: str = Field("raise", description="'raise' delivers WatchdogTimeout into the stepping thread (agent-restartable); 'kill' SIGABRTs the process for launcher-supervised jobs")
+    stack_dump_file: str = Field("", description="also append faulthandler stack dumps to this file (empty = stderr only)")
+    consistency_interval: int = Field(0, ge=0, description="every N steps, ranks agree on (step counter, loss bits, RNG hash); mismatch raises DesyncError naming the divergent rank (0 = off)")
+    check_fingerprint_at_init: bool = Field(True, description="at init, all ranks agree on a config/topology/code fingerprint before the first step")
+    heartbeat_file: str = Field("", description="file the engine touches each heartbeat_interval steps for the launcher's stale-heartbeat supervision (empty = DS_TPU_HEARTBEAT_FILE env, else no heartbeat)")
+    heartbeat_interval: int = Field(1, ge=1, description="touch the heartbeat file every N steps")
+
+    @field_validator("on_timeout")
+    @classmethod
+    def _on_timeout_known(cls, v):
+        if v not in ("raise", "kill"):
+            raise ValueError(f"watchdog.on_timeout must be 'raise' or 'kill', got {v!r}")
+        return v
 
 
 class ResilienceConfig(DeepSpeedConfigModel):
@@ -379,6 +412,7 @@ class DeepSpeedConfig:
         self.aio_config = AioConfig(**pd.get("aio", {}))
         self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}))
         self.resilience = ResilienceConfig(**pd.get("resilience", {}))
+        self.watchdog = WatchdogConfig(**pd.get("watchdog", {}))
         self.telemetry = TelemetryConfig(**pd.get("telemetry", {}))
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
@@ -445,7 +479,7 @@ class DeepSpeedConfig:
         "csv_monitor", "pipeline", "tpu", "checkpoint", "data_types", "aio",
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
-        "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience",
+        "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "watchdog",
         "steps_per_print", "telemetry", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",

@@ -157,6 +157,63 @@ class DeepSpeedEngine:
         self.mp_world_size = self.grid.get_model_parallel_world_size()
         self._config._configure_train_batch_size(self.dp_world_size)
 
+        # ---- watchdog (before any model/state work) ----------------------
+        # live hang/desync defense (resilience/watchdog.py + consistency.py),
+        # installed FIRST: the startup fingerprint agreement must run before
+        # _init_state issues the first sharded computation — two ranks with
+        # different configs would otherwise wedge or crash inside state
+        # materialization with no DesyncError ever naming the divergence.
+        # STRICT no-op when the block is absent: no StepWatchdog object, no
+        # monitor thread, no heartbeat writes, no agreement collectives —
+        # the per-step cost of a disabled watchdog is two `is None` checks.
+        wd_cfg = self._config.watchdog
+        self._watchdog = None
+        self._heartbeat_path = None
+        self._heartbeat_interval = 1
+        self._consistency_interval = 0
+        if wd_cfg.enabled:
+            from deepspeed_tpu.resilience.watchdog import (StepWatchdog,
+                                                           set_default_dump_path)
+
+            # barrier / startup-fingerprint timeouts dump to the same file
+            set_default_dump_path(wd_cfg.stack_dump_file or None, source="config")
+            self._watchdog = StepWatchdog(
+                factor=wd_cfg.step_timeout_factor,
+                percentile=wd_cfg.step_timeout_percentile,
+                window=wd_cfg.window,
+                min_timeout=wd_cfg.min_step_timeout,
+                startup_timeout=wd_cfg.startup_timeout,
+                on_timeout=wd_cfg.on_timeout,
+                dump_path=wd_cfg.stack_dump_file or None)
+            dist.set_default_barrier_timeout(wd_cfg.barrier_timeout,
+                                             source="config")
+            hb = wd_cfg.heartbeat_file or os.environ.get("DS_TPU_HEARTBEAT_FILE", "")
+            if hb:
+                self._heartbeat_path = hb
+                self._heartbeat_interval = wd_cfg.heartbeat_interval
+            self._consistency_interval = wd_cfg.consistency_interval
+            if wd_cfg.check_fingerprint_at_init:
+                from deepspeed_tpu.resilience.consistency import \
+                    verify_startup_consistency
+
+                # every rank must be running the same (config, topology,
+                # code) BEFORE the first collective — a desynced rank fails
+                # here, loudly, instead of corrupting training; the deadline
+                # covers a peer that died between rendezvous and engine init
+                self._config_fingerprint = verify_startup_consistency(
+                    self._config._param_dict, mesh=self.mesh,
+                    timeout=wd_cfg.barrier_timeout)
+        else:
+            # same contract as resilience.chaos: a later engine built
+            # WITHOUT the block must not inherit the previous engine's
+            # barrier deadline or dump file — absent block means plain
+            # barriers (manual set_default_barrier_timeout installs are
+            # left alone)
+            dist.clear_config_barrier_timeout()
+            from deepspeed_tpu.resilience.watchdog import clear_config_dump_path
+
+            clear_config_dump_path()
+
         # ---- model protocol ---------------------------------------------
         # `model` provides init_params(rng) + loss(params, batch, rng) — the
         # functional stand-in for the reference's nn.Module. Alternatively
@@ -1213,6 +1270,18 @@ class DeepSpeedEngine:
         The idiomatic entry point (reference PipelineEngine.train_batch:286 has
         the same contract). Returns the mean loss.
         """
+        if self._watchdog is None:
+            return self._train_batch_outer(batch, data_iter)
+        # armed before the data fetch: a wedged input pipeline is a hang
+        # like any other — the deadline covers data + device step + the
+        # host syncs in _post_step; disarm feeds the step-time history
+        self._watchdog.arm()
+        try:
+            return self._train_batch_outer(batch, data_iter)
+        finally:
+            self._watchdog.disarm()
+
+    def _train_batch_outer(self, batch, data_iter):
         gas = self._config.gradient_accumulation_steps
         with _telemetry.get_tracer().span("data", step=getattr(self, "_host_step", 0)):
             if batch is None:
@@ -1250,6 +1319,24 @@ class DeepSpeedEngine:
             # device buffers; see _estimate_step_flops)
             self._flops_probe = (jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch), gas)
+        from deepspeed_tpu.resilience import chaos as _chaos_mod
+
+        # chaos step hook + consistency cadence run inside train_batch's
+        # armed region, so an injected (or real) stall in either is covered
+        inj = _chaos_mod.active_injector()
+        if inj is not None and inj.targets("train_step"):
+            inj.before("train_step", f"step={getattr(self, '_host_step', 0) + 1}")
+        loss = self._train_batch_instrumented(batch, gas)
+        if self._consistency_interval and \
+                self._host_step % self._consistency_interval == 0:
+            from deepspeed_tpu.resilience.consistency import \
+                check_step_agreement
+
+            check_step_agreement(self._host_step, float(loss),
+                                 rng=self.state.rng)
+        return loss
+
+    def _train_batch_instrumented(self, batch, gas):
         with _telemetry.get_tracer().span("train_batch",
                                           step=getattr(self, "_host_step", 0)):
             if self._nvme_optimizer is not None:
@@ -1399,6 +1486,15 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             self.timers(STEP_GLOBAL_TIMER).stop()
             return  # mid-accumulation: reference engine also no-ops the model step
+        if self._watchdog is not None:
+            self._watchdog.arm()
+        try:
+            self._step_at_boundary()
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.disarm()
+
+    def _step_at_boundary(self):
         with _telemetry.get_tracer().span("step", step=getattr(self, "_host_step", 0)):
             assert self._grad_buffer is not None, "step() called with no accumulated gradients"
             gas = self._config.gradient_accumulation_steps
@@ -1441,6 +1537,15 @@ class DeepSpeedEngine:
         # host-side step counter: never force a device sync just for logging
         self._host_step = getattr(self, "_host_step", 0) + 1
         step = self._host_step
+        if self._heartbeat_path is not None and \
+                step % self._heartbeat_interval == 0:
+            from deepspeed_tpu.resilience.watchdog import touch_heartbeat
+
+            # liveness proof for the launcher's supervision loop: mtime
+            # advancing = steps completing (works even when this process's
+            # Python threads can't be reached — the ABSENCE of touches is
+            # the signal)
+            touch_heartbeat(self._heartbeat_path)
         if self.progressive_layer_drop is not None:
             # mirror of the jitted θ(t) — reference engine.py updates PLD state
             # host-side each step; here it is reporting-only (the compiled
@@ -1758,25 +1863,49 @@ class DeepSpeedEngine:
                                    data_sampler=data_sampler, **dl_kwargs)
 
     # ------------------------------------------------------------ checkpoint
+    def _touch_heartbeat_now(self):
+        """Heartbeat touch outside the step cadence: long between-step
+        phases (a retried checkpoint commit, a load) are progress, not a
+        wedge — without these touches the launcher's stale-heartbeat
+        supervision would kill a healthy job mid-save. A single commit
+        longer than ``--heartbeat_timeout`` still needs the timeout sized
+        above it (documented in CONFIG.md)."""
+        if self._heartbeat_path is not None:
+            from deepspeed_tpu.resilience.watchdog import touch_heartbeat
+
+            touch_heartbeat(self._heartbeat_path)
+        if self._watchdog is not None:
+            # a save/load reached from INSIDE an armed step (sentinel
+            # rewind) is step-sized work, not step-time-sized — push the
+            # deadline out to startup_timeout instead of async-aborting a
+            # healthy multi-minute restore at the step deadline
+            self._watchdog.extend_if_armed()
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
                         exclude_frozen_parameters=False):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_checkpoint
 
         self._ckpt_save_dir = save_dir      # the bad-step sentinel's rewind target
+        self._touch_heartbeat_now()
         with _telemetry.get_tracer().span("save_checkpoint", cat="checkpoint"):
-            return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
-                                          save_latest=save_latest)
+            try:
+                return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
+                                              save_latest=save_latest)
+            finally:
+                self._touch_heartbeat_now()
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False, custom_load_fn=None):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
 
+        self._touch_heartbeat_now()
         with _telemetry.get_tracer().span("load_checkpoint", cat="checkpoint"):
             path, client_state = load_engine_checkpoint(
                 self, load_dir, tag=tag,
                 load_optimizer_states=load_optimizer_states,
                 load_module_only=load_module_only)
+        self._touch_heartbeat_now()
         if path is not None:
             self._ckpt_save_dir = load_dir  # the bad-step sentinel's rewind target
         return path, client_state

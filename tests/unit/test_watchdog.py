@@ -1,0 +1,743 @@
+"""Distributed watchdog tests: hang detection (step deadline + monitored
+barrier), cross-rank consistency guard, heartbeat supervision, and the
+chaos hang/delay/kill fault classes that make every detection path
+deterministically drivable.
+
+The acceptance contract (ISSUE 3): with ``watchdog`` enabled an injected
+stall is detected within the configured deadline, produces a faulthandler
+stack dump + a ``watchdog_timeouts`` telemetry increment, and ends in a
+clean ``WatchdogTimeout``/agent restart — never an indefinite hang; with
+the block absent the step path adds no threads and no heartbeat writes.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.elasticity import DSElasticAgent
+from deepspeed_tpu.models.simple import SimpleModel
+from deepspeed_tpu.resilience import consistency as cons
+from deepspeed_tpu.resilience import watchdog as wd
+from deepspeed_tpu.resilience.chaos import ChaosInjector, install_chaos, uninstall_chaos
+from deepspeed_tpu.resilience.watchdog import (StepWatchdog, WatchdogTimeout,
+                                               run_with_deadline, touch_heartbeat)
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.telemetry import TelemetrySession
+from deepspeed_tpu.runtime.config import TelemetryConfig
+
+HIDDEN = 16
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    yield
+    telemetry.deconfigure()
+    uninstall_chaos()
+    comm.set_default_barrier_timeout(None)
+    wd.set_default_dump_path(None)
+
+
+@pytest.fixture
+def live_registry(tmp_path):
+    """A real registry so tests can assert the watchdog counters."""
+    cfg = TelemetryConfig(enabled=True, output_dir=str(tmp_path / "telem"),
+                          trace=False, jsonl=False, prometheus=False)
+    telemetry.install_session(TelemetrySession(cfg))
+    return telemetry.get_registry()
+
+
+def _counter_total(registry, name):
+    return sum(m["value"] for m in registry.snapshot()
+               if m["name"] == name and m["kind"] == "counter")
+
+
+def _ds_config(watchdog=None, extra=None):
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 0}
+    if watchdog is not None:
+        cfg["watchdog"] = watchdog
+    cfg.update(extra or {})
+    return cfg
+
+
+def _engine(watchdog=None, extra=None):
+    comm.cdb = None
+    engine, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+        config=_ds_config(watchdog, extra))
+    return engine
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(8, HIDDEN).astype(np.float32),
+            rng.randn(8, HIDDEN).astype(np.float32))
+
+
+# --------------------------------------------------------------------------- #
+# config block
+# --------------------------------------------------------------------------- #
+class TestWatchdogConfig:
+    def test_defaults_off(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8})
+        assert cfg.watchdog.enabled is False
+        assert cfg.watchdog.min_step_timeout > 0
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(Exception):
+            DeepSpeedConfig({"train_batch_size": 8,
+                             "watchdog": {"enabled": True, "step_timout": 1}})
+
+    def test_on_timeout_validated(self):
+        with pytest.raises(Exception, match="on_timeout"):
+            DeepSpeedConfig({"train_batch_size": 8,
+                             "watchdog": {"on_timeout": "explode"}})
+
+    def test_chaos_block_gains_hang_knobs(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "resilience": {"chaos": {"enabled": True,
+                                                        "hang_rate": 0.5,
+                                                        "hang_s": 1.0}}})
+        inj = ChaosInjector.from_config(cfg.resilience.chaos)
+        assert inj.hang_rate == 0.5 and inj.hang_s == 1.0
+
+
+# --------------------------------------------------------------------------- #
+# StepWatchdog core
+# --------------------------------------------------------------------------- #
+class TestStepWatchdog:
+    def test_deadline_policy(self):
+        w = StepWatchdog(factor=2.0, percentile=0.5, window=8,
+                         min_timeout=0.1, startup_timeout=99.0)
+        assert w.deadline_s() == 99.0               # no history: startup
+        for d in [1.0, 2.0, 3.0, 4.0]:
+            w.observe(d)
+        # p50 of [1,2,3,4] -> 2.0, ×2 = 4.0
+        assert w.deadline_s() == pytest.approx(4.0)
+        w2 = StepWatchdog(min_timeout=50.0)
+        w2.observe(0.001)
+        assert w2.deadline_s() == 50.0              # floored
+
+    def test_never_armed_owns_no_thread(self):
+        before = threading.active_count()
+        StepWatchdog(min_timeout=0.1)
+        assert threading.active_count() == before
+
+    def test_fast_step_does_not_fire(self):
+        w = StepWatchdog(min_timeout=5.0, startup_timeout=5.0)
+        w.arm()
+        time.sleep(0.05)
+        dur = w.disarm()
+        assert dur is not None and dur < 1.0
+        time.sleep(0.2)     # give the monitor a chance to (wrongly) fire
+        assert w.trips == 0
+        w.close()
+
+    def test_hang_fires_dump_counter_and_clean_timeout(self, tmp_path, live_registry):
+        dump = str(tmp_path / "stacks.txt")
+        w = StepWatchdog(min_timeout=0.3, startup_timeout=0.3, dump_path=dump)
+        w.arm()
+        t0 = time.monotonic()
+        with pytest.raises(WatchdogTimeout, match="deadline"):
+            # a host-side stall: interruptible like the chaos hang class
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                time.sleep(0.02)
+        elapsed = time.monotonic() - t0
+        w.disarm()
+        w.close()
+        assert elapsed < 10.0, "detection must come from the deadline, not the stall ending"
+        assert w.trips == 1
+        with open(dump) as f:
+            text = f.read()
+        assert "watchdog stack dump" in text and "Thread" in text
+        assert _counter_total(live_registry, "resilience/watchdog_timeouts") == 1
+
+    def test_on_timeout_kill_escalates(self):
+        killed = []
+        w = StepWatchdog(min_timeout=0.2, startup_timeout=0.2, on_timeout="kill")
+        w._kill = lambda: killed.append(True)
+        w.arm()
+        time.sleep(0.6)     # deadline passes; monitor fires the kill hook
+        w.close()
+        assert killed == [True]
+        assert w.trips == 1
+
+    def test_extend_if_armed_moves_deadline(self):
+        """In-step checkpoint work (sentinel rewind) extends the deadline to
+        startup_timeout instead of being aborted at the step deadline."""
+        w = StepWatchdog(min_timeout=0.2, startup_timeout=5.0)
+        assert w.extend_if_armed() is False      # unarmed: must stay a no-op
+        w.arm()
+        assert w.extend_if_armed() is True
+        time.sleep(0.5)     # past the original 0.2s deadline; must not fire
+        assert w.trips == 0
+        w.disarm()
+        w.close()
+
+    def test_late_completion_cancels_pending_timeout(self, monkeypatch):
+        """Fire/disarm race: an op completing while _fire is mid-stack-dump
+        must NOT receive the timeout later in unrelated code."""
+        monkeypatch.setattr(wd, "dump_all_stacks",
+                            lambda *a, **k: time.sleep(0.5))   # widen the window
+        w = StepWatchdog(min_timeout=0.1, startup_timeout=0.1)
+        w.arm()
+        time.sleep(0.3)         # deadline passes; monitor fires into the slow dump
+        assert w.disarm() is None   # op completed while the fire was in flight
+        time.sleep(0.8)         # let _fire finish; nothing may be delivered
+        for _ in range(1000):   # pending async exc would surface on these bytecodes
+            pass
+        assert w.trips == 1     # the trip is still recorded (deadline WAS blown)
+        w.close()
+
+    def test_rejects_bad_policy(self):
+        with pytest.raises(ValueError):
+            StepWatchdog(on_timeout="nope")
+        with pytest.raises(ValueError):
+            StepWatchdog(percentile=1.5)
+
+
+class TestRunWithDeadline:
+    def test_returns_value_and_propagates_error(self):
+        assert run_with_deadline(lambda: 42, timeout=5.0) == 42
+        with pytest.raises(KeyError):
+            run_with_deadline(lambda: {}["missing"], timeout=5.0)
+
+    def test_timeout_raises_with_info(self, live_registry):
+        t0 = time.monotonic()
+        with pytest.raises(WatchdogTimeout, match="who-is-missing"):
+            run_with_deadline(lambda: time.sleep(30), timeout=0.2,
+                              name="test-op",
+                              on_timeout_info=lambda: "; who-is-missing")
+        assert time.monotonic() - t0 < 5.0
+        assert _counter_total(live_registry, "resilience/watchdog_timeouts") == 1
+
+
+# --------------------------------------------------------------------------- #
+# monitored_barrier / init_distributed satellites
+# --------------------------------------------------------------------------- #
+class TestMonitoredBarrier:
+    def test_single_process_fast_path_is_plain_barrier(self):
+        """Satellite: single-process monitored_barrier stays a plain barrier
+        — no deadline thread spawned, args accepted for API parity."""
+        before = threading.active_count()
+        comm.monitored_barrier(timeout=5.0, wait_all_ranks=True)
+        comm.monitored_barrier()
+        assert threading.active_count() == before
+
+    def test_invalid_timeout_rejected(self):
+        with pytest.raises(ValueError):
+            comm.monitored_barrier(timeout=0)
+        with pytest.raises(ValueError):
+            comm.set_default_barrier_timeout(-1)
+
+    def test_multiprocess_timeout_raises_clean(self, monkeypatch, live_registry):
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+        def hang(group=None, log_name="barrier"):
+            time.sleep(30)
+
+        monkeypatch.setattr(comm, "barrier", hang)
+        t0 = time.monotonic()
+        with pytest.raises(WatchdogTimeout, match="monitored_barrier"):
+            comm.monitored_barrier(timeout=0.2, wait_all_ranks=True)
+        assert time.monotonic() - t0 < 5.0
+        assert _counter_total(live_registry, "resilience/watchdog_timeouts") == 1
+
+    def test_timedelta_timeout_accepted(self, monkeypatch):
+        """Reference callers pass datetime.timedelta — same normalization
+        as init_distributed."""
+        import datetime
+
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.setattr(comm, "barrier", lambda group=None, log_name="barrier": time.sleep(30))
+        with pytest.raises(WatchdogTimeout):
+            comm.monitored_barrier(timeout=datetime.timedelta(milliseconds=200))
+        with pytest.raises(ValueError):
+            comm.monitored_barrier(timeout=datetime.timedelta(0))
+
+    def test_timeout_dump_lands_in_default_dump_file(self, tmp_path, monkeypatch):
+        """Barrier timeouts dump into the engine-installed stack_dump_file,
+        not just stderr."""
+        import jax
+
+        dump = str(tmp_path / "wd.txt")
+        wd.set_default_dump_path(dump)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.setattr(comm, "barrier", lambda group=None, log_name="barrier": time.sleep(30))
+        with pytest.raises(WatchdogTimeout):
+            comm.monitored_barrier(timeout=0.2)
+        with open(dump) as f:
+            assert "watchdog stack dump" in f.read()
+
+    def test_default_timeout_installed_by_config(self, monkeypatch):
+        import jax
+
+        comm.set_default_barrier_timeout(0.2)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.setattr(comm, "barrier", lambda group=None, log_name="barrier": time.sleep(30))
+        with pytest.raises(WatchdogTimeout):
+            comm.monitored_barrier()        # no explicit timeout
+
+
+class TestInitDistributedTimeout:
+    def test_timeout_validated_positive(self):
+        """Satellite: init_distributed no longer drops `timeout` — an
+        invalid value is rejected in the config path."""
+        with pytest.raises(ValueError, match="positive"):
+            comm.init_distributed(timeout=0)
+        with pytest.raises(ValueError, match="positive"):
+            comm.init_distributed(timeout=-3.0)
+
+    def test_timeout_reaches_jax_initialize_kwargs(self):
+        kw = comm._jax_init_kwargs("host:1", 4, 1, 120.0)
+        assert kw["initialization_timeout"] == 120
+        assert "initialization_timeout" not in comm._jax_init_kwargs("host:1", 4, 1, None)
+
+    def test_timedelta_accepted(self):
+        import datetime
+
+        kw = comm._jax_init_kwargs("host:1", 2, 0, 90)
+        assert kw["initialization_timeout"] == 90
+        # reference passes datetime.timedelta; init_distributed normalizes it
+        with pytest.raises(ValueError):
+            comm.init_distributed(timeout=datetime.timedelta(seconds=0))
+
+
+# --------------------------------------------------------------------------- #
+# consistency guard
+# --------------------------------------------------------------------------- #
+class TestConsistencyGuard:
+    def test_fingerprint_deterministic_and_sensitive(self):
+        a = cons.config_fingerprint({"train_batch_size": 8})
+        b = cons.config_fingerprint({"train_batch_size": 8})
+        c = cons.config_fingerprint({"train_batch_size": 16})
+        assert a == b and a != c
+        mesh = comm.init_distributed(verbose=False).mesh
+        assert cons.config_fingerprint({}, mesh=mesh) != cons.config_fingerprint({})
+
+    def test_step_digest_tracks_loss_bits_and_rng(self):
+        base = cons.step_digest(5, 1.25, b"rng")
+        assert base == cons.step_digest(5, 1.25, b"rng")
+        assert base != cons.step_digest(6, 1.25, b"rng")
+        assert base != cons.step_digest(5, np.nextafter(np.float32(1.25), 2.0), b"rng")
+        assert base != cons.step_digest(5, 1.25, b"RNG")
+        # non-finite safe: hashing bit patterns, not values
+        assert cons.step_digest(5, float("nan"), b"") == cons.step_digest(5, float("nan"), b"")
+
+    def test_find_divergent_majority_vote(self):
+        good = np.frombuffer(b"\x01" * 32, dtype=np.uint8)
+        bad = np.frombuffer(b"\x02" * 32, dtype=np.uint8)
+        assert cons.find_divergent([good, good, bad, good]) == [2]
+        assert cons.find_divergent([good, good, good]) == []
+        # 2-rank tie resolves toward rank 0's value
+        assert cons.find_divergent([good, bad]) == [1]
+
+    def test_startup_mismatch_raises_desync(self, monkeypatch, live_registry):
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        monkeypatch.setattr(comm, "broadcast_object_list",
+                            lambda objs, src=0: ["0" * 64])
+        with pytest.raises(cons.DesyncError, match="rank 1"):
+            cons.verify_startup_consistency({"train_batch_size": 8})
+        assert _counter_total(live_registry, "resilience/desync_detected") == 1
+
+    def test_step_agreement_names_divergent_rank(self, monkeypatch, live_registry):
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        good = np.frombuffer(bytes.fromhex(cons.step_digest(7, 2.0, b"k")), np.uint8)
+        bad = np.frombuffer(bytes.fromhex(cons.step_digest(7, 2.5, b"k")), np.uint8)
+        monkeypatch.setattr(cons, "_gather_rows",
+                            lambda d: np.stack([good, good, bad, good]))
+        with pytest.raises(cons.DesyncError, match=r"rank\(s\) \[2\]"):
+            cons.check_step_agreement(7, 2.0, rng=None)
+        assert _counter_total(live_registry, "resilience/desync_detected") == 1
+
+    def test_startup_broadcast_bounded_by_timeout(self, monkeypatch):
+        """A peer dead between rendezvous and engine init must produce a
+        WatchdogTimeout from the startup check, not an unbounded wait."""
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.setattr(comm, "broadcast_object_list",
+                            lambda objs, src=0: time.sleep(30))
+        t0 = time.monotonic()
+        with pytest.raises(WatchdogTimeout, match="startup_fingerprint"):
+            cons.verify_startup_consistency({"train_batch_size": 8}, timeout=0.2)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_single_process_paths_are_local(self):
+        fp = cons.verify_startup_consistency({"train_batch_size": 8})
+        assert len(fp) == 64
+        assert len(cons.check_step_agreement(3, 1.0, rng=np.zeros(2, np.uint32))) == 64
+
+
+# --------------------------------------------------------------------------- #
+# chaos fault classes
+# --------------------------------------------------------------------------- #
+class TestChaosFaultClasses:
+    def test_hang_class_stalls_for_hang_s(self):
+        inj = ChaosInjector(hang_at={"train_step": [2]}, hang_s=0.3)
+        t0 = time.monotonic()
+        inj.before("train_step", "step=1")      # 1st call: clean
+        assert time.monotonic() - t0 < 0.2
+        t0 = time.monotonic()
+        inj.before("train_step", "step=2")      # 2nd call: hangs
+        assert time.monotonic() - t0 >= 0.3
+        assert any(a.startswith("hang") for _, a, _ in inj.log)
+
+    def test_delay_class_scripted(self):
+        inj = ChaosInjector(delay_at={"train_step": [1]}, max_delay_s=0.2)
+        t0 = time.monotonic()
+        inj.before("train_step", "step=1")
+        assert time.monotonic() - t0 >= 0.2
+        assert ("train_step", "delay 0.200s", "step=1") in inj.log
+
+    def test_kill_class_signals_sigkill(self, monkeypatch):
+        sent = []
+        monkeypatch.setattr(os, "kill", lambda pid, sig: sent.append((pid, sig)))
+        inj = ChaosInjector(kill_at={"train_step": [1]})
+        inj.before("train_step", "step=1")
+        assert sent == [(os.getpid(), signal.SIGKILL)]
+        assert ("train_step", "kill", "step=1") in inj.log
+
+    def test_targets_gates_the_step_hook(self):
+        """A checkpoint-I/O drill (rates only, ops unset) must not expand
+        into the step path; scripted/explicit/hang_rate targeting does."""
+        assert not ChaosInjector(failure_rate=0.9).targets("train_step")
+        assert ChaosInjector(hang_at={"train_step": [1]}).targets("train_step")
+        assert ChaosInjector(failure_rate=0.9, ops=["train_step"]).targets("train_step")
+        assert not ChaosInjector(failure_rate=0.9, ops=["latest"]).targets("train_step")
+        assert ChaosInjector(hang_rate=0.1).targets("train_step")
+
+    def test_hang_rate_never_stalls_checkpoint_io(self):
+        """Randomized hangs are step-oriented: with ops unset they must not
+        stall checkpoint I/O ops, which run outside any armed watchdog."""
+        inj = ChaosInjector(hang_rate=1.0, hang_s=0.5)
+        t0 = time.monotonic()
+        inj.before("manifest", "p")
+        inj.before("state_save", "p")
+        assert time.monotonic() - t0 < 0.3
+        t0 = time.monotonic()
+        inj.before("train_step", "step=1")      # the step op DOES hang
+        assert time.monotonic() - t0 >= 0.5
+        # an explicit ops list opts the named op into the drill
+        inj2 = ChaosInjector(hang_rate=1.0, hang_s=0.3, ops=["latest"])
+        t0 = time.monotonic()
+        inj2.before("latest", "p")
+        assert time.monotonic() - t0 >= 0.3
+
+    def test_hang_interruptible_by_watchdog(self):
+        """The hang class sleeps in slices so the watchdog's in-thread
+        timeout cuts it short — the full detection path in miniature."""
+        inj = ChaosInjector(hang_at={"train_step": [1]}, hang_s=30.0)
+        w = StepWatchdog(min_timeout=0.3, startup_timeout=0.3)
+        w.arm()
+        t0 = time.monotonic()
+        with pytest.raises(WatchdogTimeout):
+            inj.before("train_step", "step=1")
+        w.disarm()
+        w.close()
+        assert time.monotonic() - t0 < 10.0
+        assert w.trips == 1
+
+
+# --------------------------------------------------------------------------- #
+# engine integration
+# --------------------------------------------------------------------------- #
+class TestEngineIntegration:
+    def test_absent_block_is_strict_noop(self, tmp_path):
+        """Acceptance: no watchdog block → no threads, no heartbeat writes,
+        no watchdog object on the step path."""
+        hb = tmp_path / "heartbeat"
+        engine = _engine()
+        assert engine._watchdog is None and engine._heartbeat_path is None
+        engine.train_batch(_batch())    # warm-up: jax may lazily spawn pools
+        before = threading.active_count()
+        for _ in range(2):
+            engine.train_batch(_batch())
+        assert threading.active_count() == before
+        assert not hb.exists()
+        assert comm._default_barrier_timeout is None
+
+    def test_enabled_watchdog_arms_and_learns_step_times(self):
+        engine = _engine(watchdog={"enabled": True, "min_step_timeout": 30.0,
+                                   "startup_timeout": 300.0})
+        assert engine._watchdog is not None
+        for _ in range(3):
+            engine.train_batch(_batch())
+        # disarm fed the history: deadline now floors at min_step_timeout
+        assert len(engine._watchdog._durations) == 3
+        assert engine._watchdog.deadline_s() == 30.0
+        assert comm._default_barrier_timeout == engine._config.watchdog.barrier_timeout
+        engine._watchdog.close()
+
+    def test_heartbeat_touched_each_step(self, tmp_path):
+        hb = str(tmp_path / "hb" / "heartbeat")
+        engine = _engine(watchdog={"enabled": True, "min_step_timeout": 30.0,
+                                   "startup_timeout": 300.0,
+                                   "heartbeat_file": hb})
+        engine.train_batch(_batch())
+        assert os.path.exists(hb)
+        m1 = os.path.getmtime(hb)
+        time.sleep(0.05)
+        engine.train_batch(_batch())
+        assert os.path.getmtime(hb) > m1
+        engine._watchdog.close()
+
+    def test_heartbeat_env_var_fallback(self, tmp_path, monkeypatch):
+        hb = str(tmp_path / "env_hb")
+        monkeypatch.setenv("DS_TPU_HEARTBEAT_FILE", hb)
+        engine = _engine(watchdog={"enabled": True, "min_step_timeout": 30.0,
+                                   "startup_timeout": 300.0})
+        engine.train_batch(_batch())
+        assert os.path.exists(hb)
+        engine._watchdog.close()
+
+    def test_consistency_interval_runs_agreement(self, monkeypatch):
+        calls = []
+        real = cons.check_step_agreement
+        monkeypatch.setattr(cons, "check_step_agreement",
+                            lambda step, loss, rng=None: calls.append(step) or real(step, loss, rng=rng))
+        engine = _engine(watchdog={"enabled": True, "min_step_timeout": 30.0,
+                                   "startup_timeout": 300.0,
+                                   "consistency_interval": 2})
+        for _ in range(4):
+            engine.train_batch(_batch())
+        assert calls == [2, 4]
+        engine._watchdog.close()
+
+    def test_later_engine_without_block_resets_barrier_default(self):
+        """Same contract as resilience.chaos: a later engine built WITHOUT
+        the block clears a CONFIG-installed barrier default — but never a
+        manual set_default_barrier_timeout install."""
+        a = _engine(watchdog={"enabled": True, "min_step_timeout": 30.0})
+        assert comm._default_barrier_timeout is not None
+        a._watchdog.close()
+        _engine()
+        assert comm._default_barrier_timeout is None
+        comm.set_default_barrier_timeout(7.0)       # manual install
+        wd.set_default_dump_path("/tmp/manual-dump.txt")
+        _engine()
+        assert comm._default_barrier_timeout == 7.0
+        assert wd._default_dump_path == "/tmp/manual-dump.txt"
+
+    def test_wedged_data_iterator_is_detected(self):
+        """The armed region starts BEFORE the data fetch: a stalled input
+        pipeline is a hang like any other."""
+        engine = _engine(watchdog={"enabled": True, "min_step_timeout": 0.4,
+                                   "startup_timeout": 60.0})
+        engine.train_batch(_batch())                # compile + learn a step time
+
+        def wedged_iter():
+            yield _batch()
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:      # interruptible stall
+                time.sleep(0.02)
+            yield _batch()                          # pragma: no cover
+
+        it = wedged_iter()
+        engine.train_batch(data_iter=it)
+        t0 = time.monotonic()
+        with pytest.raises(WatchdogTimeout):
+            engine.train_batch(data_iter=it)
+        assert time.monotonic() - t0 < 30.0
+        engine._watchdog.close()
+
+    @pytest.mark.watchdog
+    @pytest.mark.chaos
+    def test_injected_hang_ends_in_clean_timeout(self, tmp_path, live_registry):
+        """Acceptance core: chaos `hang` mid-step → watchdog fires within
+        the deadline, dumps stacks, counts the timeout, raises a clean
+        WatchdogTimeout out of train_batch — never an indefinite hang."""
+        dump = str(tmp_path / "stacks.txt")
+        engine = _engine(watchdog={"enabled": True, "min_step_timeout": 0.4,
+                                   "startup_timeout": 60.0,
+                                   "stack_dump_file": dump})
+        install_chaos(ChaosInjector(hang_at={"train_step": [3]}, hang_s=120.0))
+        engine.train_batch(_batch())
+        engine.train_batch(_batch())
+        t0 = time.monotonic()
+        with pytest.raises(WatchdogTimeout):
+            engine.train_batch(_batch())
+        assert time.monotonic() - t0 < 30.0, "must detect, not wait out the 120s stall"
+        assert engine._watchdog.trips == 1
+        with open(dump) as f:
+            assert "watchdog stack dump" in f.read()
+        assert _counter_total(live_registry, "resilience/watchdog_timeouts") == 1
+        engine._watchdog.close()
+
+
+# --------------------------------------------------------------------------- #
+# elastic agent
+# --------------------------------------------------------------------------- #
+def _agent_factory(watchdog=None):
+    def make():
+        comm.cdb = None
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=HIDDEN, nlayers=2),
+            config=_ds_config(watchdog, {"zero_optimization": {"stage": 1},
+                                         "tpu": {"data": 8}}))
+        return engine
+    return make
+
+
+def _batches():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, HIDDEN).astype(np.float32)
+    y = rng.randn(8, HIDDEN).astype(np.float32)
+    while True:
+        yield (x, y)
+
+
+class TestElasticAgentWatchdog:
+    def test_sigusr1_stack_dump_registered(self):
+        """Satellite: agent start registers a faulthandler SIGUSR1 handler
+        so operators can stack-dump a live wedged process."""
+        import faulthandler
+
+        faulthandler.unregister(signal.SIGUSR1)     # clean slate
+        DSElasticAgent(_agent_factory(), "/tmp/unused-ckpt",
+                       install_signal_handlers=False)._install_stack_dump_signal()
+        assert faulthandler.unregister(signal.SIGUSR1) is True
+
+    @pytest.mark.watchdog
+    @pytest.mark.chaos
+    def test_watchdog_timeout_is_restartable(self, tmp_path):
+        """Acceptance tail: hang → WatchdogTimeout → agent restart from the
+        last verified tag → run completes; the reason lands in
+        restart_reasons."""
+        install_chaos(ChaosInjector(hang_at={"train_step": [3]}, hang_s=120.0))
+        agent = DSElasticAgent(
+            _agent_factory(watchdog={"enabled": True, "min_step_timeout": 0.4,
+                                     "startup_timeout": 60.0}),
+            str(tmp_path / "ckpt"), checkpoint_interval=1, max_restarts=2,
+            install_signal_handlers=False)
+        t0 = time.monotonic()
+        out = agent.run(_batches, num_steps=4)
+        assert out["status"] == "complete"
+        assert out["final_step"] == 4
+        assert out["restarts"] == 1
+        assert any("WatchdogTimeout" in r for r in out["restart_reasons"])
+        assert time.monotonic() - t0 < 300.0
+        # every agent exit path closes the engine's watchdog monitor thread
+        assert not any(t.name.startswith("ds-watchdog")
+                       for t in threading.enumerate())
+
+
+@pytest.mark.watchdog
+@pytest.mark.chaos
+def test_watchdog_e2e_5s_stall_restarts(tmp_path):
+    """Slow sweep (tests/slow_tests.txt): a genuine multi-second stall
+    mid-step — the watchdog fires at its deadline (well before the stall
+    ends), dumps stacks, and the agent restarts from the last verified tag."""
+    dump = str(tmp_path / "stacks.txt")
+    cfg = TelemetryConfig(enabled=True, output_dir=str(tmp_path / "telem"),
+                          trace=False, jsonl=False, prometheus=False)
+    telemetry.install_session(TelemetrySession(cfg))
+    install_chaos(ChaosInjector(hang_at={"train_step": [3]}, hang_s=5.0))
+    agent = DSElasticAgent(
+        _agent_factory(watchdog={"enabled": True, "min_step_timeout": 1.0,
+                                 "startup_timeout": 120.0,
+                                 "stack_dump_file": dump}),
+        str(tmp_path / "ckpt"), checkpoint_interval=1, max_restarts=2,
+        install_signal_handlers=False)
+    out = agent.run(_batches, num_steps=5)
+    assert out["status"] == "complete" and out["restarts"] == 1
+    with open(dump) as f:
+        assert "watchdog stack dump" in f.read()
+    assert _counter_total(telemetry.get_registry(),
+                          "resilience/watchdog_timeouts") >= 1
+
+
+# --------------------------------------------------------------------------- #
+# launcher supervision
+# --------------------------------------------------------------------------- #
+class TestLauncherSupervision:
+    def test_clean_exit_passthrough(self):
+        import subprocess
+        import sys
+
+        from deepspeed_tpu.launcher.launch import supervise
+
+        proc = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(7)"])
+        code, reason = supervise(proc, poll_interval=0.05)
+        assert code == 7 and reason == "exited"
+
+    def test_stale_heartbeat_kills_process_group(self, tmp_path):
+        import subprocess
+        import sys
+
+        from deepspeed_tpu.launcher.launch import (HEARTBEAT_KILL_EXIT_CODE,
+                                                   supervise)
+
+        hb = tmp_path / "heartbeat"
+        hb.write_text("")
+        os.utime(hb, (time.time() - 100, time.time() - 100))    # already stale
+        proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"],
+                                start_new_session=True)
+        t0 = time.monotonic()
+        code, reason = supervise(proc, heartbeat_file=str(hb),
+                                 heartbeat_timeout=5.0, poll_interval=0.05,
+                                 kill_grace=2.0)
+        assert code == HEARTBEAT_KILL_EXIT_CODE
+        assert "heartbeat stale" in reason
+        assert proc.poll() is not None, "wedged child must be dead"
+        assert time.monotonic() - t0 < 30.0
+
+    def test_missing_heartbeat_file_never_trips(self, tmp_path):
+        import subprocess
+        import sys
+
+        from deepspeed_tpu.launcher.launch import supervise
+
+        proc = subprocess.Popen([sys.executable, "-c",
+                                 "import time; time.sleep(0.3)"])
+        code, reason = supervise(proc, heartbeat_file=str(tmp_path / "never-made"),
+                                 heartbeat_timeout=0.05, poll_interval=0.05)
+        assert code == 0 and reason == "exited"
+
+    def test_heartbeat_env_exported_to_child(self, tmp_path):
+        import base64
+        import json
+
+        from deepspeed_tpu.launcher import launch
+
+        info = base64.urlsafe_b64encode(json.dumps({"h": [0]}).encode()).decode()
+        args = launch.parse_args(["--world_info", info,
+                                  "--heartbeat_file", str(tmp_path / "hb"),
+                                  "--heartbeat_timeout", "30", "script.py"])
+        env = launch.build_env({"h": [0]}, 0, "127.0.0.1", 8476)
+        if args.heartbeat_file:
+            env["DS_TPU_HEARTBEAT_FILE"] = args.heartbeat_file
+        assert env["DS_TPU_HEARTBEAT_FILE"] == str(tmp_path / "hb")
+
+
+def test_touch_heartbeat_creates_and_advances(tmp_path):
+    p = str(tmp_path / "nested" / "hb")
+    assert touch_heartbeat(p) is True
+    m1 = os.path.getmtime(p)
+    time.sleep(0.05)
+    assert touch_heartbeat(p) is True
+    assert os.path.getmtime(p) > m1

@@ -1,0 +1,38 @@
+"""ds_doctor — static graph, sharding & collective analysis.
+
+PRs 1–3 built runtime defenses (resilience, telemetry, watchdog) that
+detect failures *after* accelerator-hours are already burning; the
+cheapest failure is the one rejected before compilation. This package
+lints what is statically knowable from the program BEFORE step 0:
+
+* **graph pass** (:mod:`~deepspeed_tpu.analysis.graph_lint`) — abstract-trace
+  the train step to a jaxpr (``jax.make_jaxpr`` costs a trace, not a
+  compile) and flag recompilation hazards, silent fp32/f64 promotion
+  under a bf16/fp16 config, missing buffer donation, and large arrays
+  left replicated when the ZeRO stage says they should be partitioned;
+* **collective pass** (:mod:`~deepspeed_tpu.analysis.collectives`) — a
+  record mode in ``comm`` captures each rank's static collective
+  sequence (op, shape, dtype, group) and diffs it across ranks, so an
+  order/shape/group mismatch is reported with the divergent rank and
+  call site instead of becoming a watchdog-detected hang;
+* **schema pass** (:mod:`~deepspeed_tpu.analysis.schema`) — a recursive
+  ds_config walk with did-you-mean unknown-key findings and cross-field
+  constraint checks (zero stage vs offload, watchdog vs telemetry, …);
+* **self-lint** (:mod:`~deepspeed_tpu.analysis.selflint`) — an AST lint
+  of this codebase (untimed host collectives outside ``comm``, bare
+  ``time.time()`` in the step path) that runs in tier-1.
+
+Entry points: the ``analysis`` ds_config block (engine init — a STRICT
+no-op when the block is absent: this package is never even imported),
+the ``bin/ds_doctor`` CLI, and ``bin/ds_report doctor``. Findings are
+structured (:class:`~deepspeed_tpu.analysis.findings.Finding`), counted
+through the telemetry registry, and rendered by the CLIs.
+"""
+
+from deepspeed_tpu.analysis.findings import (AnalysisError, AnalysisReport,  # noqa: F401
+                                             Finding, SEVERITIES)
+from deepspeed_tpu.analysis.doctor import (engine_graph_analysis,  # noqa: F401
+                                           engine_init_analysis, run_doctor)
+
+__all__ = ["Finding", "AnalysisReport", "AnalysisError", "SEVERITIES",
+           "run_doctor", "engine_init_analysis", "engine_graph_analysis"]

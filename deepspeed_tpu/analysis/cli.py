@@ -1,0 +1,145 @@
+"""ds_doctor CLI — catch the TPU-burning bug before step 0.
+
+Usage::
+
+    ds_doctor --config ds_config.json [options]
+
+Options:
+    --config PATH          ds_config JSON (required unless --passes selflint)
+    --model FAMILY         trace a registry family's fwd+bwd graph under the
+                           config's compute dtype (gpt2 | llama | moe | bert,
+                           or any preset name like gpt2-tiny)
+    --graph FILE[:FN]      custom graph builder: FILE is a python file whose
+                           FN (default "build_graph") is called with the
+                           parsed DeepSpeedConfig and returns (fn, args) or
+                           (fn, args, donate_argnums) — your actual train
+                           step, linted instead of a fixture
+    --collective-log PATH  recorded collective sequence JSON, one flag per
+                           rank (analysis.collectives.CollectiveRecorder
+                           .save); two or more are diffed across ranks
+    --passes LIST          comma list of schema,sharding,graph,collectives,
+                           selflint (default: every pass its inputs allow)
+    --fail-on LEVEL        error | warn | never (default error): exit 2 when
+                           findings at/above LEVEL exist
+    --world-size N         data-parallel world for batch-triple validation
+    --batch N --seq N      synthetic batch geometry for --model (default 2/16)
+    --json                 machine-readable report on stdout
+
+Exit codes: 0 = clean (below fail-on), 2 = findings tripped fail-on,
+1 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _parse(argv):
+    ap = argparse.ArgumentParser(
+        prog="ds_doctor",
+        description="static graph/sharding/collective/config analysis")
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--graph", default=None)
+    ap.add_argument("--collective-log", action="append", default=[])
+    ap.add_argument("--passes", default=None)
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warn", "never"])
+    ap.add_argument("--world-size", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=16)
+    ap.add_argument("--json", action="store_true")
+    return ap.parse_args(argv)
+
+
+def _load_graph_builder(spec: str, cfg):
+    """FILE[:FN] -> (fn, args[, donate_argnums]) from user code."""
+    path, _, fn_name = spec.partition(":")
+    fn_name = fn_name or "build_graph"
+    scope: dict = {"__file__": path, "__name__": "_ds_doctor_graph"}
+    with open(path) as f:
+        exec(compile(f.read(), path, "exec"), scope)
+    builder = scope.get(fn_name)
+    if builder is None:
+        raise SystemExit(f"ds_doctor: {path} defines no {fn_name}()")
+    out = builder(cfg)
+    if len(out) == 2:
+        # no donation opinion from the builder: None (not ()) keeps the
+        # donation lint off — run_doctor's contract is that it runs only
+        # when the caller/builder actually states the donation set
+        fn, args = out
+        return fn, args, None
+    fn, args, donate = out
+    return fn, args, donate
+
+
+def main(argv=None) -> int:
+    args = _parse(list(sys.argv[1:] if argv is None else argv))
+    from deepspeed_tpu.analysis.doctor import ALL_PASSES, run_doctor
+
+    # None = "every pass its inputs allow"; an explicit list additionally
+    # reports pass-skipped findings when a requested pass cannot run
+    passes = tuple(args.passes.split(",")) if args.passes else None
+    unknown = [p for p in (passes or ()) if p not in ALL_PASSES]
+    if unknown:
+        print(f"ds_doctor: unknown pass(es) {unknown}; known: {ALL_PASSES}",
+              file=sys.stderr)
+        return 1
+    if args.config is None and set(passes or ALL_PASSES) != {"selflint"}:
+        print("ds_doctor: --config is required (or --passes selflint)",
+              file=sys.stderr)
+        return 1
+
+    graph = None
+    if args.graph:
+        if args.config is None:
+            print("ds_doctor: --graph needs --config", file=sys.stderr)
+            return 1
+        # deferred: run_doctor parses the config ONCE and hands it to the
+        # builder (the graph pass is skipped when the config is invalid —
+        # the schema findings explain why)
+        graph = lambda cfg: _load_graph_builder(args.graph, cfg)
+
+    try:
+        report = run_doctor(
+            args.config if args.config is not None else {},
+            passes=passes, fail_on=args.fail_on, model=args.model,
+            graph=graph,
+            collective_logs=args.collective_log or None,
+            world_size=args.world_size, batch_size=args.batch,
+            seq_len=args.seq)
+    except FileNotFoundError as e:
+        print(f"ds_doctor: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render())
+    return 2 if report.should_fail(args.fail_on) else 0
+
+
+def doctor_section(argv) -> int:
+    """``ds_report doctor --config X [--fail-on L]`` — the config/schema
+    pass only, rendered as a report section (the full tool is ds_doctor)."""
+    ap = argparse.ArgumentParser(prog="ds_report doctor")
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--fail-on", default="never",
+                    choices=["error", "warn", "never"])
+    args = ap.parse_args(argv)
+    from deepspeed_tpu.analysis.doctor import run_doctor
+
+    report = run_doctor(args.config, passes=("schema",),
+                        fail_on=args.fail_on)
+    line = "-" * 72
+    print(line)
+    print("doctor: config/schema findings")
+    print(line)
+    print(report.render("ds_doctor (schema pass)"))
+    print(line)
+    print("run bin/ds_doctor for the graph / sharding / collective passes")
+    return 2 if report.should_fail(args.fail_on) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,250 @@
+"""Pass 2 — collective deadlock detector.
+
+A multi-controller SPMD job deadlocks when two ranks issue DIFFERENT
+collective sequences: rank 3 calls all_gather where everyone else calls
+all_reduce, and every rank blocks forever inside its own op. At runtime
+that is a watchdog-detected hang (PR 3) with zero attribution; but the
+sequence each rank WILL issue is statically knowable — record it once
+(tracing is enough, no execution), diff across ranks, and the report
+names the divergent rank and the exact call site before step 0.
+
+Record mode: :func:`record_collectives` installs a recorder into the
+``comm`` layer (``comm.set_collective_recorder``); every collective —
+eager or traced — reports (op, shape, dtype, group axes) plus the
+user-level call site. The sequence fingerprints through the same sha256
+machinery the resilience consistency guard uses
+(:func:`~deepspeed_tpu.resilience.consistency.find_divergent`), so
+cross-rank agreement is one tiny allgather of 32-byte digests; only on
+mismatch is the full sequence pulled for the detailed diff.
+
+The ``collective_mismatch`` chaos fault class
+(:mod:`deepspeed_tpu.resilience.chaos`) perturbs one rank's recorded
+sequence, making the detector deterministically testable end to end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import traceback
+from contextlib import contextmanager
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
+
+from deepspeed_tpu.analysis.findings import Finding
+
+RULE_MISMATCH = "collectives/sequence-mismatch"
+
+
+class CollectiveRecord(NamedTuple):
+    op: str                  # all_reduce / all_gather / barrier / ...
+    shape: Tuple[int, ...]
+    dtype: str
+    axes: Tuple[str, ...]    # mesh axis names = the group
+    site: str = ""           # user-level call site (file:line)
+
+    def describe(self) -> str:
+        grp = "+".join(self.axes) if self.axes else "world"
+        return f"{self.op}({self.dtype}{list(self.shape)} over {grp})"
+
+
+def _call_site() -> str:
+    """First stack frame outside jax / the comm+analysis layers."""
+    for frame in reversed(traceback.extract_stack(limit=24)):
+        f = frame.filename.replace("\\", "/")
+        if ("/deepspeed_tpu/comm/" in f or "/deepspeed_tpu/analysis/" in f
+                or "/jax/" in f or "/jax/_src/" in f):
+            continue
+        return f"{f.rsplit('/', 1)[-1]}:{frame.lineno}"
+    return ""
+
+
+class CollectiveRecorder:
+    """Accumulates the static collective sequence of this rank."""
+
+    def __init__(self):
+        self.records: List[CollectiveRecord] = []
+
+    def record(self, op: str, shape, dtype, axes) -> None:
+        self.records.append(CollectiveRecord(
+            op=str(op), shape=tuple(int(s) for s in shape),
+            dtype=str(dtype), axes=tuple(str(a) for a in axes),
+            site=_call_site()))
+
+    def fingerprint(self) -> str:
+        return collective_fingerprint(self.records)
+
+    def apply_chaos(self) -> bool:
+        """Let an active chaos injector with the ``collective_mismatch``
+        fault class perturb this rank's sequence; returns True if it did."""
+        from deepspeed_tpu.resilience.chaos import active_injector
+
+        inj = active_injector()
+        if inj is None or not getattr(inj, "collective_mismatch", False):
+            return False
+        perturbed = inj.perturb_collectives(self.records)
+        changed = perturbed != self.records
+        self.records = perturbed
+        return changed
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump([r._asdict() for r in self.records], f, indent=1)
+
+    @staticmethod
+    def load(path: str) -> List[CollectiveRecord]:
+        with open(path) as f:
+            raw = json.load(f)
+        return [CollectiveRecord(op=r["op"], shape=tuple(r["shape"]),
+                                 dtype=r["dtype"], axes=tuple(r["axes"]),
+                                 site=r.get("site", "")) for r in raw]
+
+
+@contextmanager
+def record_collectives(apply_chaos: bool = True):
+    """Capture every collective issued (eagerly or inside a trace) in the
+    body. Nesting is not supported — the comm layer holds one recorder."""
+    from deepspeed_tpu.comm import comm as _comm
+
+    rec = CollectiveRecorder()
+    _comm.set_collective_recorder(rec.record)
+    try:
+        yield rec
+    finally:
+        _comm.set_collective_recorder(None)
+        if apply_chaos:
+            rec.apply_chaos()
+
+
+def collective_fingerprint(records: Sequence[CollectiveRecord]) -> str:
+    """sha256 over the canonical sequence (op, shape, dtype, group) —
+    call sites are rank-local strings and deliberately excluded."""
+    h = hashlib.sha256()
+    for r in records:
+        h.update(json.dumps([r.op, list(r.shape), r.dtype, list(r.axes)],
+                            sort_keys=True).encode())
+    return h.hexdigest()
+
+
+def _mismatch_kind(a: Optional[CollectiveRecord],
+                   b: Optional[CollectiveRecord]) -> str:
+    if a is None or b is None:
+        return "length"
+    if a.op != b.op:
+        return "order/op"
+    if a.shape != b.shape:
+        return "shape"
+    if a.dtype != b.dtype:
+        return "dtype"
+    if a.axes != b.axes:
+        return "group"
+    return "other"
+
+
+def diff_sequences(sequences: Union[Dict[int, Sequence[CollectiveRecord]],
+                                    Sequence[Sequence[CollectiveRecord]]],
+                   majority_rank: Optional[int] = None) -> List[Finding]:
+    """Diff per-rank collective sequences; one error finding per divergent
+    rank, citing the first divergent position and its call site.
+
+    The reference sequence is the majority fingerprint (ties resolve
+    toward the lowest rank — the convention
+    :func:`resilience.consistency.find_divergent` uses), unless
+    ``majority_rank`` pins it explicitly — the cross-rank verify path
+    uses that when it already KNOWS which rank holds the majority
+    sequence (a two-way diff has no meaningful vote).
+    """
+    from collections import Counter
+
+    if not isinstance(sequences, dict):
+        sequences = {i: s for i, s in enumerate(sequences)}
+    if len(sequences) < 2:
+        return []
+    fps = {rank: collective_fingerprint(seq) for rank, seq in sequences.items()}
+    if majority_rank is not None and majority_rank in fps:
+        ref_rank = majority_rank
+        majority_fp = fps[ref_rank]
+    else:
+        majority_fp, _ = Counter(
+            fps[r] for r in sorted(fps)).most_common(1)[0]
+        ref_rank = min(r for r, fp in fps.items() if fp == majority_fp)
+    ref = list(sequences[ref_rank])
+
+    findings: List[Finding] = []
+    for rank in sorted(sequences):
+        if fps[rank] == majority_fp:
+            continue
+        seq = list(sequences[rank])
+        idx = next((i for i in range(max(len(ref), len(seq)))
+                    if i >= len(ref) or i >= len(seq)
+                    or ref[i][:4] != seq[i][:4]), 0)
+        mine = seq[idx] if idx < len(seq) else None
+        theirs = ref[idx] if idx < len(ref) else None
+        kind = _mismatch_kind(theirs, mine)
+        mine_s = mine.describe() if mine else "(sequence ended)"
+        theirs_s = theirs.describe() if theirs else "(sequence ended)"
+        site = (mine.site if mine and mine.site else
+                (theirs.site if theirs else ""))
+        findings.append(Finding(
+            rule=RULE_MISMATCH, severity="error",
+            message=(f"collective #{idx} diverges ({kind} mismatch): rank "
+                     f"{rank} issues {mine_s} where rank {ref_rank} (majority)"
+                     f" issues {theirs_s} — at runtime every rank would block"
+                     " forever inside its own op (watchdog hang, zero "
+                     "attribution)"),
+            citation=f"collective[{idx}] @ {site}" if site else f"collective[{idx}]",
+            rank=rank, pass_name="collectives"))
+    return findings
+
+
+def verify_collective_consistency(recorder: CollectiveRecorder) -> List[Finding]:
+    """Cross-rank agreement on this rank's recorded sequence.
+
+    Cheap path: 32-byte fingerprint digests allgathered through the same
+    machinery as the resilience consistency guard
+    (:func:`~deepspeed_tpu.resilience.consistency.find_divergent` names
+    the divergent rank exactly like the step-agreement guard does).
+    Only when digests disagree is the majority rank's full sequence
+    broadcast for the detailed positional diff. Single process: nothing
+    to diverge from, returns []."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return []
+    from deepspeed_tpu.comm import comm as _comm
+    from deepspeed_tpu.resilience.consistency import find_divergent
+
+    fp = recorder.fingerprint()
+    buf = np.frombuffer(bytes.fromhex(fp), dtype=np.uint8)
+    rows = np.asarray(_comm.allgather_host(buf)).reshape(-1, buf.size)
+    bad = find_divergent(rows)
+    if not bad:
+        return []
+    # full-sequence exchange only on the failure path. The broadcast root
+    # is always process 0 (the multihost primitive's contract), so which
+    # side of the diff holds the MAJORITY must come from the fingerprint
+    # vote, not from who broadcast: with rank 0 healthy, a divergent rank
+    # diffs itself against rank 0's sequence; with rank 0 itself
+    # divergent, each healthy rank diffs rank 0's sequence against its
+    # own majority copy — either way the finding blames the bad rank.
+    ref = _comm.broadcast_object_list([recorder.records], src=0)[0]
+    me = jax.process_index()
+    findings: List[Finding] = []
+    if 0 not in bad and me in bad:
+        findings = diff_sequences({0: ref, me: recorder.records},
+                                  majority_rank=0)
+    elif 0 in bad and me not in bad:
+        findings = diff_sequences({0: ref, me: recorder.records},
+                                  majority_rank=me)
+    if not findings:
+        # healthy rank observing someone else diverge, both sides of the
+        # exchange divergent, or a site-only difference: report at
+        # fingerprint granularity so EVERY rank's log names the bad set
+        findings = [Finding(
+            rule=RULE_MISMATCH, severity="error",
+            message=("collective-sequence fingerprints diverge across "
+                     f"ranks: rank(s) {sorted(bad)} disagree with the "
+                     "majority"
+                     + (" (this rank is among them)" if me in bad else "")),
+            rank=me if me in bad else None, pass_name="collectives")]
+    return findings

@@ -1,0 +1,139 @@
+"""Structured findings shared by every ds_doctor pass.
+
+A finding is (severity, rule id, message, citation) — the citation names
+the offending config key, jaxpr op + source line, or divergent rank, so
+the report is actionable without re-running anything. Reports know the
+``fail_on`` contract (``error`` | ``warn`` | ``never``) and count
+themselves into the telemetry registry (``analysis/findings`` by rule
+and severity) so a CI dashboard can watch lint trends like any other
+series.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+# Ordered worst-first; ``fail_on: warn`` fails on warning-or-worse.
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str                    # e.g. "graph/dtype-promotion"
+    severity: str                # error | warning | info
+    message: str                 # names the offending key/op/rank
+    citation: str = ""           # config key path, file:line, jaxpr op
+    rank: Optional[int] = None   # divergent rank (collective pass)
+    pass_name: str = ""          # schema | graph | sharding | collectives | selflint
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity {self.severity!r} not in {SEVERITIES}")
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v not in (None, "")}
+
+    def __str__(self):
+        where = f" [{self.citation}]" if self.citation else ""
+        who = f" (rank {self.rank})" if self.rank is not None else ""
+        return f"{self.severity.upper():7s} {self.rule}: {self.message}{who}{where}"
+
+
+class AnalysisError(RuntimeError):
+    """Raised when a report trips its ``fail_on`` threshold. Carries the
+    report so callers (engine init, CLI) can still render everything."""
+
+    def __init__(self, message: str, report: "AnalysisReport"):
+        super().__init__(message)
+        self.report = report
+
+
+class AnalysisReport:
+    """An ordered collection of findings from one or more passes."""
+
+    def __init__(self, findings: Optional[List[Finding]] = None):
+        self.findings: List[Finding] = list(findings or [])
+        self.passes_run: List[str] = []
+
+    def add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def extend(self, findings, pass_name: str = "") -> "AnalysisReport":
+        for f in findings:
+            if pass_name and not f.pass_name:
+                f.pass_name = pass_name
+            self.findings.append(f)
+        if pass_name and pass_name not in self.passes_run:
+            self.passes_run.append(pass_name)
+        return self
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warning"]
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def should_fail(self, fail_on: str) -> bool:
+        """``error``: any error fails. ``warn``: any warning-or-worse
+        fails. ``never``: report only."""
+        if fail_on == "never":
+            return False
+        if fail_on == "warn":
+            return bool(self.errors or self.warnings)
+        if fail_on == "error":
+            return bool(self.errors)
+        raise ValueError(f"fail_on must be error|warn|never, got {fail_on!r}")
+
+    def raise_if(self, fail_on: str) -> None:
+        if self.should_fail(fail_on):
+            c = self.counts()
+            head = (f"ds_doctor: {c['error']} error(s), {c['warning']} "
+                    f"warning(s) at fail_on={fail_on!r}")
+            worst = self.errors or self.warnings
+            detail = "\n".join(f"  {f}" for f in worst[:8])
+            raise AnalysisError(f"{head}\n{detail}", self)
+
+    def count_into_registry(self) -> None:
+        """One ``analysis/findings`` counter bump per finding, labeled by
+        rule and severity (noop registry when telemetry is off)."""
+        from deepspeed_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        for f in self.findings:
+            reg.counter("analysis/findings",
+                        labels={"rule": f.rule, "severity": f.severity}).inc()
+
+    def render(self, title: str = "ds_doctor report") -> str:
+        c = self.counts()
+        lines = [title,
+                 f"passes: {', '.join(self.passes_run) or '(none)'}  |  "
+                 f"errors: {c['error']}  warnings: {c['warning']}  "
+                 f"info: {c['info']}"]
+        by_pass: Dict[str, List[Finding]] = {}
+        for f in self.findings:
+            by_pass.setdefault(f.pass_name or "-", []).append(f)
+        for pass_name in sorted(by_pass):
+            lines.append(f"[{pass_name}]")
+            for f in by_pass[pass_name]:
+                lines.append(f"  {f}")
+        if not self.findings:
+            lines.append("no findings — every pass that ran came back clean "
+                         "(the 'passes:' line above says which ran)")
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({"counts": self.counts(),
+                           "passes": self.passes_run,
+                           "findings": [f.to_dict() for f in self.findings]},
+                          indent=2)

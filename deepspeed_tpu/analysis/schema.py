@@ -1,0 +1,205 @@
+"""Pass 3a — recursive config schema walk + cross-field constraints.
+
+``runtime/config.py`` already rejects unknown TOP-level keys with
+did-you-mean hints and every pydantic sub-block forbids extra fields
+(with the same hints, via ``DeepSpeedConfigModel``); this pass goes two
+steps further, as findings instead of a first-error exception:
+
+* every sub-block is validated INDEPENDENTLY, so one report lists every
+  broken block instead of stopping at the first;
+* the raw-dict blocks the runtime consumes permissively (``autotuning``,
+  ``data_efficiency``, ``sparse_attention``, legacy
+  ``curriculum_learning``) are walked against their accepted key sets —
+  a typo there used to be a silent no-op, the worst failure mode a
+  config surface can have;
+* cross-FIELD constraints that are individually valid but jointly
+  wrong (ZeRO stage vs offload, 1-bit optimizer vs stage/fp16, MiCS vs
+  mesh divisibility, watchdog vs telemetry) are checked statically,
+  instead of erroring at engine init after the job already scheduled.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.analysis.findings import Finding
+
+RULE_UNKNOWN_KEY = "config/unknown-key"
+RULE_INVALID = "config/invalid-value"
+RULE_CROSS_FIELD = "config/cross-field"
+
+def _block_models() -> Dict[str, type]:
+    """Top-level key -> pydantic block model (mirrors DeepSpeedConfig)."""
+    from deepspeed_tpu.compression.config import CompressionConfig
+    from deepspeed_tpu.runtime import config as C
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+    return {
+        "fp16": C.FP16Config, "bf16": C.BF16Config, "bfloat16": C.BF16Config,
+        "zero_optimization": DeepSpeedZeroConfig,
+        "comms_logger": C.CommsLoggerConfig,
+        "flops_profiler": C.FlopsProfilerConfig,
+        "activation_checkpointing": C.ActivationCheckpointingConfig,
+        "tensorboard": C.TensorboardConfig, "wandb": C.WandbConfig,
+        "csv_monitor": C.CSVConfig, "pipeline": C.PipelineConfig,
+        "tpu": C.TPUMeshConfig, "checkpoint": C.CheckpointConfig,
+        "data_types": C.DataTypesConfig, "aio": C.AioConfig,
+        "elasticity": C.ElasticityConfig,
+        "hybrid_engine": C.HybridEngineConfig,
+        "gradient_compression": C.GradientCompressionConfig,
+        "eigenvalue": C.EigenvalueConfig,
+        "progressive_layer_drop": C.PLDConfig,
+        "resilience": C.ResilienceConfig, "watchdog": C.WatchdogConfig,
+        "telemetry": C.TelemetryConfig, "analysis": C.AnalysisConfig,
+        "compression_training": CompressionConfig,
+    }
+
+
+def _check_raw_block(pd: dict, findings: List[Finding]) -> None:
+    """Unknown-key walk over the raw-dict blocks, against the same
+    accepted-key sets config parsing enforces (runtime/config.py
+    ``RAW_BLOCK_KEYS``) — here as one finding per key so the report is
+    complete instead of first-error-wins."""
+    from deepspeed_tpu.runtime.config import RAW_BLOCK_KEYS
+    from deepspeed_tpu.runtime.config_utils import format_unknown_key_hints
+
+    for where, accepted in RAW_BLOCK_KEYS.items():
+        head, _, tail = where.partition(".")
+        block = pd.get(head)
+        if tail and isinstance(block, dict):
+            block = block.get(tail)
+        if not isinstance(block, dict):
+            continue
+        for key in sorted(set(block) - accepted):
+            findings.append(Finding(
+                rule=RULE_UNKNOWN_KEY, severity="error",
+                message=(f"unknown key "
+                         f"{format_unknown_key_hints({key}, accepted)} in "
+                         f"the {where} block — it would be silently ignored"),
+                citation=f"{where}.{key}", pass_name="schema"))
+
+
+def _trim(msg: str, limit: int = 400) -> str:
+    msg = " ".join(str(msg).split())
+    return msg if len(msg) <= limit else msg[:limit] + "…"
+
+
+def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
+    from deepspeed_tpu.runtime.config import (ONEBIT_ADAM_OPTIMIZER,
+                                              ONEBIT_LAMB_OPTIMIZER,
+                                              ZERO_ONE_ADAM_OPTIMIZER)
+
+    def add(severity, message, citation):
+        findings.append(Finding(rule=RULE_CROSS_FIELD, severity=severity,
+                                message=message, citation=citation,
+                                pass_name="schema"))
+
+    zc = cfg.zero_config
+    stage = int(zc.stage)
+    if zc.offload_param is not None and stage < 3:
+        add("error",
+            f"zero_optimization.offload_param requires ZeRO stage 3 (params "
+            f"are only partitioned at stage 3) but stage is {stage} — the "
+            "offload would silently not happen",
+            "zero_optimization.offload_param vs .stage")
+    if zc.offload_optimizer is not None and stage == 0:
+        add("warning",
+            "zero_optimization.offload_optimizer with ZeRO stage 0 offloads "
+            "the FULL (unsharded) optimizer state through every host — set "
+            "stage >= 1 so each host streams only its shard",
+            "zero_optimization.offload_optimizer vs .stage")
+    onebit = cfg.optimizer_name in (ONEBIT_ADAM_OPTIMIZER,
+                                    ONEBIT_LAMB_OPTIMIZER,
+                                    ZERO_ONE_ADAM_OPTIMIZER)
+    if onebit and stage != 0:
+        add("error",
+            f"1-bit optimizer {cfg.optimizer_name!r} requires ZeRO stage 0 "
+            f"(compressed comm replaces ZeRO's) but stage is {stage} — "
+            "engine init will refuse this config",
+            "optimizer.type vs zero_optimization.stage")
+    if onebit and cfg.fp16.enabled:
+        add("error",
+            f"1-bit optimizer {cfg.optimizer_name!r} with fp16: dynamic loss "
+            "scaling would sit inside the compressed loop — use bf16/fp32",
+            "optimizer.type vs fp16.enabled")
+    if zc.offload_optimizer is not None and \
+            zc.offload_optimizer.device == "nvme" and cfg.fp16.enabled:
+        add("error",
+            "NVMe optimizer offload supports bf16/fp32 only (fp16 dynamic "
+            "loss scaling is a device-side loop) — engine init will refuse",
+            "zero_optimization.offload_optimizer.device vs fp16.enabled")
+    mics = int(getattr(zc, "mics_shard_size", -1) or -1)
+    if mics > 0 and cfg.mesh_config.data not in (-1, None) and \
+            cfg.mesh_config.data % mics:
+        add("error",
+            f"zero_optimization.mics_shard_size={mics} does not divide the "
+            f"tpu.data axis ({cfg.mesh_config.data}) — engine init will "
+            "refuse this mesh factoring",
+            "zero_optimization.mics_shard_size vs tpu.data")
+    wd = cfg.watchdog
+    if "watchdog" in pd and not wd.enabled and wd.consistency_interval > 0:
+        add("warning",
+            "watchdog.consistency_interval is set but watchdog.enabled is "
+            "false — no agreement round will ever run",
+            "watchdog.consistency_interval vs .enabled")
+    tel = cfg.telemetry
+    if tel.enabled and tel.monitor and not (
+            cfg.monitor_config.tensorboard.enabled
+            or cfg.monitor_config.wandb.enabled
+            or cfg.monitor_config.csv_monitor.enabled):
+        add("warning",
+            "telemetry.monitor fans metrics out through the monitor writers "
+            "but no tensorboard/wandb/csv_monitor block is enabled — the "
+            "fan-out goes nowhere",
+            "telemetry.monitor vs tensorboard/wandb/csv_monitor")
+    if wd.enabled and not tel.enabled:
+        add("info",
+            "watchdog is enabled without telemetry: watchdog_timeouts / "
+            "desync counters go to the no-op registry (detection still "
+            "works; you just cannot chart it)",
+            "watchdog.enabled vs telemetry.enabled")
+
+
+def walk_config(pd: dict, world_size: Optional[int] = None
+                ) -> Tuple[List[Finding], Optional[object]]:
+    """Validate a ds_config dict; returns (findings, DeepSpeedConfig|None).
+
+    Unlike plain construction (first error wins), every sub-block is
+    checked independently so the report is complete in one shot."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    findings: List[Finding] = []
+    if not isinstance(pd, dict):
+        return [Finding(rule=RULE_INVALID, severity="error",
+                        message=f"ds_config must be a dict, got {type(pd).__name__}",
+                        citation="ds_config", pass_name="schema")], None
+
+    for key, model in _block_models().items():
+        block = pd.get(key)
+        if not isinstance(block, dict):
+            continue
+        try:
+            model(**block)
+        except ValueError as e:
+            findings.append(Finding(
+                rule=RULE_UNKNOWN_KEY if "Unknown key" in str(e)
+                else RULE_INVALID,
+                severity="error", message=_trim(e), citation=key,
+                pass_name="schema"))
+    _check_raw_block(pd, findings)
+
+    cfg = None
+    try:
+        cfg = DeepSpeedConfig(dict(pd), world_size=world_size)
+    except ValueError as e:
+        msg = _trim(e)
+        dup = any(f.message == msg for f in findings) or (
+            "Unknown key(s)" in msg
+            and any(f.rule == RULE_UNKNOWN_KEY for f in findings))
+        if not dup:
+            findings.append(Finding(rule=RULE_INVALID, severity="error",
+                                    message=msg, citation="ds_config",
+                                    pass_name="schema"))
+    if cfg is not None:
+        _cross_field(cfg, pd, findings)
+    return findings, cfg

@@ -1,0 +1,124 @@
+"""Pass 3b — AST self-lint of this codebase, run in tier-1.
+
+Two rules, both born from real incident classes:
+
+* ``selflint/untimed-host-collective`` — host-side collectives
+  (``multihost_utils.sync_global_devices`` / ``process_allgather`` /
+  ``broadcast_one_to_all``) are forbidden outside ``comm/comm.py``.
+  A raw host sync bypasses the comm layer's recorder, its telemetry
+  timing, and the watchdog's barrier deadline — it is exactly the call
+  that wedges a job with zero attribution. In-trace ``lax.*``
+  collectives are NOT flagged: XLA owns their scheduling and timing
+  (the ``timed_op`` contract), and model/pipe code legitimately issues
+  them inside shard_map.
+* ``selflint/bare-time-in-step-path`` — ``time.time()`` is forbidden in
+  the step-path modules. Wall-clock is not monotonic (NTP slews, leap
+  smears); a backwards jump mid-step turns a latency histogram or a
+  watchdog deadline negative. Durations must use ``time.perf_counter``
+  / ``time.monotonic``; the timer subsystem (``utils/timer.py``) and
+  timestamp-emitting exporters are exempt by path.
+
+The lint is itself a tier-1 test (``tests/unit/test_analysis.py``), so
+a regression cannot merge.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence
+
+from deepspeed_tpu.analysis.findings import Finding
+
+RULE_UNTIMED_COLLECTIVE = "selflint/untimed-host-collective"
+RULE_BARE_TIME = "selflint/bare-time-in-step-path"
+
+HOST_COLLECTIVE_ATTRS = frozenset({"sync_global_devices", "process_allgather",
+                                   "broadcast_one_to_all"})
+# the one routing point host collectives are allowed to live in
+HOST_COLLECTIVE_ALLOWED = ("comm/comm.py",)
+
+# modules on the per-step hot path where wall-clock reads are forbidden
+STEP_PATH_FILES = ("runtime/engine.py", "comm/comm.py",
+                   "runtime/hybrid_engine.py", "inference/engine.py",
+                   "runtime/pipe/engine.py", "resilience/watchdog.py")
+
+
+def _dotted(node: ast.AST) -> str:
+    """'a.b.c' for an Attribute/Name chain, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def lint_source(src: str, relpath: str) -> List[Finding]:
+    """Lint one module's source. ``relpath`` is package-relative with
+    forward slashes (e.g. ``runtime/engine.py``)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(rule="selflint/syntax-error", severity="error",
+                        message=f"cannot parse: {e}", citation=relpath,
+                        pass_name="selflint")]
+    findings: List[Finding] = []
+    relpath = relpath.replace("\\", "/")
+    in_step_path = any(relpath.endswith(p) for p in STEP_PATH_FILES)
+    collectives_allowed = any(relpath.endswith(p)
+                              for p in HOST_COLLECTIVE_ALLOWED)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in HOST_COLLECTIVE_ATTRS and not collectives_allowed:
+            findings.append(Finding(
+                rule=RULE_UNTIMED_COLLECTIVE, severity="error",
+                message=(f"host-side collective {name or leaf}() outside the "
+                         "comm layer — it bypasses the collective recorder, "
+                         "telemetry timing and the watchdog barrier deadline;"
+                         " route it through deepspeed_tpu.comm (e.g. "
+                         "comm.allgather_host / comm.monitored_barrier)"),
+                citation=f"{relpath}:{node.lineno}", pass_name="selflint"))
+        if in_step_path and name in ("time.time",):
+            findings.append(Finding(
+                rule=RULE_BARE_TIME, severity="error",
+                message=("bare time.time() in the step path — wall-clock is "
+                         "not monotonic (NTP slew turns latencies/deadlines "
+                         "negative); use time.perf_counter() or "
+                         "time.monotonic() for durations"),
+                citation=f"{relpath}:{node.lineno}", pass_name="selflint"))
+    return findings
+
+
+def lint_package(root: Optional[str] = None,
+                 skip_dirs: Sequence[str] = ("__pycache__",)) -> List[Finding]:
+    """Lint every .py file of the deepspeed_tpu package."""
+    if root is None:
+        import deepspeed_tpu
+
+        root = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError as e:
+                findings.append(Finding(
+                    rule="selflint/unreadable", severity="warning",
+                    message=f"cannot read: {e}", citation=rel,
+                    pass_name="selflint"))
+                continue
+            findings.extend(lint_source(src, rel))
+    return findings

@@ -100,6 +100,35 @@ class XCCLBackend:
 cdb: Optional[XCCLBackend] = None
 comms_logger = None  # installed by configure()
 
+# ds_doctor record mode (analysis/collectives.py): when installed, every
+# collective — eager or traced — reports (op, shape, dtype, group axes)
+# so the static per-rank sequence can be diffed across ranks BEFORE the
+# mismatched program deadlocks at runtime. One `is None` check when off.
+_collective_recorder = None
+
+
+def set_collective_recorder(recorder) -> None:
+    """Install/remove (None) the collective recorder callback
+    ``recorder(op, shape, dtype, axes)``."""
+    global _collective_recorder
+    _collective_recorder = recorder
+
+
+def _record_collective(op: str, tensor, group) -> None:
+    rec = _collective_recorder
+    if rec is None:
+        return
+    try:
+        shape = tuple(getattr(tensor, "shape", ()))
+        dtype = str(jnp.dtype(tensor.dtype)) if hasattr(tensor, "dtype") else "-"
+    except Exception:
+        shape, dtype = (), "-"
+    try:
+        axes = _axes(group)
+    except Exception:
+        axes = ()
+    rec(op, shape, dtype, axes)
+
 
 def is_initialized() -> bool:
     return cdb is not None
@@ -381,14 +410,19 @@ def timed_op(func):
     def wrapper(tensor, *args, **kwargs):
         from deepspeed_tpu import telemetry
 
+        if _collective_recorder is not None:
+            group = kwargs.get("group")
+            if group is None and group_idx is not None and group_idx < len(args):
+                group = args[group_idx]
+            _record_collective(func.__name__, tensor, group)
         registry = telemetry.get_registry()
         if ((comms_logger is None and not registry.enabled)
                 or isinstance(tensor, jax.core.Tracer)):
             return func(tensor, *args, **kwargs)
-        t0 = time.time()
+        t0 = time.perf_counter()
         result = func(tensor, *args, **kwargs)
         jax.block_until_ready(result)
-        latency = time.time() - t0
+        latency = time.perf_counter() - t0
         size = _nbytes(tensor)
         group = kwargs.get("group")
         if group is None and group_idx is not None and group_idx < len(args):
@@ -559,6 +593,7 @@ def broadcast(tensor, src: int = 0, group=None, async_op: bool = False, log_name
 def ppermute(tensor, perm, group=None):
     """Point-to-point collective permute — the TPU-native send/recv
     (reference pipe/p2p.py send:50/recv:71 become one fused ppermute over ICI)."""
+    _record_collective("ppermute", tensor, group)
     axes = _axes(group)
     axis = axes[0] if len(axes) == 1 else axes
     return lax.ppermute(tensor, axis, perm)
@@ -577,6 +612,7 @@ def recv(tensor, src: int, group=None, tag: int = 0):
 
 def barrier(group=None, log_name="barrier"):
     """Cross-process sync point. In-trace it's a no-op (XLA orders ops)."""
+    _record_collective("barrier", None, group)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -729,6 +765,22 @@ def all_reduce_coalesced(tensors, op=ReduceOp.SUM, group=None):
 
 
 # ------------------------------------------------------------------ host-side
+def allgather_host(value, log_name="allgather_host"):
+    """Host-side (numpy) per-process allgather: returns an array with a
+    leading process dimension. The ONE routing point for untimed host
+    collectives outside this module — the ds_doctor self-lint forbids
+    raw ``multihost_utils.process_allgather`` elsewhere (it would bypass
+    the collective recorder and any timing/telemetry), so the
+    consistency guard and the elastic agent come through here."""
+    arr = np.asarray(value)
+    _record_collective(log_name, arr, None)
+    if jax.process_count() == 1:
+        return arr[None, ...]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
 def broadcast_object_list(obj_list, src=0, group=None):
     """Cross-process python-object broadcast (reference send_obj/recv_obj
     pickle path, pipe/p2p.py:100). Uses multihost broadcast of host bytes."""

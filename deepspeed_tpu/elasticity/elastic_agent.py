@@ -136,10 +136,10 @@ class DSElasticAgent:
         if step % self.preempt_sync_interval:
             return False
         import numpy as np
-        from jax.experimental import multihost_utils
 
-        flags = multihost_utils.process_allgather(
-            np.int32(1 if self._preempted else 0))
+        from deepspeed_tpu.comm import comm as _comm
+
+        flags = _comm.allgather_host(np.int32(1 if self._preempted else 0))
         return bool(np.max(flags))
 
     # ---------------------------------------------------------- lifecycle

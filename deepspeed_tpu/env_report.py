@@ -99,6 +99,13 @@ def kernel_report():
 
 
 def main(args=None):
+    args = list(sys.argv[1:] if args is None else args)
+    if args and args[0] == "doctor":
+        # `ds_report doctor --config X` — run the ds_doctor config/schema
+        # pass against a ds_config and print its findings
+        from deepspeed_tpu.analysis.cli import doctor_section
+
+        return doctor_section(args[1:])
     line = "-" * 72
     print(line)
     print("deepspeed_tpu environment report")

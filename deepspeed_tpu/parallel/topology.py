@@ -169,6 +169,33 @@ def topology_from_mesh(mesh: Mesh) -> ProcessTopology:
     return ProcessTopology(axes=list(mesh.axis_names), dims=[mesh.shape[a] for a in mesh.axis_names])
 
 
+def spec_axes(spec, ndim: int) -> Tuple[str, ...]:
+    """All mesh axis names a PartitionSpec actually uses, normalized over
+    the array rank (None / missing trailing entries use no axis)."""
+    if spec is None:
+        return ()
+    entries = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    axes = []
+    for e in entries:
+        if e is None:
+            continue
+        for a in (e if isinstance(e, (tuple, list)) else (e,)):
+            axes.append(a)
+    return tuple(axes)
+
+
+def unused_mesh_axes(spec, ndim: int, mesh: Mesh) -> Tuple[str, ...]:
+    """The replication set of a placement: mesh axes of size > 1 that a
+    PartitionSpec leaves unused. An array placed with ``spec`` is fully
+    materialized once per coordinate of every returned axis — the
+    ds_doctor sharding lint flags large arrays whose replication set
+    still covers the data-parallel axes a ZeRO stage promised to shard
+    over."""
+    used = set(spec_axes(spec, ndim))
+    return tuple(a for a in mesh.axis_names
+                 if mesh.shape[a] > 1 and a not in used)
+
+
 class ParallelGrid:
     """Axis-size/rank accessors bound to a Mesh + this process's position.
 

@@ -24,6 +24,12 @@ expand into the step path). The step-oriented fault classes:
 * ``kill`` (``kill_at``) — SIGKILL the process mid-step: the launcher's
   liveness/heartbeat supervision is the only thing that can notice.
 
+One fault class targets the STATIC analyzer instead of the runtime:
+``collective_mismatch`` perturbs this rank's ds_doctor-recorded
+collective sequence (:meth:`ChaosInjector.perturb_collectives`), so the
+collective deadlock detector (analysis/collectives.py) has a
+deterministic divergent rank to catch in tests and game days.
+
 Activation: ``install_chaos(injector)`` (tests / the ``resilience.chaos``
 config block at engine init), or the ``DS_CHAOS`` env var, e.g.
 ``DS_CHAOS="seed=7,failure_rate=0.2,truncate_rate=0.1,ops=latest+client_state"``.
@@ -70,7 +76,9 @@ class ChaosInjector:
                  truncate_at: Optional[Dict[str, Sequence[int]]] = None,
                  hang_at: Optional[Dict[str, Sequence[int]]] = None,
                  delay_at: Optional[Dict[str, Sequence[int]]] = None,
-                 kill_at: Optional[Dict[str, Sequence[int]]] = None):
+                 kill_at: Optional[Dict[str, Sequence[int]]] = None,
+                 collective_mismatch: bool = False,
+                 collective_mismatch_rank: int = -1):
         self._rng = random.Random(seed)
         self.seed = seed
         self.source = "manual"      # "config" / "env": who installed it
@@ -86,6 +94,8 @@ class ChaosInjector:
         self.hang_at = {k: set(v) for k, v in (hang_at or {}).items()}
         self.delay_at = {k: set(v) for k, v in (delay_at or {}).items()}
         self.kill_at = {k: set(v) for k, v in (kill_at or {}).items()}
+        self.collective_mismatch = bool(collective_mismatch)
+        self.collective_mismatch_rank = int(collective_mismatch_rank)
         self._counts = defaultdict(int)
         self.log: list = []          # (op, action, path) — what actually fired
 
@@ -95,7 +105,9 @@ class ChaosInjector:
         inj = cls(seed=cfg.seed, failure_rate=cfg.failure_rate,
                   truncate_rate=cfg.truncate_rate, delay_rate=cfg.delay_rate,
                   max_delay_s=cfg.max_delay_s, hang_rate=cfg.hang_rate,
-                  hang_s=cfg.hang_s, ops=cfg.ops or None)
+                  hang_s=cfg.hang_s, ops=cfg.ops or None,
+                  collective_mismatch=cfg.collective_mismatch,
+                  collective_mismatch_rank=cfg.collective_mismatch_rank)
         inj.source = "config"
         return inj
 
@@ -192,6 +204,59 @@ class ChaosInjector:
             self.log.append((op, "fail", path))
             self._count(op, "fail")
             raise ChaosError(f"chaos: injected failure on {op} #{n} ({path})")
+
+    def perturb_collectives(self, records: list, rank: Optional[int] = None) -> list:
+        """``collective_mismatch`` fault class: deterministically perturb ONE
+        rank's recorded collective sequence (analysis/collectives.py record
+        mode), so the static deadlock detector has a reproducible divergent
+        rank to catch. ``collective_mismatch_rank`` targets a specific
+        process (-1 = every process that records; with fabricated per-rank
+        sequences, pass ``rank`` explicitly). The perturbation draws from a
+        DEDICATED ``random.Random(seed)`` stream, so it reproduces exactly
+        regardless of what the I/O fault stream consumed before it: two
+        adjacent entries that differ in the fingerprinted fields are
+        swapped (an order mismatch); with no such pair, one record's shape
+        is mutated; an empty sequence gains a phantom all_reduce (a length
+        mismatch) — every branch is guaranteed visible to the detector."""
+        if not self.collective_mismatch:
+            return list(records)
+        if rank is None:
+            import jax
+
+            rank = jax.process_index()
+        if self.collective_mismatch_rank not in (-1, rank):
+            return list(records)
+        rng = random.Random((self.seed << 8) ^ 0xC011EC)
+        out = list(records)
+        # swap only where the neighbors actually DIFFER in the fingerprinted
+        # fields (op, shape, dtype, group) — swapping two identical
+        # all_reduce records would log an injection the detector provably
+        # cannot see; with no differing pair, mutate a shape instead
+        swappable = [i for i in range(len(out) - 1)
+                     if out[i][:4] != out[i + 1][:4]]
+        if swappable:
+            i = swappable[rng.randrange(len(swappable))]
+            out[i], out[i + 1] = out[i + 1], out[i]
+            action = f"swap #{i}<->#{i + 1}"
+        elif out:
+            i = rng.randrange(len(out))
+            r = out[i]
+            shape = tuple(s + 1 for s in r.shape) or (1,)
+            out[i] = r._replace(shape=shape)
+            action = f"mutate shape #{i}"
+        else:
+            from deepspeed_tpu.analysis.collectives import CollectiveRecord
+
+            out.append(CollectiveRecord(op="all_reduce", shape=(1,),
+                                        dtype="float32", axes=("data",),
+                                        site="chaos"))
+            action = "append phantom"
+        self.log.append(("collective_record", f"mismatch {action}",
+                         f"rank={rank}"))
+        self._count("collective_record", "mismatch")
+        logger.warning(f"chaos: injected collective_mismatch ({action}) on "
+                       f"rank {rank}'s recorded sequence")
+        return out
 
     def corrupt(self, op: str, path: str, data: bytes) -> bytes:
         """Called with the payload about to be written; may truncate it —

@@ -85,11 +85,13 @@ def find_divergent(rows) -> List[int]:
 
 def _gather_rows(digest_hex: str) -> np.ndarray:
     """Allgather this process's digest; returns (nproc, 32) uint8 rows.
-    (Factored out so tests can fabricate rosters without multiple hosts.)"""
-    from jax.experimental import multihost_utils
+    (Factored out so tests can fabricate rosters without multiple hosts.)
+    Routed through comm.allgather_host — the one sanctioned host-collective
+    entry point (ds_doctor self-lint enforces this)."""
+    from deepspeed_tpu.comm import comm as _comm
 
     buf = np.frombuffer(bytes.fromhex(digest_hex), dtype=np.uint8)
-    rows = np.asarray(multihost_utils.process_allgather(buf))
+    rows = np.asarray(_comm.allgather_host(buf))
     return rows.reshape(-1, buf.size)
 
 

@@ -91,6 +91,64 @@ REJECTED_KEYS = {
            "(recommended on TPU) or fp16 with dynamic loss scaling",
 }
 
+# Raw-dict blocks whose subsystems consume them permissively (no pydantic
+# model): accepted key sets, one level deep — enforced at parse time with
+# did-you-mean, the same contract the top level and every pydantic
+# sub-block carry. A typo in these blocks used to be a silent no-op, the
+# worst failure mode a config surface can have. Dotted names validate a
+# nested block. The ds_doctor schema pass (analysis/schema.py) reuses
+# these sets; tests pin "autotuning" against AutotuningConfig's dataclass
+# fields so the two cannot drift. (The curriculum_metrics interiors are
+# metric-name keyed and free-form, hence data_sampling stops one level
+# down; compression_training is pydantic-validated when armed.)
+RAW_BLOCK_KEYS = {
+    "autotuning": frozenset({
+        "enabled", "metric", "start_profile_step", "end_profile_step",
+        "tuner_type", "tuner_early_stopping", "tuner_num_trials",
+        "results_dir", "exps_dir", "fast", "mbs_list", "zero_stage_list",
+        "remat_list", "gas_list", "tp_list", "offload_list",
+        "offload_overlap_list", "flash_block_list", "heads_list",
+        "hbm_prune_fraction"}),
+    "data_efficiency": frozenset({"enabled", "seed", "data_sampling",
+                                  "data_routing"}),
+    "data_efficiency.data_sampling": frozenset({
+        "enabled", "num_epochs", "num_workers", "pin_memory",
+        "curriculum_learning"}),
+    "curriculum_learning": frozenset({
+        "enabled", "curriculum_type", "min_difficulty", "max_difficulty",
+        "schedule_type", "schedule_config"}),
+    "sparse_attention": frozenset({
+        "mode", "block", "different_layout_per_head", "num_local_blocks",
+        "num_global_blocks", "attention", "horizontal_global_attention",
+        "num_different_global_patterns", "num_random_blocks",
+        "local_window_blocks", "global_block_indices",
+        "global_block_end_indices", "num_sliding_window_blocks"}),
+}
+
+
+def validate_raw_block_keys(pd: Dict[str, Any]):
+    """Raise on unknown keys in the RAW_BLOCK_KEYS blocks (did-you-mean
+    included), mirroring what the pydantic sub-blocks enforce."""
+    from deepspeed_tpu.runtime.config_utils import format_unknown_key_hints
+
+    def check(block, accepted, where):
+        if not isinstance(block, dict):
+            return
+        unknown = set(block) - accepted
+        if not unknown:
+            return
+        raise ValueError(
+            f"Unknown key(s) in the {where!r} ds_config block: "
+            f"{format_unknown_key_hints(unknown, accepted)}. Accepted keys "
+            "are documented in docs/CONFIG.md.")
+
+    for name, accepted in RAW_BLOCK_KEYS.items():
+        head, _, tail = name.partition(".")
+        block = pd.get(head)
+        if tail and isinstance(block, dict):
+            block = block.get(tail)
+        check(block, accepted, name)
+
 
 class FP16Config(DeepSpeedConfigModel):
     enabled: bool = False
@@ -315,6 +373,8 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
     hang_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-op probability of an injected interruptible HANG (watchdog detection drills)")
     hang_s: float = Field(3600.0, ge=0.0, description="duration of an injected hang (s); the watchdog is expected to fire well before it ends")
     ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/train_step); empty = all")
+    collective_mismatch: bool = Field(False, description="perturb this rank's ds_doctor-recorded collective sequence (swap/mutate/phantom, seed-deterministic) so the static deadlock detector has a reproducible divergent rank to catch")
+    collective_mismatch_rank: int = Field(-1, ge=-1, description="process whose recorded sequence is perturbed (-1 = every recording process)")
 
 
 class TelemetryConfig(DeepSpeedConfigModel):
@@ -368,6 +428,42 @@ class WatchdogConfig(DeepSpeedConfigModel):
         return v
 
 
+class AnalysisConfig(DeepSpeedConfigModel):
+    """ds_doctor static analysis (deepspeed_tpu/analysis/): graph lint
+    (recompilation hazards, silent fp32/f64 promotion under bf16/fp16,
+    missing donation), sharding lint (ZeRO-promised partitioning that
+    silently degraded to replication), collective-sequence cross-rank
+    diff, and a recursive config schema walk — all BEFORE step 0, on a
+    trace instead of a compile. STRICT no-op when the block is absent:
+    the analysis package is never even imported. See docs/CONFIG.md
+    'analysis' section for the rule table."""
+    enabled: bool = Field(True, description="run the analyzer at engine init + first train_batch (the block being present opts in; set false to keep the block but skip the work)")
+    fail_on: str = Field("error", description="'error' aborts init/step-0 on any error finding; 'warn' also on warnings; 'never' reports only")
+    passes: list = Field([], description="subset of (schema, sharding, graph, collectives) to run; empty = all four (selflint is a CI pass, not an engine pass)")
+    record_collectives: bool = Field(True, description="record this rank's static collective sequence during the step trace and cross-check it against the other ranks")
+    min_promote_elements: int = Field(65536, gt=0, description="dtype-promotion lint fires only for matmuls with an operand at least this large (scalar/loss-path fp32 math is fine)")
+    min_replicated_elements: int = Field(100_000, gt=0, description="sharding lint ignores leaves smaller than this (small leaves are intentionally kept whole)")
+    min_donate_bytes: int = Field(64 << 20, gt=0, description="donation lint ignores undonated args smaller than this")
+
+    @field_validator("fail_on")
+    @classmethod
+    def _fail_on_known(cls, v):
+        if v not in ("error", "warn", "never"):
+            raise ValueError(f"analysis.fail_on must be 'error', 'warn' or "
+                             f"'never', got {v!r}")
+        return v
+
+    @field_validator("passes")
+    @classmethod
+    def _passes_known(cls, v):
+        known = ("schema", "sharding", "graph", "collectives", "selflint")
+        bad = [p for p in v if p not in known]
+        if bad:
+            raise ValueError(f"analysis.passes: unknown pass(es) {bad}; "
+                             f"known: {known}")
+        return v
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Verified checkpoints + recovery policy (resilience/ package). See
     docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
@@ -413,6 +509,10 @@ class DeepSpeedConfig:
         self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}))
         self.resilience = ResilienceConfig(**pd.get("resilience", {}))
         self.watchdog = WatchdogConfig(**pd.get("watchdog", {}))
+        # presence matters: the engine's analyzer hook is a STRICT no-op
+        # (package not even imported) when the block is absent
+        self.analysis = AnalysisConfig(**pd.get("analysis", {}))
+        self.analysis_present = "analysis" in pd
         self.telemetry = TelemetryConfig(**pd.get("telemetry", {}))
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
@@ -465,6 +565,7 @@ class DeepSpeedConfig:
         # presence, not truthiness — an explicit false/0 is still "set"
         self.advisory_keys_set = [k for k in ADVISORY_NOOP_KEYS if k in pd]
         self._validate_top_level_keys(pd)
+        validate_raw_block_keys(pd)
 
         self._configure_train_batch_size(world_size)
 
@@ -479,7 +580,7 @@ class DeepSpeedConfig:
         "csv_monitor", "pipeline", "tpu", "checkpoint", "data_types", "aio",
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
-        "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "watchdog",
+        "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "watchdog", "analysis",
         "steps_per_print", "telemetry", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
@@ -493,17 +594,14 @@ class DeepSpeedConfig:
             if key in pd:
                 raise ValueError(f"ds_config key {key!r} is not supported on "
                                  f"this runtime: {why}")
-        unknown = sorted(set(pd) - accepted)
+        unknown = set(pd) - accepted
         if unknown:
-            import difflib
+            from deepspeed_tpu.runtime.config_utils import \
+                format_unknown_key_hints
 
-            hints = []
-            for k in unknown:
-                close = difflib.get_close_matches(k, accepted, n=1)
-                hints.append(f"{k!r}" + (f" (did you mean {close[0]!r}?)"
-                                         if close else ""))
             raise ValueError(
-                f"Unknown top-level ds_config key(s): {', '.join(hints)}. "
+                "Unknown top-level ds_config key(s): "
+                f"{format_unknown_key_hints(unknown, accepted)}. "
                 "Accepted keys are documented in docs/CONFIG.md; advisory "
                 "no-ops are listed there with their rationale.")
 

@@ -42,8 +42,36 @@ class DeepSpeedConfigModel(BaseModel):
         if not strict:
             # "auto" / None mean "use the default" in ds_config files
             data = {k: v for k, v in data.items() if v is not None and v != "auto"}
+        self._reject_unknown_keys(data)
         super().__init__(**data)
         self._deprecated_fields_check()
+
+    @classmethod
+    def _accepted_keys(cls) -> set:
+        accepted = set()
+        for name, field in cls.model_fields.items():
+            accepted.add(name)
+            if field.alias:
+                accepted.add(field.alias)
+        return accepted
+
+    @classmethod
+    def _reject_unknown_keys(cls, data: Dict[str, Any]) -> None:
+        """Pre-empt pydantic's bare 'Extra inputs are not permitted' with a
+        did-you-mean error naming the block — the same contract the
+        top-level key validation enforces (runtime/config.py), extended to
+        every sub-block."""
+        if cls.model_config.get("extra") != "forbid":
+            return
+        accepted = cls._accepted_keys()
+        unknown = set(data) - accepted
+        if not unknown:
+            return
+        block = cls.__name__.removesuffix("Config") or cls.__name__
+        raise ValueError(
+            f"Unknown key(s) in the {block} config block: "
+            f"{format_unknown_key_hints(unknown, accepted)}. "
+            "Accepted keys are documented in docs/CONFIG.md.")
 
     def _deprecated_fields_check(self):
         for name, field in type(self).model_fields.items():
@@ -71,6 +99,20 @@ class DeepSpeedConfigModel(BaseModel):
 
     def get(self, key, default=None):
         return getattr(self, key, default)
+
+
+def format_unknown_key_hints(unknown, accepted) -> str:
+    """``'foo' (did you mean 'for'?), 'bar'`` — the one did-you-mean
+    formatter every unknown-key error surface shares (top-level keys,
+    pydantic sub-blocks, raw blocks), so the hint style cannot drift."""
+    import difflib
+
+    hints = []
+    for k in sorted(unknown):
+        close = difflib.get_close_matches(k, list(accepted), n=1)
+        hints.append(f"{k!r}" + (f" (did you mean {close[0]!r}?)"
+                                 if close else ""))
+    return ", ".join(hints)
 
 
 def get_scalar_param(param_dict: dict, param_name: str, param_default_value):

@@ -250,6 +250,25 @@ class DeepSpeedEngine:
             param_shapes, mesh, zero_config=self._config.zero_config, tp_specs=tp_specs)
         log_dist(partition_report(self.plan, param_shapes), ranks=[0])
 
+        # ---- static analysis (ds_doctor) ---------------------------------
+        # STRICT no-op when the ``analysis`` block is absent: the analysis
+        # package is never imported and no pass runs (asserted in tests).
+        # With the block: the schema + sharding passes run HERE — before any
+        # state is materialized, so a doomed config dies in milliseconds —
+        # and the graph + collective passes run at the first train_batch
+        # (the batch shape is only known then), on a re-TRACE of the step,
+        # never an extra compile. fail_on=error|warn aborts with
+        # AnalysisError; 'never' reports only.
+        self._analysis_enabled = (self._config.analysis_present
+                                  and self._config.analysis.enabled)
+        self._analysis_graph_done = False
+        self._analysis_batch_shapes = None
+        self._collective_fingerprint = None
+        if self._analysis_enabled:
+            from deepspeed_tpu.analysis import engine_init_analysis
+
+            engine_init_analysis(self, param_shapes)
+
         # ---- ZeRO-Offload policy ----------------------------------------
         # CPU offload = state lives in host memory (pinned_host memory kind)
         # and streams through the chip inside the step program — the TPU
@@ -1314,6 +1333,8 @@ class DeepSpeedEngine:
         return self._train_batch_inner(batch, gas)
 
     def _train_batch_inner(self, batch, gas):
+        if self._analysis_enabled:
+            self._run_step_analysis(batch, gas)
         if self._flops_probe is None:
             # abstract batch shape for the lazy TFLOPs estimate (holds no
             # device buffers; see _estimate_step_flops)
@@ -1335,6 +1356,35 @@ class DeepSpeedEngine:
             check_step_agreement(self._host_step, float(loss),
                                  rng=self.state.rng)
         return loss
+
+    def _run_step_analysis(self, batch, gas):
+        """ds_doctor step-0 hook. First batch: abstract re-trace of the
+        exact step function about to compile → graph + collective passes
+        (may raise AnalysisError per analysis.fail_on — i.e. BEFORE the
+        first compile burns accelerator time). Later batches: a cheap
+        shape-stability check (each new shape silently compiles a whole
+        new program) that warns once and stands down."""
+        if not self._analysis_graph_done:
+            from deepspeed_tpu.analysis import engine_graph_analysis
+            from deepspeed_tpu.analysis.graph_lint import batch_shape_map
+
+            self._analysis_graph_done = True
+            self._analysis_batch_shapes = batch_shape_map(batch)
+            engine_graph_analysis(self, batch, gas)
+        elif self._analysis_batch_shapes is not None:
+            from deepspeed_tpu.analysis.findings import AnalysisReport
+            from deepspeed_tpu.analysis.graph_lint import diff_batch_shapes
+
+            findings = diff_batch_shapes(self._analysis_batch_shapes, batch)
+            if findings:
+                # report + count, never abort: a mid-run shape change is a
+                # perf bug, not a correctness one (aborting is the
+                # watchdog's call, not the linter's); warn once per run
+                self._analysis_batch_shapes = None
+                report = AnalysisReport().extend(findings, "graph")
+                report.count_into_registry()
+                log_dist(report.render("ds_doctor: batch shape changed"),
+                         ranks=[0])
 
     def _train_batch_instrumented(self, batch, gas):
         with _telemetry.get_tracer().span("train_batch",

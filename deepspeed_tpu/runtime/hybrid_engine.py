@@ -100,7 +100,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
             self._gen_compiled[key] = jax.jit(gen)
         rng = jax.random.PRNGKey(self._host_rng_seed() if seed is None else seed)
-        t0 = time.time()
+        t0 = time.perf_counter()
         with self.mesh:
             out = self._gen_compiled[key](self.state.params, ids, rng,
                                           self.state.step)
@@ -109,7 +109,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         if not first_call:
             # steady-state throughput accounting: the one-time XLA compile
             # call contributes neither latency nor tokens
-            self._generate_latency += time.time() - t0
+            self._generate_latency += time.perf_counter() - t0
             self._generated_tokens += B * max_new_tokens
         return out
 

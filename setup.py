@@ -28,5 +28,5 @@ setup(
     packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
     python_requires=">=3.10",
     install_requires=["jax", "flax", "optax", "orbax-checkpoint", "numpy", "pydantic>=2"],
-    scripts=["bin/deepspeed_tpu", "bin/ds_report", "bin/ds_bench", "bin/ds_elastic"],
+    scripts=["bin/deepspeed_tpu", "bin/ds_report", "bin/ds_bench", "bin/ds_elastic", "bin/ds_doctor"],
 )

@@ -1,0 +1,697 @@
+"""ds_doctor tests — static graph/sharding/collective/config analysis.
+
+Covers: the schema walk (did-you-mean, raw blocks, cross-field), the
+jaxpr graph lint (one seeded true-positive per rule, zero false
+positives on the known-good family fixtures), the collective deadlock
+detector (record mode, cross-rank diff, chaos ``collective_mismatch``
+tie-in), the repo self-lint (runs IN tier-1 — a regression cannot
+merge), engine wiring (strict no-op without the block, fail_on
+semantics), and the bin/ds_doctor + ds_report doctor CLIs against the
+acceptance matrix.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis import AnalysisError, AnalysisReport, Finding
+from deepspeed_tpu.analysis.collectives import (CollectiveRecord,
+                                                CollectiveRecorder,
+                                                diff_sequences,
+                                                record_collectives)
+from deepspeed_tpu.analysis.doctor import run_doctor
+from deepspeed_tpu.analysis.graph_lint import (batch_shape_map,
+                                               diff_batch_shapes,
+                                               lint_donation, lint_jaxpr,
+                                               lint_sharding_plan)
+from deepspeed_tpu.analysis.schema import walk_config
+from deepspeed_tpu.analysis.selflint import lint_package, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.analysis
+
+BASE_CFG = {"train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 0}
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == "error"]
+
+
+# --------------------------------------------------------------------- schema
+class TestSchemaPass:
+    def test_good_config_zero_findings(self):
+        findings, cfg = walk_config({**BASE_CFG, "bf16": {"enabled": True}},
+                                    world_size=1)
+        assert findings == [] and cfg is not None
+
+    def test_subblock_typo_is_error_with_suggestion(self):
+        findings, _ = walk_config({**BASE_CFG, "fp16": {"enabld": True}},
+                                  world_size=1)
+        [f] = _errors(findings)
+        assert f.rule == "config/unknown-key" and f.citation == "fp16"
+        assert "did you mean 'enabled'" in f.message
+
+    def test_multiple_broken_blocks_all_reported(self):
+        findings, cfg = walk_config(
+            {**BASE_CFG, "fp16": {"enabld": True},
+             "watchdog": {"windoww": 8}}, world_size=1)
+        assert cfg is None
+        assert {f.citation for f in _errors(findings)} == {"fp16", "watchdog"}
+
+    def test_raw_block_typo_is_error(self):
+        findings, _ = walk_config(
+            {**BASE_CFG, "autotuning": {"tuner_typ": "random"}}, world_size=1)
+        assert any(f.rule == "config/unknown-key"
+                   and "tuner_type" in f.message for f in _errors(findings))
+
+    def test_raw_block_typo_raises_at_parse_time(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        with pytest.raises(ValueError, match="tuner_type"):
+            DeepSpeedConfig({**BASE_CFG,
+                             "autotuning": {"tuner_typ": "random"}},
+                            world_size=1)
+
+    def test_autotuning_key_set_pinned_to_dataclass(self):
+        """RAW_BLOCK_KEYS cannot drift from AutotuningConfig's fields."""
+        from deepspeed_tpu.autotuning.autotuner import AutotuningConfig
+        from deepspeed_tpu.runtime.config import RAW_BLOCK_KEYS
+
+        assert RAW_BLOCK_KEYS["autotuning"] == frozenset(
+            AutotuningConfig.__dataclass_fields__)
+
+    def test_cross_field_offload_param_needs_stage3(self):
+        findings, _ = walk_config(
+            {**BASE_CFG, "zero_optimization": {
+                "stage": 1, "offload_param": {"device": "cpu"}}},
+            world_size=1)
+        [f] = [f for f in findings if f.rule == "config/cross-field"]
+        assert f.severity == "error" and "offload_param" in f.citation
+
+    def test_cross_field_watchdog_consistency_ignored(self):
+        findings, _ = walk_config(
+            {**BASE_CFG, "watchdog": {"enabled": False,
+                                      "consistency_interval": 10}},
+            world_size=1)
+        assert any(f.severity == "warning" and "consistency_interval"
+                   in f.citation for f in findings)
+
+    def test_cross_field_monitor_fanout_nowhere(self):
+        findings, _ = walk_config(
+            {**BASE_CFG, "telemetry": {"enabled": True, "monitor": True}},
+            world_size=1)
+        assert any("fan-out goes nowhere" in f.message for f in findings)
+
+    def test_block_models_pinned_to_deepspeed_config(self):
+        """Every pydantic block DeepSpeedConfig builds must be covered by
+        the schema pass's independent per-block walk — a new config block
+        that forgets analysis/schema.py fails here, not silently."""
+        from deepspeed_tpu.analysis.schema import _block_models
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig, MonitorConfig
+        from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+        cfg = DeepSpeedConfig(dict(BASE_CFG), world_size=1)
+        covered = set(_block_models().values())
+        for name, val in vars(cfg).items():
+            if not isinstance(val, DeepSpeedConfigModel):
+                continue
+            if isinstance(val, MonitorConfig):
+                # container: its tensorboard/wandb/csv_monitor interiors are
+                # separate top-level blocks, each covered individually
+                continue
+            assert type(val) in covered, (
+                f"DeepSpeedConfig.{name} ({type(val).__name__}) is missing "
+                "from analysis.schema._block_models — add it so the schema "
+                "pass validates the block independently")
+
+    def test_invalid_value_is_error(self):
+        findings, cfg = walk_config(
+            {**BASE_CFG, "watchdog": {"on_timeout": "abort"}}, world_size=1)
+        assert cfg is None
+        [f] = _errors(findings)
+        assert f.rule == "config/invalid-value" and f.citation == "watchdog"
+        assert "on_timeout" in f.message
+
+    def test_config_model_did_you_mean_direct(self):
+        from deepspeed_tpu.runtime.config import FP16Config
+
+        with pytest.raises(ValueError, match="did you mean 'enabled'"):
+            FP16Config(enabld=True)
+
+
+# ---------------------------------------------------------------- graph lint
+class TestGraphLint:
+    def _mats(self, n=512):
+        p = {"w": jax.ShapeDtypeStruct((n, n), jnp.bfloat16)}
+        x = jax.ShapeDtypeStruct((64, n), jnp.bfloat16)
+        return p, x
+
+    def test_fp32_matmul_under_bf16_is_error(self):
+        p, x = self._mats()
+
+        def f(params, inp):
+            return (inp.astype(jnp.float32)
+                    @ params["w"].astype(jnp.float32)).sum()
+
+        [f1] = lint_jaxpr(jax.make_jaxpr(f)(p, x), train_dtype=jnp.bfloat16,
+                          min_promote_elements=1024)
+        assert f1.rule == "graph/dtype-promotion" and f1.severity == "error"
+        assert "dot_general" in f1.citation and "float32" in f1.message
+
+    def test_bf16_matmul_clean(self):
+        p, x = self._mats()
+
+        def f(params, inp):
+            # loss-path fp32 on the SCALAR is fine (below the size floor)
+            return (inp @ params["w"]).sum().astype(jnp.float32)
+
+        assert lint_jaxpr(jax.make_jaxpr(f)(p, x), train_dtype=jnp.bfloat16,
+                          min_promote_elements=1024) == []
+
+    def test_fp32_config_allows_fp32_matmul(self):
+        p, x = self._mats()
+        f = lambda params, inp: (inp.astype(jnp.float32)
+                                 @ params["w"].astype(jnp.float32)).sum()
+        assert lint_jaxpr(jax.make_jaxpr(f)(p, x), train_dtype=jnp.float32,
+                          min_promote_elements=1024) == []
+
+    def test_weak_scalar_input_flagged(self):
+        f = lambda x, s: (x * s).sum()
+        closed = jax.make_jaxpr(f)(jnp.ones((4, 4), jnp.bfloat16), 2.0)
+        fs = lint_jaxpr(closed, train_dtype=jnp.bfloat16)
+        assert [x.rule for x in fs] == ["graph/weak-scalar-input"]
+
+    def test_donation_lint(self):
+        state = {"m": jnp.zeros((256, 256), jnp.float32)}
+        [f] = lint_donation((state, state), donate_argnums=(0,),
+                            min_bytes=1024)
+        assert f.rule == "graph/missing-donation" and f.citation == "arg[1]"
+        assert lint_donation((state,), donate_argnums=(0,),
+                             min_bytes=1024) == []
+
+    def test_sharding_lint_flags_indivisible_leaf(self, mesh8):
+        from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+        from deepspeed_tpu.runtime.zero.partition import plan_sharding
+
+        shapes = {"odd": jax.ShapeDtypeStruct((10_001,), jnp.float32),
+                  "even": jax.ShapeDtypeStruct((4096, 4), jnp.float32)}
+        plan = plan_sharding(shapes, mesh8,
+                             zero_config=DeepSpeedZeroConfig(stage=2))
+        fs = lint_sharding_plan(plan, shapes, min_elements=1000)
+        assert [f.rule for f in fs] == ["sharding/replicated-large-array"]
+        assert "odd" in fs[0].message
+
+    def test_batch_shape_diff(self):
+        first = batch_shape_map({"input_ids": np.zeros((8, 32))})
+        assert diff_batch_shapes(first, {"input_ids": np.zeros((8, 32))}) == []
+        [f] = diff_batch_shapes(first, {"input_ids": np.zeros((8, 48))})
+        assert f.rule == "graph/shape-varying-input"
+
+
+# -------------------------------------------------------------- collectives
+class TestCollectivePass:
+    def _seq(self):
+        return [CollectiveRecord("all_reduce", (8,), "float32", ("data",),
+                                 "train.py:10"),
+                CollectiveRecord("all_gather", (16,), "bfloat16", ("data",),
+                                 "train.py:11"),
+                CollectiveRecord("barrier", (), "-", (), "train.py:12")]
+
+    def test_identical_sequences_clean(self):
+        s = self._seq()
+        assert diff_sequences({0: s, 1: s, 2: s}) == []
+
+    def test_reorder_names_divergent_rank(self):
+        s = self._seq()
+        bad = [s[1], s[0], s[2]]
+        [f] = diff_sequences({0: s, 1: s, 2: bad, 3: s})
+        assert f.rank == 2 and f.severity == "error"
+        assert "order/op mismatch" in f.message and "collective[0]" in f.citation
+
+    def test_majority_rank_override_blames_the_pinned_minority(self):
+        """The cross-rank verify pins the majority side explicitly (a
+        two-way diff has no meaningful vote): with rank 0 divergent and
+        rank 3 holding the majority sequence, the finding must blame
+        rank 0 — not the healthy rank."""
+        s = self._seq()
+        bad = [s[1], s[0], s[2]]
+        [f] = diff_sequences({0: bad, 3: s}, majority_rank=3)
+        assert f.rank == 0
+        assert "rank 0 issues" in f.message and "rank 3 (majority)" in f.message
+
+    def test_shape_and_length_mismatch_kinds(self):
+        s = self._seq()
+        shp = [s[0]._replace(shape=(9,)), s[1], s[2]]
+        [f] = diff_sequences([s, shp])
+        assert "shape mismatch" in f.message
+        [f2] = diff_sequences([s, s[:2]])
+        assert "length mismatch" in f2.message
+
+    def test_record_mode_captures_eager_collectives(self, mesh8):
+        from deepspeed_tpu.comm import comm
+
+        comm.set_mesh(mesh8)
+        with record_collectives(apply_chaos=False) as rec:
+            comm.all_reduce(jnp.ones((8, 4)), group="data")
+            comm.barrier()
+        ops = [r.op for r in rec.records]
+        assert ops == ["all_reduce", "barrier"]
+        assert rec.records[0].shape == (8, 4)
+        assert rec.records[0].axes == ("data",)
+        # recorder uninstalled after the context
+        assert comm._collective_recorder is None
+
+    def test_save_load_roundtrip(self, tmp_path):
+        rec = CollectiveRecorder()
+        rec.records = self._seq()
+        p = str(tmp_path / "seq.json")
+        rec.save(p)
+        assert CollectiveRecorder.load(p) == self._seq()
+
+    def test_fingerprint_ignores_site(self):
+        a = self._seq()
+        b = [r._replace(site="elsewhere.py:1") for r in a]
+        assert (CollectiveRecorder().fingerprint()
+                == CollectiveRecorder().fingerprint())
+        ra, rb = CollectiveRecorder(), CollectiveRecorder()
+        ra.records, rb.records = a, b
+        assert ra.fingerprint() == rb.fingerprint()
+
+
+# ------------------------------------------------------------------- chaos
+@pytest.mark.chaos
+class TestCollectiveMismatchChaos:
+    def test_perturbation_is_deterministic_and_detected(self):
+        from deepspeed_tpu.resilience.chaos import ChaosInjector
+
+        seq = [CollectiveRecord("all_reduce", (8,), "float32", ("data",), ""),
+               CollectiveRecord("all_gather", (16,), "bfloat16", ("data",), ""),
+               CollectiveRecord("reduce_scatter", (32,), "float32", ("data",), "")]
+        inj1 = ChaosInjector(seed=7, collective_mismatch=True)
+        inj2 = ChaosInjector(seed=7, collective_mismatch=True)
+        out1 = inj1.perturb_collectives(seq, rank=1)
+        assert out1 == inj2.perturb_collectives(seq, rank=1)
+        assert out1 != seq
+        findings = diff_sequences({0: seq, 1: out1})
+        assert findings and findings[0].rank == 1
+        assert ("collective_record", "mismatch")[0] in inj1.log[0][0]
+
+    def test_rank_targeting(self):
+        from deepspeed_tpu.resilience.chaos import ChaosInjector
+
+        seq = [CollectiveRecord("all_reduce", (8,), "float32", ("data",), ""),
+               CollectiveRecord("barrier", (), "-", (), "")]
+        inj = ChaosInjector(seed=3, collective_mismatch=True,
+                            collective_mismatch_rank=5)
+        assert inj.perturb_collectives(seq, rank=0) == seq
+        assert inj.perturb_collectives(seq, rank=5) != seq
+
+    def test_identical_adjacent_records_still_detected(self):
+        """Swapping two records identical in the fingerprinted fields
+        would be invisible to the detector — the injector must pick a
+        differing pair (or mutate a shape) so every logged injection is
+        provably detectable."""
+        from deepspeed_tpu.resilience.chaos import ChaosInjector
+
+        same = CollectiveRecord("all_reduce", (8,), "float32", ("data",), "")
+        for seed in range(6):
+            inj = ChaosInjector(seed=seed, collective_mismatch=True)
+            out = inj.perturb_collectives([same, same, same], rank=0)
+            assert diff_sequences({0: [same, same, same], 1: out}), seed
+
+    def test_empty_and_single_sequences_still_diverge(self):
+        from deepspeed_tpu.resilience.chaos import ChaosInjector
+
+        inj = ChaosInjector(seed=1, collective_mismatch=True)
+        assert len(inj.perturb_collectives([], rank=0)) == 1
+        one = [CollectiveRecord("all_reduce", (8,), "float32", ("data",), "")]
+        out = inj.perturb_collectives(one, rank=0)
+        assert out[0].shape != one[0].shape
+
+    def test_recorder_applies_installed_injector(self, mesh8):
+        from deepspeed_tpu.comm import comm
+        from deepspeed_tpu.resilience import chaos
+
+        comm.set_mesh(mesh8)
+        inj = chaos.ChaosInjector(seed=11, collective_mismatch=True)
+        chaos.install_chaos(inj)
+        try:
+            with record_collectives() as rec:
+                comm.all_reduce(jnp.ones((8, 2)), group="data")
+                comm.all_reduce(jnp.ones((8, 4)), group="data")
+            clean = CollectiveRecorder()
+            with record_collectives(apply_chaos=False) as clean:
+                comm.all_reduce(jnp.ones((8, 2)), group="data")
+                comm.all_reduce(jnp.ones((8, 4)), group="data")
+            assert rec.fingerprint() != clean.fingerprint()
+            assert diff_sequences({0: clean.records, 1: rec.records})
+        finally:
+            chaos.uninstall_chaos()
+
+    def test_from_env_spec(self):
+        from deepspeed_tpu.resilience.chaos import ChaosInjector
+
+        inj = ChaosInjector.from_env("seed=5,collective_mismatch=1")
+        assert inj.collective_mismatch and inj.seed == 5
+
+
+# ---------------------------------------------------------------- self-lint
+class TestSelfLint:
+    def test_repo_is_clean(self):
+        """The tier-1 self-lint: untimed host collectives outside comm and
+        bare time.time() in the step path cannot merge."""
+        assert lint_package() == []
+
+    def test_bare_time_in_step_path_flagged(self):
+        src = "import time\n\ndef f():\n    return time.time()\n"
+        [f] = lint_source(src, "runtime/engine.py")
+        assert f.rule == "selflint/bare-time-in-step-path"
+        assert f.citation == "runtime/engine.py:4"
+        # outside the step path it is fine (e.g. a timestamp for an event)
+        assert lint_source(src, "telemetry/exporters.py") == []
+
+    def test_untimed_host_collective_flagged(self):
+        src = ("from jax.experimental import multihost_utils\n"
+               "def f(x):\n"
+               "    return multihost_utils.process_allgather(x)\n")
+        [f] = lint_source(src, "elasticity/elastic_agent.py")
+        assert f.rule == "selflint/untimed-host-collective"
+        # comm.py is the sanctioned routing point
+        assert lint_source(src, "comm/comm.py") == []
+
+
+# ----------------------------------------------------- engine + smoke matrix
+def _tiny_gpt2():
+    from deepspeed_tpu.models.gpt2 import GPT2Model, PRESETS
+
+    return GPT2Model(PRESETS["gpt2-tiny"])
+
+
+def _lm_batch(seq=32, batch=8):
+    from deepspeed_tpu.models.gpt2 import PRESETS, synthetic_lm_batch
+
+    return synthetic_lm_batch(batch, seq, PRESETS["gpt2-tiny"].vocab_size)
+
+
+class TestEngineWiring:
+    def test_strict_noop_without_block(self):
+        """Without the ``analysis`` block the engine provably runs no
+        analyzer code: the package is never (re)imported."""
+        saved = {m: sys.modules.pop(m) for m in list(sys.modules)
+                 if m.startswith("deepspeed_tpu.analysis")}
+        try:
+            eng, *_ = deepspeed_tpu.initialize(
+                model=_tiny_gpt2(), config={**BASE_CFG,
+                                            "bf16": {"enabled": True}})
+            eng.train_batch(_lm_batch())
+            assert not any(m.startswith("deepspeed_tpu.analysis")
+                           for m in sys.modules)
+            assert eng._analysis_enabled is False
+        finally:
+            sys.modules.update(saved)
+
+    def test_enabled_block_runs_clean_and_fingerprints(self):
+        eng, *_ = deepspeed_tpu.initialize(
+            model=_tiny_gpt2(),
+            config={**BASE_CFG, "bf16": {"enabled": True},
+                    "analysis": {"fail_on": "error"}})
+        loss = eng.train_batch(_lm_batch())
+        assert np.isfinite(float(loss))
+        assert eng._analysis_graph_done
+        assert eng._collective_fingerprint is not None
+
+    def test_fail_on_error_aborts_before_first_compile(self):
+        class UpcastModel:
+            def init_params(self, rng):
+                return {"w": jax.random.normal(rng, (256, 256), jnp.float32),
+                        "emb": jax.random.normal(rng, (64, 256), jnp.float32)}
+
+            def loss(self, params, batch, rng=None):
+                x = params["emb"][batch["input_ids"]]
+                h = x.astype(jnp.float32) @ params["w"].astype(jnp.float32)
+                return (h ** 2).mean()
+
+        eng, *_ = deepspeed_tpu.initialize(
+            model=UpcastModel(),
+            config={**BASE_CFG, "bf16": {"enabled": True},
+                    "analysis": {"fail_on": "error",
+                                 "min_promote_elements": 1024}})
+        with pytest.raises(AnalysisError, match="dtype-promotion"):
+            eng.train_batch({"input_ids": np.zeros((8, 16), np.int32)})
+
+    def test_fail_on_never_reports_only(self):
+        class UpcastModel:
+            def init_params(self, rng):
+                return {"w": jax.random.normal(rng, (256, 256), jnp.float32)}
+
+            def loss(self, params, batch, rng=None):
+                h = batch["x"].astype(jnp.float32) @ \
+                    params["w"].astype(jnp.float32)
+                return (h ** 2).mean()
+
+        eng, *_ = deepspeed_tpu.initialize(
+            model=UpcastModel(),
+            config={**BASE_CFG, "bf16": {"enabled": True},
+                    "analysis": {"fail_on": "never",
+                                 "min_promote_elements": 1024}})
+        loss = eng.train_batch({"x": np.ones((8, 256), np.float32)})
+        assert np.isfinite(float(loss))
+
+    def test_scalar_batch_leaf_is_not_a_false_positive(self):
+        """The engine's _shard_batch materializes every batch leaf as a
+        strong-typed array, so a Python scalar riding in the batch is NOT
+        a retrace hazard there — the analyzer must not flag it (the
+        weak-scalar rule targets user-built steps, where the
+        number-vs-array alternation bug actually lives)."""
+        class ScaledModel:
+            def init_params(self, rng):
+                return {"w": jax.random.normal(rng, (64, 64), jnp.float32)}
+
+            def loss(self, params, batch, rng=None):
+                return ((batch["x"] @ params["w"]) * batch["scale"]).mean()
+
+        eng, *_ = deepspeed_tpu.initialize(
+            model=ScaledModel(),
+            config={**BASE_CFG, "bf16": {"enabled": True},
+                    "analysis": {"fail_on": "warn"}})
+        loss = eng.train_batch({"x": np.ones((8, 64), np.float32),
+                                "scale": 2.0})
+        assert np.isfinite(float(loss))
+
+    def test_init_fails_on_cross_field_error(self):
+        with pytest.raises(AnalysisError, match="cross-field"):
+            deepspeed_tpu.initialize(
+                model=_tiny_gpt2(),
+                config={**BASE_CFG, "bf16": {"enabled": True},
+                        "zero_optimization": {
+                            "stage": 1, "offload_param": {"device": "cpu"}},
+                        "analysis": {"fail_on": "error"}})
+
+    def test_shape_change_warns_but_never_aborts(self):
+        eng, *_ = deepspeed_tpu.initialize(
+            model=_tiny_gpt2(),
+            config={**BASE_CFG, "bf16": {"enabled": True},
+                    "analysis": {"fail_on": "warn"}})
+        eng.train_batch(_lm_batch(seq=32))
+        eng.train_batch(_lm_batch(seq=16))   # new shape: warn-once, no raise
+        assert eng._analysis_batch_shapes is None
+
+
+class TestSmokeMatrix:
+    """Zero false-positive errors on known-good configs across the model
+    family fixtures (trace-only: no engine, no compile)."""
+
+    @pytest.mark.parametrize("family", ["gpt2", "llama", "moe"])
+    @pytest.mark.parametrize("dtype_block", [{"bf16": {"enabled": True}}, {}])
+    def test_family_clean(self, family, dtype_block):
+        report = run_doctor({**BASE_CFG, **dtype_block}, model=family,
+                            passes=("schema", "sharding", "graph"),
+                            world_size=1)
+        assert report.errors == [], report.render()
+
+    def test_explicitly_requested_pass_without_inputs_says_skipped(self):
+        """A pass the caller asked for by name that cannot run must say so
+        (info finding), not render as a clean result."""
+        report = run_doctor(dict(BASE_CFG), passes=("sharding", "collectives"),
+                            world_size=1)
+        rules = {f.rule for f in report.findings}
+        assert rules == {"sharding/pass-skipped", "collectives/pass-skipped"}
+        assert all(f.severity == "info" for f in report.findings)
+        assert not report.should_fail("error")
+
+    def test_default_pass_set_skips_quietly(self):
+        report = run_doctor(dict(BASE_CFG), world_size=1)
+        assert report.findings == []   # header lists what ran; no noise
+
+    def test_single_collective_log_is_not_a_clean_diff(self, tmp_path):
+        rec = CollectiveRecorder()
+        rec.records = [CollectiveRecord("all_reduce", (8,), "float32",
+                                        ("data",), "")]
+        p = str(tmp_path / "only_rank.json")
+        rec.save(p)
+        report = run_doctor(dict(BASE_CFG), world_size=1,
+                            collective_logs=[p])
+        assert [f.rule for f in report.findings] == ["collectives/pass-skipped"]
+
+    def test_graph_skip_on_broken_config_carries_the_schema_error(self):
+        report = run_doctor({**BASE_CFG, "fp16": {"enabld": True}},
+                            passes=("graph",), model="gpt2", world_size=1)
+        [f] = report.findings
+        assert f.rule == "graph/pass-skipped"
+        assert "did you mean 'enabled'" in f.message
+
+    def test_bert_clean(self):
+        report = run_doctor({**BASE_CFG, "bf16": {"enabled": True}},
+                            model="bert", passes=("schema", "graph"),
+                            world_size=1)
+        assert report.errors == [], report.render()
+
+
+# -------------------------------------------------------------------- report
+class TestReport:
+    def test_fail_on_semantics(self):
+        r = AnalysisReport()
+        r.add(Finding(rule="x/y", severity="warning", message="m"))
+        assert not r.should_fail("error")
+        assert r.should_fail("warn") and not r.should_fail("never")
+        r.add(Finding(rule="x/z", severity="error", message="m2"))
+        assert r.should_fail("error")
+        with pytest.raises(AnalysisError):
+            r.raise_if("error")
+
+    def test_counted_into_telemetry(self):
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.runtime.config import TelemetryConfig
+
+        session = telemetry.TelemetrySession(
+            TelemetryConfig(enabled=True, jsonl=False, prometheus=False,
+                            trace=False, output_dir="/tmp/ds_doctor_t"))
+        telemetry.install_session(session)
+        try:
+            r = AnalysisReport()
+            r.add(Finding(rule="graph/dtype-promotion", severity="error",
+                          message="m"))
+            r.count_into_registry()
+            snap = session.registry.snapshot()
+            rows = [s for s in snap
+                    if s["name"] == "analysis/findings"]
+            assert rows and rows[0]["value"] == 1
+        finally:
+            telemetry.deconfigure()
+
+    def test_render_and_json(self):
+        r = AnalysisReport()
+        r.extend([Finding(rule="a/b", severity="info", message="hello",
+                          citation="there")], "schema")
+        out = r.render()
+        assert "a/b" in out and "[schema]" in out
+        parsed = json.loads(r.to_json())
+        assert parsed["counts"]["info"] == 1
+
+
+# ---------------------------------------------------------------------- CLIs
+class TestDoctorCLI:
+    def _run(self, *args, cwd=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_doctor"), *args],
+            capture_output=True, text=True, cwd=cwd, env=env, timeout=300)
+
+    def test_acceptance_matrix(self, tmp_path):
+        """The ISSUE acceptance block, end to end: typo'd sub-block key,
+        bf16 graph that upcasts to fp32, and a reordered collective each
+        exit non-zero naming rule + offender; all-good exits 0."""
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps({**BASE_CFG, "bf16": {"enabled": True}}))
+        typo = tmp_path / "typo.json"
+        typo.write_text(json.dumps(
+            {**BASE_CFG, "bf16": {"enabled": True},
+             "watchdog": {"enabeld": True}}))
+        upcast = tmp_path / "upcast.py"
+        upcast.write_text(
+            "import jax, jax.numpy as jnp\n"
+            "def build_graph(cfg):\n"
+            "    p = {'w': jax.ShapeDtypeStruct((512, 512), jnp.bfloat16)}\n"
+            "    x = jax.ShapeDtypeStruct((64, 512), jnp.bfloat16)\n"
+            "    def f(params, inp):\n"
+            "        return (inp.astype(jnp.float32) @\n"
+            "                params['w'].astype(jnp.float32)).sum()\n"
+            "    return f, (p, x)\n")
+        seq = [CollectiveRecord("all_reduce", (8,), "float32", ("data",),
+                                "train.py:10"),
+               CollectiveRecord("all_gather", (16,), "bfloat16", ("data",),
+                                "train.py:11")]
+        r0 = CollectiveRecorder(); r0.records = seq
+        r0.save(str(tmp_path / "rank0.json"))
+        r1 = CollectiveRecorder(); r1.records = [seq[1], seq[0]]
+        r1.save(str(tmp_path / "rank1.json"))
+
+        # 1) typo'd sub-block key -> non-zero, names rule + key
+        p = self._run("--config", str(typo), "--fail-on", "error")
+        assert p.returncode == 2, p.stderr
+        assert "config/unknown-key" in p.stdout and "enabeld" in p.stdout \
+            and "watchdog" in p.stdout
+
+        # 2) bf16 config whose graph upcasts to fp32 -> non-zero, names op
+        p = self._run("--config", str(good), "--graph", str(upcast),
+                      "--passes", "schema,graph", "--fail-on", "error")
+        assert p.returncode == 2, p.stderr
+        assert "graph/dtype-promotion" in p.stdout and "dot_general" in p.stdout
+
+        # 3) reordered collective -> non-zero, names the divergent rank
+        p = self._run("--config", str(good), "--passes", "collectives",
+                      "--collective-log", str(tmp_path / "rank0.json"),
+                      "--collective-log", str(tmp_path / "rank1.json"),
+                      "--fail-on", "error")
+        assert p.returncode == 2, p.stderr
+        assert "collectives/sequence-mismatch" in p.stdout \
+            and "rank 1" in p.stdout
+
+        # 4) all-good config + graph -> exit 0 with zero errors
+        p = self._run("--config", str(good), "--model", "gpt2",
+                      "--world-size", "1", "--fail-on", "error")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "errors: 0" in p.stdout
+
+    def test_ds_report_doctor_section(self, tmp_path):
+        cfg = tmp_path / "c.json"
+        cfg.write_text(json.dumps(
+            {**BASE_CFG, "fp16": {"enabld": True}}))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_report"),
+             "doctor", "--config", str(cfg), "--fail-on", "error"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert p.returncode == 2, p.stderr
+        assert "did you mean 'enabled'" in p.stdout
+
+    def test_selflint_pass_via_cli(self):
+        p = self._run("--passes", "selflint", "--fail-on", "error")
+        assert p.returncode == 0, p.stdout + p.stderr
+
+
+# ------------------------------------------------------------------ comm api
+class TestAllgatherHost:
+    def test_single_process_shape(self):
+        from deepspeed_tpu.comm import comm
+
+        out = comm.allgather_host(np.int32(3))
+        assert out.shape == (1,) and int(out[0]) == 3
+
+    def test_recorded(self, mesh8):
+        from deepspeed_tpu.comm import comm
+
+        comm.set_mesh(mesh8)
+        with record_collectives(apply_chaos=False) as rec:
+            comm.allgather_host(np.zeros(4, np.float32))
+        assert [r.op for r in rec.records] == ["allgather_host"]

@@ -51,6 +51,7 @@ def _block_models() -> Dict[str, type]:
         "progressive_layer_drop": C.PLDConfig,
         "resilience": C.ResilienceConfig, "watchdog": C.WatchdogConfig,
         "telemetry": C.TelemetryConfig, "analysis": C.AnalysisConfig,
+        "profiling": C.ProfilingConfig,
         "compression_training": CompressionConfig,
     }
 
@@ -158,6 +159,21 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
             "desync counters go to the no-op registry (detection still "
             "works; you just cannot chart it)",
             "watchdog.enabled vs telemetry.enabled")
+    prof = cfg.profiling
+    if "profiling" in pd and prof.enabled:
+        if not tel.enabled:
+            add("warning",
+                "profiling is enabled without telemetry: the census / "
+                "executable / span-peak series go to the no-op registry and "
+                "are never exported — only the leak-sentinel log warning "
+                "survives; enable the telemetry block to chart them",
+                "profiling.enabled vs telemetry.enabled")
+        elif prof.span_memory and not tel.trace:
+            add("warning",
+                "profiling.span_memory hooks per-span memory deltas into the "
+                "step tracer, but telemetry.trace is false — there are no "
+                "spans to hook",
+                "profiling.span_memory vs telemetry.trace")
 
 
 def walk_config(pd: dict, world_size: Optional[int] = None

@@ -130,6 +130,38 @@ def _record_collective(op: str, tensor, group) -> None:
     rec(op, shape, dtype, axes)
 
 
+# ds_prof fleet aggregation: per-(op, group) sequence numbers stamped onto
+# the timed collectives' trace spans, so `ds_prof merge` can match the
+# k-th all_reduce over `data` on rank 0 with the k-th on rank 7 and
+# compute arrival skew — the same (op, seq, group) identity the ds_doctor
+# collective fingerprints canonicalize. Advances only on the timed eager
+# path, which every rank takes identically under the same config.
+_collective_trace_seq: dict = {}
+
+
+def _next_collective_seq(op: str, group_desc: str) -> int:
+    key = (op, group_desc)
+    n = _collective_trace_seq.get(key, 0)
+    _collective_trace_seq[key] = n + 1
+    return n
+
+
+def reset_collective_trace_seq() -> None:
+    """Restart the per-(op, group) seq counters. Called by the telemetry
+    session constructor: a new session means a new trace file and clock,
+    and after an elastic restart a surviving rank (counters at N) and a
+    replaced rank (fresh process, counters at 0) must both restart at 0
+    or their (op, seq, group) identities never match again."""
+    _collective_trace_seq.clear()
+
+
+def _group_desc(group) -> str:
+    try:
+        return "+".join(_axes(group)) or "world"
+    except Exception:
+        return "world"
+
+
 def is_initialized() -> bool:
     return cdb is not None
 
@@ -436,6 +468,13 @@ def timed_op(func):
                                labels={"op": func.__name__, "size": str(size)}).observe(latency)
             registry.counter("comm/op_calls", labels={"op": func.__name__}).inc()
             registry.counter("comm/op_bytes", labels={"op": func.__name__}).inc(size)
+        # rank-matchable trace span: (op, seq, group) is the fleet-wide
+        # identity ds_prof merges/skews on (no-op without a live tracer)
+        gd = _group_desc(group)
+        telemetry.get_tracer().complete(
+            f"comm:{func.__name__}", latency * 1e6, cat="comm",
+            op=func.__name__, seq=_next_collective_seq(func.__name__, gd),
+            group=gd, bytes=size)
         return result
 
     return wrapper

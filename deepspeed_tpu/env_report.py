@@ -73,6 +73,48 @@ def hardware_report():
     return rows
 
 
+def profiling_report():
+    """ds_prof capability probe: per-device memory stats through the
+    accelerator API, and whether this backend's executables expose
+    ``memory_analysis`` (the static HBM accounting `profiling` uses)."""
+    rows = []
+    try:
+        from deepspeed_tpu.accelerator import get_accelerator
+
+        acc = get_accelerator()
+        n = acc.device_count()
+        for i in range(n):
+            stats = acc.memory_stats(i)
+            if stats:
+                lim = stats.get("bytes_limit", 0)
+                use = stats.get("bytes_in_use", 0)
+                peak = stats.get("peak_bytes_in_use", 0)
+                rows.append((f"device {i} memory",
+                             f"{use / 2**30:.2f} / {lim / 2**30:.2f} GiB in use "
+                             f"(peak {peak / 2**30:.2f})"))
+            else:
+                rows.append((f"device {i} memory",
+                             "no memory_stats on this backend"))
+            if i == 0 and n > 4:
+                rows.append(("...", f"({n} local devices)"))
+                break
+    except Exception as e:  # pragma: no cover
+        rows.append(("accelerator memory", f"{RED_NO} ({e})"))
+    try:
+        import jax
+
+        mem = jax.jit(lambda x: x + 1).lower(
+            jax.ShapeDtypeStruct((8,), "float32")).compile().memory_analysis()
+        rows.append(("memory_analysis", GREEN_OK if mem is not None
+                     else f"{RED_NO} (backend returns None)"))
+        live = jax.live_arrays()
+        rows.append(("live arrays", f"{len(live)} "
+                     f"({sum(int(getattr(a, 'nbytes', 0)) for a in live) / 2**20:.1f} MiB)"))
+    except Exception as e:  # pragma: no cover
+        rows.append(("memory_analysis", f"{RED_NO} ({e})"))
+    return rows
+
+
 def kernel_report():
     rows = []
     try:
@@ -116,6 +158,10 @@ def main(args=None):
     print(line)
     print("hardware:")
     for k, v in hardware_report():
+        print(f"  {k:<24} {v}")
+    print(line)
+    print("profiling:")
+    for k, v in profiling_report():
         print(f"  {k:<24} {v}")
     print(line)
     print("kernels/toolchain:")

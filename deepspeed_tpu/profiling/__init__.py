@@ -1,2 +1,20 @@
+"""Profiling layer: flops profiler + ds_prof (memory / fleet traces).
+
+* ``flops_profiler/`` — XLA-native flops/MACs accounting (re-exported
+  here for reference API parity);
+* ``memory.py`` — HBM live-buffer census, executable memory accounting,
+  per-span peak deltas, leak sentinel (the ``profiling`` ds_config
+  block; engine wiring in runtime/engine.py);
+* ``aggregate.py`` / ``report.py`` — fleet trace merge, collective
+  arrival-skew / straggler attribution, critical-path extraction and
+  their renderers (pure stdlib);
+* ``cli.py`` — the ``bin/ds_prof`` entry point.
+
+``memory``/``aggregate``/``report``/``cli`` are deliberately NOT
+imported here: the engine's strict no-op contract for the absent
+``profiling`` block is "the profiler module is never imported", and the
+flops-profiler import below must not drag them in.
+"""
+
 from deepspeed_tpu.profiling.flops_profiler.profiler import (FlopsProfiler,  # noqa: F401
                                                              get_model_profile)

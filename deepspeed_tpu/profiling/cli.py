@@ -1,0 +1,131 @@
+"""``bin/ds_prof`` — fleet trace merge + memory report CLI.
+
+Subcommands:
+
+* ``ds_prof merge <trace.json|jsonl>... [-o merged.json] [--top K]
+  [--step N] [--no-align] [--json]`` — merge per-rank telemetry traces
+  into one Perfetto-loadable timeline with rank lanes, print the top-K
+  straggler table (which rank, which collective, how many µs it cost the
+  fleet) and the per-step critical path.
+* ``ds_prof memory <metrics.jsonl | telemetry_dir>`` — summarize the
+  ``profiling/*`` series a run's memory profiler exported (same renderer
+  as ``ds_metrics --memory``).
+
+The analyses themselves (aggregate/report) are pure stdlib — no device,
+no distributed init; traces from a 256-chip run merge fine on a laptop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from deepspeed_tpu.profiling.aggregate import FleetTrace
+from deepspeed_tpu.profiling.report import (load_metrics_records,
+                                            render_critical_path,
+                                            render_memory_summary,
+                                            render_straggler_report)
+
+
+def _cmd_merge(args) -> int:
+    paths = []
+    for p in args.traces:
+        if os.path.isdir(p):
+            paths.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.startswith("trace") and (f.endswith(".json")
+                                              or f.endswith(".jsonl"))))
+        else:
+            paths.append(p)
+    if not paths:
+        print("ds_prof merge: no trace files given", file=sys.stderr)
+        return 2
+    try:
+        ft = FleetTrace.from_files(paths)
+    except ValueError as e:                   # e.g. two files claim one rank
+        print(f"ds_prof merge: {e}", file=sys.stderr)
+        return 2
+    align = not args.no_align
+    merged = ft.to_chrome_trace(align=align)
+    if args.output:
+        tmp = args.output + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(merged, f)
+        os.replace(tmp, args.output)
+    rows = ft.straggler_table(top_k=args.top, align=align)
+    cp = ft.critical_path(step=args.step, align=align)
+    if args.json:
+        print(json.dumps({
+            "ranks": sorted(ft.by_rank),
+            "clock_offsets_us": ft.clock_offsets() if align else {},
+            "stragglers": [r._asdict() for r in rows],
+            "rank_cost_us": ft.rank_cost_summary(align=align),
+            "critical_path": cp._asdict() if cp else None,
+            "output": args.output,
+        }, indent=2, default=str))
+        return 0
+    nev = sum(len(e) for e in ft.by_rank.values())
+    print(f"merged {len(ft.by_rank)} rank trace(s), {nev} events"
+          + (f" -> {args.output}" if args.output else "")
+          + " (open in https://ui.perfetto.dev)")
+    if align:
+        offs = ft.clock_offsets()
+        if any(abs(o) > 1.0 for o in offs.values()):
+            print("clock offsets (us): "
+                  + ", ".join(f"rank {r}: {o:+.0f}" for r, o in sorted(offs.items())))
+    print()
+    print(render_straggler_report(rows, ft.rank_cost_summary(align=align),
+                                  top_k=args.top))
+    print()
+    print(render_critical_path(cp))
+    return 0
+
+
+def _cmd_memory(args) -> int:
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    if not os.path.isfile(path):
+        print(f"ds_prof memory: no such file: {path}", file=sys.stderr)
+        return 1
+    records, bad = load_metrics_records(path)
+    print(render_memory_summary(records, source=path))
+    if bad:
+        print(f"ds_prof memory: skipped {bad} malformed line(s)", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ds_prof",
+        description="fleet trace aggregation + HBM memory reports")
+    sub = parser.add_subparsers(dest="cmd")
+    m = sub.add_parser("merge", help="merge per-rank traces; straggler + "
+                                     "critical-path report")
+    m.add_argument("traces", nargs="+",
+                   help="per-rank trace files (or a telemetry output dir)")
+    m.add_argument("-o", "--output", default=None,
+                   help="write the merged Perfetto JSON here")
+    m.add_argument("--top", type=int, default=10,
+                   help="straggler table size (default 10)")
+    m.add_argument("--step", type=int, default=None,
+                   help="critical-path step (default: last complete step)")
+    m.add_argument("--no-align", action="store_true",
+                   help="skip collective-based clock alignment")
+    m.add_argument("--json", action="store_true",
+                   help="machine-readable report instead of tables")
+    mem = sub.add_parser("memory", help="summarize profiling/* memory series")
+    mem.add_argument("path", help="metrics.jsonl or the telemetry output dir")
+    args = parser.parse_args(argv)
+    if args.cmd == "merge":
+        return _cmd_merge(args)
+    if args.cmd == "memory":
+        return _cmd_memory(args)
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

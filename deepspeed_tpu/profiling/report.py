@@ -1,0 +1,168 @@
+"""Render ds_prof analyses for humans — straggler table, critical path,
+memory summary. Pure stdlib (``bin/ds_prof`` and ``bin/ds_metrics
+--memory`` run far from any accelerator)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def load_metrics_records(path: str) -> Tuple[List[dict], int]:
+    """Last record per (kind, name, labels) from a telemetry metrics.jsonl,
+    plus the count of malformed lines (a run killed mid-append leaves a
+    torn last line — counted, not fatal). The one loader both
+    ``bin/ds_metrics`` and ``ds_prof memory`` share."""
+    last = {}
+    order = []
+    bad = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                key = (rec["kind"], rec["name"],
+                       tuple(sorted((rec.get("labels") or {}).items())))
+            except (ValueError, KeyError, TypeError):
+                bad += 1
+                continue
+            if key not in last:
+                order.append(key)
+            last[key] = rec
+    return [last[k] for k in order], bad
+
+
+def format_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.2f} {unit}"
+        n /= 1024.0
+    return f"{n:.2f} TiB"
+
+
+def format_us(us: float) -> str:
+    if us >= 1e6:
+        return f"{us / 1e6:.2f} s"
+    if us >= 1e3:
+        return f"{us / 1e3:.2f} ms"
+    return f"{us:.0f} us"
+
+
+def _table(rows: Sequence[Sequence[str]]) -> str:
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = []
+    for i, r in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def render_straggler_report(rows, rank_cost: Dict[int, float],
+                            top_k: int = 10) -> str:
+    """The top-K straggler table (which rank, which op, how many µs it
+    cost the fleet) + per-rank totals."""
+    if not rows:
+        return ("straggler analysis: no cross-rank collective matches found "
+                "(need comm span events with (op, seq, group) args on >= 2 ranks)")
+    out = [f"straggler table (top {min(top_k, len(rows))} collectives by fleet cost):"]
+    table = [("straggler", "collective", "group", "arrival skew", "fleet cost")]
+    for r in rows[:top_k]:
+        table.append((f"rank {r.rank}", f"{r.op}#{r.seq}", r.group or "world",
+                      format_us(r.skew_us), format_us(r.fleet_cost_us)))
+    out.append(_table(table))
+    worst = sorted(rank_cost.items(), key=lambda kv: -kv[1])
+    total = sum(rank_cost.values())
+    out.append("")
+    out.append("fleet waiting time by straggling rank:")
+    for rank, cost in worst:
+        if cost <= 0:
+            continue
+        pct = 100.0 * cost / total if total else 0.0
+        out.append(f"  rank {rank:<4} {format_us(cost):>12}  ({pct:.0f}%)")
+    if total == 0:
+        out.append("  (no measurable skew)")
+    return "\n".join(out)
+
+
+def render_critical_path(cp) -> str:
+    """One step's longest dependency chain, segment by segment."""
+    if cp is None:
+        return "critical path: no step spans found"
+    out = [f"critical path (step {cp.step}): {format_us(cp.total_us)} on-path "
+           f"of {format_us(cp.wall_us)} wall "
+           f"({100.0 * cp.total_us / cp.wall_us if cp.wall_us else 0.0:.0f}% serialized)"]
+    for rank, name, ts, dur in cp.segments:
+        out.append(f"  rank {rank:<4} {name:<24} {format_us(dur):>12}  @ {format_us(ts)}")
+    return "\n".join(out)
+
+
+# ------------------------------------------------------------- memory summary
+def render_memory_summary(records: List[dict],
+                          source: Optional[str] = None) -> str:
+    """Summarize the ``profiling/*`` registry series out of a ds_metrics
+    record list (last snapshot per series): live bytes by bucket, span HBM
+    peaks, executable accounting, leak suspects."""
+    buckets, spans, execu, leaks, device = [], [], [], [], []
+    total = frac = None
+    for rec in records:
+        name = rec.get("name", "")
+        labels = rec.get("labels") or {}
+        if name.startswith("device/"):
+            device.append((name[len("device/"):], rec.get("value", 0)))
+        elif name == "profiling/live_bytes":
+            buckets.append((labels.get("bucket", "?"), rec.get("value", 0)))
+        elif name == "profiling/live_bytes_total":
+            total = rec.get("value", 0)
+        elif name == "profiling/attributed_fraction":
+            frac = rec.get("value")
+        elif name == "profiling/span_peak_bytes":
+            spans.append((labels.get("span", "?"), rec.get("max", 0),
+                          rec.get("p50", 0), rec.get("count", 0)))
+        elif name.startswith("profiling/executable_"):
+            execu.append((name[len("profiling/executable_"):-len("_bytes")],
+                          rec.get("value", 0)))
+        elif name == "profiling/leak_suspects":
+            leaks.append((labels.get("bucket", "?"), rec.get("value", 0)))
+    if not (buckets or spans or execu or leaks or device or total is not None):
+        return ("no profiling/* series found"
+                + (f" in {source}" if source else "")
+                + " — enable the ds_config `profiling` block (and `telemetry`)")
+    out = ["memory profile" + (f": {source}" if source else "")]
+    if buckets:
+        out.append("")
+        out.append("live device bytes by bucket:")
+        for bucket, n in sorted(buckets, key=lambda kv: -kv[1]):
+            out.append(f"  {bucket:<18} {format_bytes(n):>12}")
+        if total is not None:
+            line = f"  {'total live':<18} {format_bytes(total):>12}"
+            if frac is not None:
+                line += f"  ({100.0 * frac:.1f}% attributed)"
+            out.append(line)
+    if execu:
+        out.append("")
+        out.append("train-step executable (XLA memory_analysis):")
+        for key, n in execu:
+            out.append(f"  {key:<18} {format_bytes(n):>12}")
+    if spans:
+        out.append("")
+        out.append("peak HBM delta by span (max over run):")
+        for span, mx, p50, count in sorted(spans, key=lambda s: -s[1]):
+            out.append(f"  {span:<18} {format_bytes(mx):>12}  "
+                       f"(p50 {format_bytes(p50)}, {int(count)} samples)")
+    if device:
+        out.append("")
+        out.append("device memory (runtime stats, device 0):")
+        for key, n in device:
+            out.append(f"  {key:<18} {format_bytes(n):>12}")
+    out.append("")
+    if leaks:
+        out.append("leak suspects (monotonic live-bytes growth):")
+        for bucket, n in sorted(leaks, key=lambda kv: -kv[1]):
+            out.append(f"  {bucket:<18} flagged {int(n)}x")
+    else:
+        out.append("leak suspects: none")
+    return "\n".join(out)

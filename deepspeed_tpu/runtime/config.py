@@ -464,6 +464,26 @@ class AnalysisConfig(DeepSpeedConfigModel):
         return v
 
 
+class ProfilingConfig(DeepSpeedConfigModel):
+    """ds_prof profiling layer (deepspeed_tpu/profiling/memory.py): HBM
+    live-buffer census bucketed over the engine's known pytrees (params /
+    master / optimizer state / grad buffer), static per-executable memory
+    accounting via XLA's ``memory_analysis``, per-span device-memory peak
+    deltas hooked into the telemetry step tracer, and a leak sentinel over
+    the census history. Results flow through the telemetry registry
+    (``profiling/*`` series — summarize with ``bin/ds_metrics --memory``,
+    merge per-rank traces with ``bin/ds_prof merge``). STRICT no-op when
+    the block is absent: the profiler module is never imported and zero
+    census calls run. See docs/CONFIG.md 'profiling' section."""
+    enabled: bool = Field(True, description="run the memory profiler (the block being present opts in; set false to keep the block but skip the work)")
+    sample_interval: int = Field(10, gt=0, description="census + leak check every N global steps (step 1 always sampled); the census walk is O(live buffers) host work, ~ms at gpt2 scale")
+    memory: bool = Field(True, description="run the live-buffer census on sample steps (profiling/live_bytes{bucket=} gauges + attribution fraction)")
+    span_memory: bool = Field(True, description="wrap the telemetry step tracer to record per-span device-memory peak deltas (profiling/span_peak_bytes{span=} histograms; requires telemetry.trace, free on backends without memory_stats)")
+    executable_analysis: bool = Field(True, description="one-shot compiled.memory_analysis() of the train-step executable at the first sample (argument/output/temp/generated-code bytes; goes through jax's compile cache, no extra compile)")
+    leak_window: int = Field(5, ge=2, description="consecutive samples of monotonic live-bytes growth before flagging a leak suspect")
+    leak_min_growth_bytes: int = Field(1 << 20, ge=0, description="ignore total growth below this across the window (steady-state jitter)")
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Verified checkpoints + recovery policy (resilience/ package). See
     docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
@@ -514,6 +534,10 @@ class DeepSpeedConfig:
         self.analysis = AnalysisConfig(**pd.get("analysis", {}))
         self.analysis_present = "analysis" in pd
         self.telemetry = TelemetryConfig(**pd.get("telemetry", {}))
+        # presence matters, same contract as `analysis`: the memory
+        # profiler is a STRICT no-op (module never imported) without it
+        self.profiling = ProfilingConfig(**pd.get("profiling", {}))
+        self.profiling_present = "profiling" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -581,7 +605,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

@@ -445,6 +445,33 @@ class DeepSpeedEngine:
         # (comm timed_op, resilience counters) see the noop registry
         self.telemetry = _telemetry.configure(self._config.telemetry,
                                               monitor=self.monitor)
+        # ---- memory profiler (ds_prof) -----------------------------------
+        # HBM live-buffer census + executable accounting + leak sentinel
+        # (profiling/memory.py), sampled every profiling.sample_interval
+        # steps. STRICT no-op when the ``profiling`` block is absent: the
+        # module is never imported and zero census calls run (asserted in
+        # tests) — the per-step cost of a disabled profiler is one
+        # `is None` check.
+        self._mem_profiler = None
+        prof_cfg = self._config.profiling
+        if self._config.profiling_present and prof_cfg.enabled:
+            from deepspeed_tpu.profiling.memory import (MemoryProfiler,
+                                                        SpanMemoryTracer)
+
+            self._mem_profiler = MemoryProfiler(
+                sample_interval=prof_cfg.sample_interval,
+                memory=prof_cfg.memory,
+                executable_analysis=prof_cfg.executable_analysis,
+                leak_window=prof_cfg.leak_window,
+                leak_min_growth_bytes=prof_cfg.leak_min_growth_bytes)
+            if prof_cfg.span_memory:
+                session = _telemetry.get_session()
+                # hook per-span peak deltas into the live tracer; sessions
+                # re-fetch through get_tracer(), so wrapping the session's
+                # tracer covers every instrumentation point
+                if session is not None and session.tracer is not _telemetry.NOOP_TRACER \
+                        and not isinstance(session.tracer, SpanMemoryTracer):
+                    session.tracer = SpanMemoryTracer(session.tracer)
         self._flops_probe = None
         dist.configure(self._config)
         self.flops_profiler_cfg = self._config.flops_profiler_config
@@ -1616,6 +1643,18 @@ class DeepSpeedEngine:
         session = _telemetry.get_session()
         if session is not None:
             self._record_step_telemetry(session, metrics, step)
+        if self._mem_profiler is not None:
+            self._mem_profiler.maybe_sample(self, step)
+
+    def memory_census(self):
+        """On-demand live-buffer census attributed to this engine's state
+        (params / master / optimizer state / grad buffer / misc vs other);
+        returns a :class:`~deepspeed_tpu.profiling.memory.CensusResult`.
+        Works with or without the ``profiling`` block — this is the
+        interactive entry point, the block is the sampling one."""
+        from deepspeed_tpu.profiling.memory import census, named_engine_pytrees
+
+        return census(named_engine_pytrees(self))
 
     def _record_step_telemetry(self, session, metrics: StepMetrics, step: int):
         """Per-step registry updates + exporter flush cadence. Gated on the

@@ -68,6 +68,13 @@ class TelemetrySession:
         rank = jax.process_index()
         self.tracer = (StepTracer(max_events=cfg.max_trace_events, pid=rank)
                        if cfg.trace else NOOP_TRACER)
+        # new session = new trace file + clock: restart the comm layer's
+        # per-(op, group) collective seq counters with it, so every rank's
+        # (op, seq, group) trace identities stay alignable by ds_prof even
+        # when ranks (re)start at different times (elastic restarts)
+        from deepspeed_tpu.comm import comm as _comm
+
+        _comm.reset_collective_trace_seq()
         self.output_dir = cfg.output_dir
         self.exporters = []
         self.trace_path = None

@@ -18,10 +18,13 @@ import os
 import time
 from typing import List, Optional
 
+from deepspeed_tpu.utils.logging import logger
+
 
 class StepTracer:
     """Collects complete-span events; bounded by ``max_events`` (overflow is
-    counted, never grows memory without bound on a long run)."""
+    counted — surfaced in the trace metadata and a one-shot warning — and
+    never grows memory without bound on a long run)."""
 
     def __init__(self, max_events: int = 100_000, pid: int = 0):
         self._t0 = time.perf_counter()
@@ -36,6 +39,14 @@ class StepTracer:
 
     def _emit(self, ev: dict) -> None:
         if len(self.events) >= self.max_events:
+            if self.dropped == 0:
+                # once, loudly: a silently truncated trace reads as "the
+                # run got quiet at step N" — the worst kind of wrong
+                logger.warning(
+                    f"StepTracer hit max_events={self.max_events}; further "
+                    "spans are counted but not recorded (dropped-event count "
+                    "lands in the trace metadata; raise "
+                    "telemetry.max_trace_events to keep them)")
             self.dropped += 1
             return
         self.events.append(ev)
@@ -57,20 +68,35 @@ class StepTracer:
                     "ts": self._now_us(), "pid": self.pid, "tid": 0,
                     "args": args})
 
+    def complete(self, name: str, dur_us: float, cat: str = "train",
+                 **args) -> None:
+        """Record a complete span ending NOW with the given duration —
+        for callers that already measured the interval themselves (the
+        comm layer's ``timed_op`` wraps the block+sync it times)."""
+        end = self._now_us()
+        self._emit({"name": name, "cat": cat, "ph": "X",
+                    "ts": end - float(dur_us), "dur": float(dur_us),
+                    "pid": self.pid, "tid": 0, "args": args})
+
     def to_chrome_trace(self) -> dict:
         meta = [{"name": "process_name", "ph": "M", "pid": self.pid, "tid": 0,
                  "args": {"name": f"deepspeed_tpu rank {self.pid}"}}]
-        return {"traceEvents": meta + self.events, "displayTimeUnit": "ms"}
+        return {"traceEvents": meta + self.events, "displayTimeUnit": "ms",
+                "metadata": {"rank": self.pid, "max_events": self.max_events,
+                             "dropped_events": self.dropped}}
 
     def write(self, path: str) -> None:
         """Atomic dump (tmp + replace): a reader mid-run never sees a
         half-written JSON. No-op when nothing changed since the last write —
-        the whole-file dump is O(spans so far), and a capped buffer late in a
-        long run would otherwise pay it every flush for no new data."""
-        # dropped is deliberately NOT part of the state: past the event cap
-        # only `dropped` moves, and it is not serialized — rewriting an
-        # identical file every flush is the exact cost this guard avoids
-        state = len(self.events)
+        the whole-file dump is O(spans so far) and a flush with no new data
+        should cost nothing. The FIRST drop counts as a change (so the
+        metadata's truncation flag reaches disk), but later drop-count
+        bumps do not: past the cap only `dropped` moves, and re-serializing
+        the full capped buffer every flush just to update one integer is
+        the exact cost this guard exists to avoid — the on-disk count is
+        'dropped as of the first post-cap flush', the in-memory counter
+        stays exact."""
+        state = (len(self.events), self.dropped > 0)
         if state == self._written_state:
             return
         tmp = path + ".tmp"
@@ -101,6 +127,10 @@ class NoopTracer:
         return _NULL
 
     def instant(self, name: str, cat: str = "train", **args) -> None:
+        pass
+
+    def complete(self, name: str, dur_us: float, cat: str = "train",
+                 **args) -> None:
         pass
 
     def to_chrome_trace(self) -> dict:

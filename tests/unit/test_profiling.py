@@ -1,9 +1,21 @@
-"""Tests for the flops profiler and activation checkpointing.
+"""Tests for the profiling layer: flops profiler, activation
+checkpointing, and ds_prof (HBM memory census / span peak deltas / leak
+sentinel / fleet trace merge + straggler & critical-path attribution).
 
 Mirrors the reference's profiler unit coverage
 (tests/unit/profiling/flops_profiler/test_flops_profiler.py) and the
-activation-checkpointing suite (tests/unit/runtime/activation_checkpointing/).
+activation-checkpointing suite (tests/unit/runtime/activation_checkpointing/);
+the ds_prof coverage (classes marked ``profiling``) is ISSUE 5's
+acceptance surface: census bucketing on a real engine, span peak-delta
+math, trace merge + skew on synthetic multi-rank traces, critical-path
+extraction, and the strict no-op contract without the config block.
 """
+
+import gc
+import json
+import os
+import subprocess
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -16,6 +28,8 @@ from deepspeed_tpu.profiling.flops_profiler.profiler import (FlopsProfiler,
                                                              flops_to_string,
                                                              get_model_profile,
                                                              number_to_string)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 class TestJaxprFlops:
@@ -177,3 +191,650 @@ class TestScalingEvidence:
         assert p["comm_bytes_per_chip_step"] == int(
             6 * 1_557_000_000 * 63 / 64)
         assert "ZeRO-3" in p["assumptions"]
+
+
+# ======================================================================
+# ds_prof: HBM memory profiler + fleet trace aggregation (ISSUE 5)
+# ======================================================================
+
+class _capture_warnings:
+    """Collect DeepSpeedTPU logger messages (the logger is
+    non-propagating with a stream handler bound at import, so neither
+    caplog nor capsys sees it reliably)."""
+
+    def __enter__(self):
+        import logging
+
+        from deepspeed_tpu.utils.logging import logger as _dslogger
+
+        self.messages = []
+        self._logger = _dslogger
+        self._handler = logging.Handler()
+        self._handler.emit = lambda rec: self.messages.append(rec.getMessage())
+        _dslogger.addHandler(self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        self._logger.removeHandler(self._handler)
+        return False
+
+
+def _session(tmp_path, **over):
+    """Install a manual telemetry session; caller must deconfigure()."""
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    cfg = TelemetryConfig(enabled=True, output_dir=str(tmp_path / "telem"),
+                          flush_interval=10_000, **over)
+    s = telemetry.TelemetrySession(cfg)
+    telemetry.install_session(s)
+    return s
+
+
+@pytest.mark.profiling
+class TestMemoryCensus:
+    def test_synthetic_bucketing_exact(self):
+        from deepspeed_tpu.profiling.memory import census
+
+        a = jnp.ones((16,), jnp.float32)
+        b = jnp.ones((8, 8), jnp.float32)
+        c = jnp.ones((4,), jnp.float32)
+        res = census({"params": {"w": a}, "optimizer_state": [b]},
+                     live=[a, b, c])
+        assert res.bucket_bytes["params"] == a.nbytes
+        assert res.bucket_bytes["optimizer_state"] == b.nbytes
+        assert res.bucket_bytes["other"] == c.nbytes
+        assert res.total_bytes == a.nbytes + b.nbytes + c.nbytes
+        assert res.attributed_bytes == a.nbytes + b.nbytes
+        assert 0 < res.fraction_attributed < 1
+        assert res.bucket_counts["params"] == 1 and res.bucket_counts["other"] == 1
+
+    def test_leaf_claimed_once_first_bucket_wins(self):
+        from deepspeed_tpu.profiling.memory import census
+
+        a = jnp.ones((16,), jnp.float32)
+        res = census({"params": a, "master": a}, live=[a])
+        assert res.bucket_bytes["params"] == a.nbytes
+        assert res.bucket_bytes["master"] == 0
+        assert res.attributed_bytes == a.nbytes
+
+    def test_engine_census_attributes_95pct_gpt2(self):
+        """Acceptance: >= 95% of live bytes on the gpt2 fixture land in a
+        named bucket (params / master / optimizer state / misc)."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2Model, PRESETS, synthetic_lm_batch
+
+        model = GPT2Model(PRESETS["gpt2-tiny"])
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_batch_size": 8, "steps_per_print": 0,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                    "bf16": {"enabled": True}})
+        batch = synthetic_lm_batch(8, 32, PRESETS["gpt2-tiny"].vocab_size)
+        engine.train_batch(batch)
+        del batch
+        # drop cached executables' closed-over constants and anything the
+        # test harness left unreferenced — the census is about THIS engine
+        jax.clear_caches()
+        gc.collect()
+        res = engine.memory_census()
+        assert res.bucket_bytes["params"] > 0
+        assert res.bucket_bytes["master"] > 0          # bf16 keeps fp32 master
+        assert res.bucket_bytes["optimizer_state"] > 0
+        assert res.fraction_attributed >= 0.95, res.bucket_bytes
+
+
+@pytest.mark.profiling
+class TestExecutableMemory:
+    def test_executable_accounting_on_engine(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.simple import SimpleModel
+        from deepspeed_tpu.profiling.memory import executable_memory
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16, nlayers=2),
+            config={"train_batch_size": 8, "steps_per_print": 0,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+        assert executable_memory(engine) is None       # nothing compiled yet
+        rng = np.random.RandomState(0)
+        engine.train_batch((rng.randn(8, 16).astype(np.float32),
+                            rng.randn(8, 16).astype(np.float32)))
+        stats = executable_memory(engine)
+        assert stats is not None
+        assert set(stats) == {"argument", "output", "temp", "alias",
+                              "generated_code"}
+        assert stats["argument"] > 0                   # state + batch bytes
+
+
+@pytest.mark.profiling
+class TestExecutableMemoryOnebit:
+    def test_onebit_compiled_key_tuple_found(self):
+        """The 1-bit path keys _compiled_train_batch by (gas, phase) —
+        executable accounting must still find the program."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models.simple import SimpleModel
+        from deepspeed_tpu.profiling.memory import executable_memory
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16, nlayers=2),
+            config={"train_batch_size": 8, "steps_per_print": 0,
+                    "bf16": {"enabled": True},
+                    "optimizer": {"type": "onebitadam",
+                                  "params": {"lr": 1e-3}}})
+        rng = np.random.RandomState(0)
+        engine.train_batch((rng.randn(8, 16).astype(np.float32),
+                            rng.randn(8, 16).astype(np.float32)))
+        assert all(isinstance(k, tuple) for k in engine._compiled_train_batch)
+        stats = executable_memory(engine)
+        assert stats is not None and stats["argument"] > 0
+
+
+@pytest.mark.profiling
+class TestSpanMemory:
+    def test_peak_delta_math(self, tmp_path):
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.profiling.memory import SpanMemoryTracer
+        from deepspeed_tpu.telemetry.tracing import StepTracer
+
+        _session(tmp_path)
+        try:
+            feed = [{"bytes_in_use": 100},                            # before 1
+                    {"bytes_in_use": 200, "peak_bytes_in_use": 350},  # after 1
+                    {"bytes_in_use": 500},                            # before 2
+                    {"bytes_in_use": 40, "peak_bytes_in_use": 40}]    # after 2
+            smt = SpanMemoryTracer(StepTracer(), stats_fn=lambda: feed.pop(0))
+            with smt.span("fwd", step=1):
+                pass
+            with smt.span("fwd", step=2):
+                pass
+            [rec] = [r for r in telemetry.get_registry().snapshot()
+                     if r["name"] == "profiling/span_peak_bytes"]
+            assert rec["labels"] == {"span": "fwd"}
+            assert rec["count"] == 2
+            assert rec["max"] == 250          # 350 peak - 100 in use before
+            assert rec["min"] == 0            # shrinking span clamps to 0
+            # the wrapped tracer still recorded the spans themselves
+            assert [e["name"] for e in smt.events] == ["fwd", "fwd"]
+        finally:
+            telemetry.deconfigure()
+
+    def test_backend_without_stats_probed_once(self, tmp_path):
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.profiling.memory import SpanMemoryTracer
+        from deepspeed_tpu.telemetry.tracing import StepTracer
+
+        _session(tmp_path)
+        try:
+            calls = []
+            smt = SpanMemoryTracer(StepTracer(),
+                                   stats_fn=lambda: calls.append(1) or None)
+            for _ in range(3):
+                with smt.span("fwd"):
+                    pass
+            assert len(calls) == 1            # one failed probe, then free
+            assert not [r for r in telemetry.get_registry().snapshot()
+                        if r["name"] == "profiling/span_peak_bytes"]
+        finally:
+            telemetry.deconfigure()
+
+
+@pytest.mark.profiling
+class TestLeakSentinel:
+    def _result(self, other_bytes):
+        from deepspeed_tpu.profiling.memory import CensusResult
+
+        buckets = {"params": 1000, "other": other_bytes}
+        return CensusResult(bucket_bytes=buckets,
+                            bucket_counts={b: 1 for b in buckets},
+                            total_bytes=sum(buckets.values()),
+                            attributed_bytes=1000)
+
+    def test_monotonic_growth_fires_and_names_bucket(self, tmp_path):
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.profiling.memory import MemoryProfiler
+
+        _session(tmp_path)
+        try:
+            prof = MemoryProfiler(leak_window=3, leak_min_growth_bytes=100)
+            with _capture_warnings() as logged:
+                for i, n in enumerate([0, 100, 250, 400]):  # 4 samples, +400
+                    prof._observe_leak(i + 1, self._result(n))
+            snap = telemetry.get_registry().snapshot()
+            [rec] = [r for r in snap if r["name"] == "profiling/leak_suspects"]
+            assert rec["labels"] == {"bucket": "other"} and rec["value"] == 1
+            assert any("top-growing bucket: 'other'" in m for m in logged.messages)
+        finally:
+            telemetry.deconfigure()
+
+    def test_flat_or_small_growth_stays_quiet(self, tmp_path):
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.profiling.memory import MemoryProfiler
+
+        _session(tmp_path)
+        try:
+            prof = MemoryProfiler(leak_window=3, leak_min_growth_bytes=10_000)
+            for i, n in enumerate([0, 100, 250, 400]):   # growth under floor
+                prof._observe_leak(i + 1, self._result(n))
+            prof2 = MemoryProfiler(leak_window=3, leak_min_growth_bytes=0)
+            for i, n in enumerate([0, 500, 300, 600]):   # not monotonic
+                prof2._observe_leak(i + 1, self._result(n))
+            assert not [r for r in telemetry.get_registry().snapshot()
+                        if r["name"] == "profiling/leak_suspects"]
+        finally:
+            telemetry.deconfigure()
+
+
+@pytest.mark.profiling
+class TestTracerDropSignal:
+    def test_dropped_counter_in_metadata_and_one_shot_warning(self):
+        from deepspeed_tpu.telemetry.tracing import StepTracer
+
+        t = StepTracer(max_events=2, pid=3)
+        with _capture_warnings() as logged:
+            for i in range(5):
+                t.instant(f"ev{i}")
+        assert len(t.events) == 2 and t.dropped == 3
+        meta = t.to_chrome_trace()["metadata"]
+        assert meta["dropped_events"] == 3
+        assert meta["rank"] == 3 and meta["max_events"] == 2
+        drop_warnings = [m for m in logged.messages if "max_events=2" in m]
+        assert len(drop_warnings) == 1                   # warned exactly once
+
+    def test_write_reflects_first_drop_then_stops_rewriting(self, tmp_path):
+        from deepspeed_tpu.telemetry.tracing import StepTracer
+
+        t = StepTracer(max_events=1)
+        t.instant("a")
+        path = str(tmp_path / "trace.json")
+        t.write(path)
+        t.instant("b")                                    # first drop
+        t.write(path)
+        assert json.load(open(path))["metadata"]["dropped_events"] == 1
+        # later drop-count bumps are NOT worth re-serializing the whole
+        # capped buffer: the file keeps the truncation flag, not a live count
+        t.instant("c")
+        before = os.stat(path).st_mtime_ns
+        t.write(path)
+        assert os.stat(path).st_mtime_ns == before
+        assert t.dropped == 2                             # in-memory stays exact
+
+
+# ---------------------------------------------------------------- aggregation
+def _span(name, ts, dur, pid=0, cat="train", **args):
+    return {"name": name, "cat": cat, "ph": "X", "ts": float(ts),
+            "dur": float(dur), "pid": pid, "tid": 0, "args": args}
+
+
+def _comm(op, seq, ts, dur, group="data", **kw):
+    return _span(f"comm:{op}", ts, dur, cat="comm", op=op, seq=seq,
+                 group=group, **kw)
+
+
+def _rank_meta(rank):
+    return {"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+            "args": {"name": f"deepspeed_tpu rank {rank}"}}
+
+
+@pytest.mark.profiling
+class TestFleetTrace:
+    def test_merge_builds_rank_lanes(self, tmp_path):
+        from deepspeed_tpu.profiling.aggregate import FleetTrace
+
+        paths = []
+        for rank in (0, 1):
+            trace = {"traceEvents": [_rank_meta(rank),
+                                     _span("fwd", 0, 10, step=1)],
+                     "displayTimeUnit": "ms"}
+            p = str(tmp_path / (f"trace.json" if rank == 0
+                                else f"trace.rank{rank}.json"))
+            json.dump(trace, open(p, "w"))
+            paths.append(p)
+        ft = FleetTrace.from_files(paths)
+        assert set(ft.by_rank) == {0, 1}
+        merged = ft.to_chrome_trace()
+        names = {(e["pid"], (e.get("args") or {}).get("name"))
+                 for e in merged["traceEvents"] if e.get("name") == "process_name"}
+        assert names == {(0, "rank 0"), (1, "rank 1")}
+        spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert {e["pid"] for e in spans} == {0, 1}
+        json.dumps(merged)                                # Perfetto-loadable
+
+    def test_jsonl_input_and_filename_rank(self, tmp_path):
+        from deepspeed_tpu.profiling.aggregate import FleetTrace
+
+        # multi-line JSONL (not valid whole-file JSON) and a one-event
+        # JSONL (which IS valid whole-file JSON) must both load
+        p = str(tmp_path / "trace.rank7.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps(_span("fwd", 0, 5)) + "\n\n"
+                    + json.dumps(_span("bwd", 5, 9)) + "\n")
+        single = str(tmp_path / "trace.rank2.jsonl")
+        with open(single, "w") as f:
+            f.write(json.dumps(_span("fwd", 0, 5)) + "\n")
+        ft = FleetTrace.from_files([p, single])
+        assert set(ft.by_rank) == {7, 2}
+        assert [e["name"] for e in ft.by_rank[7]] == ["fwd", "bwd"]
+        assert [e["name"] for e in ft.by_rank[2]] == ["fwd"]
+
+    def test_duplicate_rank_is_error_same_path_dedupes(self, tmp_path):
+        from deepspeed_tpu.profiling.aggregate import FleetTrace
+
+        a = str(tmp_path / "trace_a.json")
+        b = str(tmp_path / "trace_b.json")
+        for p in (a, b):
+            json.dump({"traceEvents": [_rank_meta(0), _span("fwd", 0, 5)]},
+                      open(p, "w"))
+        # the same file listed twice (overlapping globs) is fine...
+        ft = FleetTrace.from_files([a, a])
+        assert set(ft.by_rank) == {0}
+        # ...two DIFFERENT files claiming rank 0 is a stale-trace error
+        with pytest.raises(ValueError, match="identify as rank 0"):
+            FleetTrace.from_files([a, b])
+
+    def test_skew_straggler_and_fleet_cost(self):
+        from deepspeed_tpu.profiling.aggregate import FleetTrace
+
+        ft = FleetTrace()
+        # both collectives END together on each rank (blocking semantics)
+        # but rank 1 ARRIVES 30us late at seq 0 and 50us late at seq 1
+        ft.add_rank(0, [_comm("all_reduce", 0, 100, 80),
+                        _comm("all_reduce", 1, 300, 90)])
+        ft.add_rank(1, [_comm("all_reduce", 0, 130, 50),
+                        _comm("all_reduce", 1, 350, 40)])
+        matches = ft.collective_matches()
+        assert [m.seq for m in matches] == [0, 1]
+        m0, m1 = matches
+        assert m0.straggler == 1 and m0.skew_us == pytest.approx(30.0)
+        assert m0.fleet_cost_us == pytest.approx(30.0)
+        assert m1.straggler == 1 and m1.skew_us == pytest.approx(50.0)
+        rows = ft.straggler_table(top_k=10)
+        assert rows[0].seq == 1 and rows[0].rank == 1     # sorted by cost
+        cost = ft.rank_cost_summary()
+        assert cost[1] == pytest.approx(80.0) and cost[0] == 0.0
+
+    def test_clock_alignment_recovers_true_straggler(self):
+        from deepspeed_tpu.profiling.aggregate import FleetTrace
+
+        # rank 1's clock is 1000us AHEAD; unaligned it looks like the
+        # straggler on every op even though rank 0 is the slow one
+        off = 1000.0
+        ft = FleetTrace()
+        ft.add_rank(0, [_comm("all_reduce", s, 100 + 300 * s, 80)
+                        for s in range(3)])
+        ft.add_rank(1, [_comm("all_reduce", s, 160 + 300 * s + off, 20)
+                        for s in range(3)])
+        offsets = ft.clock_offsets()
+        assert offsets[1] - offsets[0] == pytest.approx(off)
+        for m in ft.collective_matches(align=True):
+            # aligned: rank1 arrives at 160 vs rank0's 100 -> rank 1 is
+            # genuinely late (it just waits less, ending together)
+            assert m.straggler == 1 and m.skew_us == pytest.approx(60.0)
+        unaligned = ft.collective_matches(align=False)
+        assert unaligned[0].skew_us == pytest.approx(60.0 + off)
+
+    def test_critical_path_extraction(self):
+        from deepspeed_tpu.profiling.aggregate import FleetTrace
+
+        ft = FleetTrace()
+        ft.add_rank(0, [
+            _span("train_batch", 0, 100, step=4),
+            _span("data", 0, 10, step=4),
+            _span("fwd", 10, 30, step=4),
+            _span("bwd", 40, 30, step=4),
+            _comm("all_reduce", 0, 70, 10),               # no step arg
+            _span("step", 80, 20, step=4),
+        ])
+        ft.add_rank(1, [_span("data", 0, 5, pid=1, step=4)])  # fast parallel rank
+        cp = ft.critical_path()                           # defaults to last step
+        assert cp.step == 4
+        assert [name for _, name, _, _ in cp.segments] == \
+            ["data", "fwd", "bwd", "comm:all_reduce", "step"]
+        assert cp.total_us == pytest.approx(100.0)
+        assert all(rank == 0 for rank, *_ in cp.segments)
+        assert cp.wall_us == pytest.approx(100.0)
+
+    def test_critical_path_crosses_ranks(self):
+        from deepspeed_tpu.profiling.aggregate import FleetTrace
+
+        ft = FleetTrace()
+        ft.add_rank(0, [_span("fwd", 0, 40, step=1)])
+        ft.add_rank(1, [_span("bwd", 50, 60, pid=1, step=1)])
+        cp = ft.critical_path(step=1)
+        assert [(r, n) for r, n, _, _ in cp.segments] == [(0, "fwd"), (1, "bwd")]
+        assert cp.total_us == pytest.approx(100.0)
+
+
+@pytest.mark.profiling
+def test_collective_seq_restarts_with_new_session(tmp_path):
+    """A new telemetry session (fresh trace file + clock) restarts the
+    comm layer's (op, group) seq counters — after an elastic restart a
+    surviving rank and a freshly spawned one must both count from 0 or
+    their trace identities never match again."""
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.comm import comm
+
+    comm.reset_collective_trace_seq()
+    assert comm._next_collective_seq("all_reduce", "data") == 0
+    assert comm._next_collective_seq("all_reduce", "data") == 1
+    _session(tmp_path)                       # session ctor resets counters
+    try:
+        assert comm._next_collective_seq("all_reduce", "data") == 0
+    finally:
+        telemetry.deconfigure()
+
+
+@pytest.mark.profiling
+class TestSchemaProfiling:
+    def test_typo_gets_did_you_mean(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, _ = walk_config({"train_batch_size": 8,
+                                   "profiling": {"sample_intervals": 5}},
+                                  world_size=1)
+        errs = [f for f in findings if f.severity == "error"]
+        assert errs and any("sample_interval" in f.message for f in errs)
+
+    def test_profiling_without_telemetry_warns(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, cfg = walk_config({"train_batch_size": 8, "profiling": {}},
+                                    world_size=1)
+        assert cfg is not None
+        [w] = [f for f in findings if f.rule == "config/cross-field"]
+        assert w.severity == "warning" and "no-op registry" in w.message
+
+    def test_span_memory_without_trace_warns(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, _ = walk_config(
+            {"train_batch_size": 8, "profiling": {},
+             "telemetry": {"enabled": True, "trace": False}}, world_size=1)
+        [w] = [f for f in findings if f.rule == "config/cross-field"]
+        assert "span_memory" in w.message
+
+
+@pytest.mark.profiling
+class TestEngineProfilingWiring:
+    def _engine(self, tmp_path, profiling=None, telemetry_cfg=None):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.simple import SimpleModel
+
+        cfg = {"train_batch_size": 8, "steps_per_print": 0,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+        if telemetry_cfg is not None:
+            cfg["telemetry"] = telemetry_cfg
+        if profiling is not None:
+            cfg["profiling"] = profiling
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16, nlayers=2), config=cfg)
+        return engine
+
+    @staticmethod
+    def _batch(i=0):
+        rng = np.random.RandomState(i)
+        return (rng.randn(8, 16).astype(np.float32),
+                rng.randn(8, 16).astype(np.float32))
+
+    def test_samples_census_and_executable_gauges(self, tmp_path):
+        from deepspeed_tpu import telemetry
+
+        out = str(tmp_path / "telem")
+        engine = self._engine(
+            tmp_path, profiling={"sample_interval": 1},
+            telemetry_cfg={"enabled": True, "output_dir": out,
+                           "flush_interval": 1})
+        try:
+            engine.train_batch(self._batch(0))
+            engine.train_batch(self._batch(1))
+            assert engine._mem_profiler is not None
+            assert engine._mem_profiler.samples == 2
+            by_name = {}
+            for r in telemetry.get_registry().snapshot():
+                by_name.setdefault(r["name"], []).append(r)
+            buckets = {r["labels"]["bucket"]
+                       for r in by_name["profiling/live_bytes"]}
+            assert {"params", "optimizer_state", "state_misc"} <= buckets
+            assert by_name["profiling/live_bytes_total"][0]["value"] > 0
+            assert by_name["profiling/attributed_fraction"][0]["value"] > 0
+            assert by_name["profiling/executable_argument_bytes"][0]["value"] > 0
+            assert "profiling/executable_temp_bytes" in by_name
+            # acceptance chain: ds_metrics --memory renders the real JSONL
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bin", "ds_metrics"),
+                 out, "--memory"], capture_output=True, text=True)
+            assert proc.returncode == 0, proc.stderr
+            assert "live device bytes by bucket" in proc.stdout
+            assert "params" in proc.stdout
+            assert "train-step executable" in proc.stdout
+        finally:
+            telemetry.deconfigure()
+
+    def test_sample_interval_respected(self, tmp_path):
+        from deepspeed_tpu import telemetry
+
+        engine = self._engine(
+            tmp_path, profiling={"sample_interval": 3},
+            telemetry_cfg={"enabled": True,
+                           "output_dir": str(tmp_path / "t"),
+                           "flush_interval": 1000})
+        try:
+            for i in range(4):
+                engine.train_batch(self._batch(i))
+            # steps 1 (always) and 3 sampled; 2 and 4 skipped
+            assert engine._mem_profiler.samples == 2
+        finally:
+            telemetry.deconfigure()
+
+    def test_strict_noop_without_block(self, tmp_path):
+        """Without the ``profiling`` block the engine provably runs no
+        profiler code: the ds_prof modules are never (re)imported and
+        zero census calls happen."""
+        mods = ("deepspeed_tpu.profiling.memory",
+                "deepspeed_tpu.profiling.aggregate",
+                "deepspeed_tpu.profiling.report",
+                "deepspeed_tpu.profiling.cli")
+        saved = {m: sys.modules.pop(m) for m in list(sys.modules)
+                 if m in mods}
+        try:
+            engine = self._engine(tmp_path)
+            engine.train_batch(self._batch())
+            assert engine._mem_profiler is None
+            assert not any(m in sys.modules for m in mods)
+        finally:
+            sys.modules.update(saved)
+
+    def test_block_with_enabled_false_is_noop(self, tmp_path):
+        engine = self._engine(tmp_path, profiling={"enabled": False})
+        engine.train_batch(self._batch())
+        assert engine._mem_profiler is None
+
+    def test_span_memory_wraps_session_tracer(self, tmp_path):
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.profiling.memory import SpanMemoryTracer
+
+        engine = self._engine(
+            tmp_path, profiling={},
+            telemetry_cfg={"enabled": True,
+                           "output_dir": str(tmp_path / "t"),
+                           "flush_interval": 1000})
+        try:
+            session = telemetry.get_session()
+            assert isinstance(session.tracer, SpanMemoryTracer)
+            engine.train_batch(self._batch())        # spans proxy through
+            assert any(e["name"] == "train_batch" for e in session.tracer.events)
+        finally:
+            telemetry.deconfigure()
+
+
+@pytest.mark.profiling
+class TestDsProfCLI:
+    def test_merge_acceptance(self, tmp_path):
+        """ISSUE 5 acceptance: merge >= 2 synthetic rank traces into one
+        Perfetto-loadable JSON with rank lanes, a straggler table naming
+        the slowest rank per collective, and a critical-path summary."""
+        for rank, arrive in ((0, 100.0), (1, 140.0)):
+            events = [_rank_meta(rank),
+                      _span("train_batch", 0, 200, pid=rank, step=7),
+                      _span("data", 0, 20, pid=rank, step=7),
+                      _span("fwd", 20, arrive - 20, pid=rank, step=7),
+                      _comm("all_reduce", 0, arrive, 180 - arrive, pid=rank),
+                      _span("step", 180, 20, pid=rank, step=7)]
+            name = "trace.json" if rank == 0 else f"trace.rank{rank}.json"
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
+                      open(tmp_path / name, "w"))
+        merged_path = str(tmp_path / "merged.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_prof"), "merge",
+             str(tmp_path), "-o", merged_path],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "straggler table" in proc.stdout
+        assert "rank 1" in proc.stdout                 # the slow arrival
+        assert "all_reduce#0" in proc.stdout
+        assert "critical path (step 7)" in proc.stdout
+        merged = json.load(open(merged_path))
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert pids == {0, 1}
+        assert merged["metadata"]["ranks"] == [0, 1]
+
+        # --json mode round-trips the same analysis
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_prof"), "merge",
+             str(tmp_path), "--json"], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        rep = json.loads(proc.stdout)
+        assert rep["stragglers"][0]["rank"] == 1
+        assert rep["critical_path"]["step"] == 7
+
+    def test_merge_works_without_jax(self, tmp_path):
+        """The analyses are pure stdlib; bin/ds_prof must run on a box
+        with no jax (the package __init__s would import it — the script
+        falls back to loading the modules straight from their files)."""
+        blocker = tmp_path / "nojax"
+        blocker.mkdir()
+        (blocker / "jax.py").write_text(
+            "raise ImportError('no jax on this log-crunching box')\n")
+        for rank in (0, 1):
+            json.dump({"traceEvents": [_rank_meta(rank),
+                                       _comm("all_reduce", 0, 100 + 30 * rank,
+                                             80 - 30 * rank, pid=rank)]},
+                      open(tmp_path / f"trace.rank{rank}.json", "w"))
+        env = {**os.environ, "PYTHONPATH": str(blocker)}
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_prof"), "merge",
+             str(tmp_path / "trace.rank0.json"),
+             str(tmp_path / "trace.rank1.json")],
+            capture_output=True, text=True, env=env)
+        assert proc.returncode == 0, proc.stderr
+        assert "straggler table" in proc.stdout
+        assert "rank 1" in proc.stdout
+
+    def test_memory_summary_no_data(self, tmp_path):
+        (tmp_path / "metrics.jsonl").write_text(
+            json.dumps({"kind": "gauge", "name": "train/loss",
+                        "value": 1.0}) + "\n")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_metrics"),
+             str(tmp_path), "--memory"], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "no profiling/* series" in proc.stdout

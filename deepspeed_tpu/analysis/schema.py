@@ -51,7 +51,7 @@ def _block_models() -> Dict[str, type]:
         "progressive_layer_drop": C.PLDConfig,
         "resilience": C.ResilienceConfig, "watchdog": C.WatchdogConfig,
         "telemetry": C.TelemetryConfig, "analysis": C.AnalysisConfig,
-        "profiling": C.ProfilingConfig,
+        "profiling": C.ProfilingConfig, "perf": C.PerfConfig,
         "compression_training": CompressionConfig,
     }
 
@@ -174,6 +174,15 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "step tracer, but telemetry.trace is false — there are no "
                 "spans to hook",
                 "profiling.span_memory vs telemetry.trace")
+    perf = cfg.perf
+    if "perf" in pd and perf.enabled and perf.attribution \
+            and not (tel.enabled and tel.trace):
+        add("info",
+            "perf.attribution embeds span p50/p99, step samples and "
+            "exposed-comm from the telemetry tracer, but telemetry.trace is "
+            "off — entries will carry memory/flops attribution only (enable "
+            "the telemetry block for the full breakdown)",
+            "perf.attribution vs telemetry.trace")
 
 
 def walk_config(pd: dict, world_size: Optional[int] = None

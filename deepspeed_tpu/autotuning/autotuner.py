@@ -75,9 +75,33 @@ class AutotuningConfig:
     # candidates 50% past HBM) because the model omits real contributors
     # (grad-accum buffers, streamed-offload working set, fragmentation) and
     # guesses activation bytes per remat policy — near the boundary the
-    # compile-time exact-OOM check must stay the arbiter, so only clearly
+    # exact-accounting check below must stay the arbiter, so only clearly
     # hopeless configs are skipped without ever compiling.
     hbm_prune_fraction: float = 1.5
+    # exact OOM pruning: AOT-lower the candidate's real train step
+    # (engine.aot_memory_analysis — the compiler's own argument/output/temp
+    # ledger, no execution) and skip the MEASUREMENT when it exceeds
+    # exact_memory_fraction of HBM. Near the boundary this wins over the
+    # first-order model in both directions: a candidate the first-order
+    # model calls hopeless but the compiler prices under budget runs; one
+    # it calls fine but the compiler prices over budget is pruned before
+    # the device ever allocates a step. COST: the AOT compile does not
+    # fully prime the jit dispatch cache, so a candidate that goes on to
+    # run pays roughly one extra compile (pruned candidates pay only the
+    # AOT one — cheaper than the runtime OOM they avoid). Compile-bound
+    # mega-sweeps can trade exactness back with exact_memory_check: false
+    # (ds_tune --no-exact-memory).
+    exact_memory_check: bool = True
+    exact_memory_fraction: float = 0.92
+    # HBM budget override (bytes) for the pruning checks: planning a sweep
+    # for a different chip, or testing the pruning logic off-device, where
+    # memory_stats() exposes no bytes_limit. None = ask the local device.
+    assume_hbm_bytes: Optional[int] = None
+    # perf ledger: every candidate appends one predicted-vs-measured entry
+    # (kind="tune_candidate") here; "" disables, None = the default
+    # <results_dir>/perf_ledger.jsonl. `ds_perf calibration` renders the
+    # cost-model error report over it.
+    ledger_path: Optional[str] = None
 
     @classmethod
     def from_ds_config(cls, pd: Dict) -> "AutotuningConfig":
@@ -123,6 +147,11 @@ class Autotuner:
         self.tuning = tuning or AutotuningConfig.from_ds_config(self.base_config)
         self.seq_len = seq_len
         self.experiments: List[Experiment] = []
+        # pruning counters, recorded in summary.json + the perf ledger's
+        # tune_summary entry: how many candidates never compiled (first-order
+        # model) vs never executed (exact memory_analysis)
+        self.pruned_first_order = 0
+        self.pruned_exact = 0
 
     # -------------------------------------------------------------- space
     def candidate_space(self) -> List[Dict[str, Any]]:
@@ -283,7 +312,7 @@ class Autotuner:
         return list(cands)[: t.tuner_num_trials]   # gridsearch
 
     # --------------------------------------------------------------- running
-    def _run_one(self, exp: Experiment):
+    def _run_one(self, exp: Experiment, hbm: Optional[int] = None):
         import deepspeed_tpu
 
         t = self.tuning
@@ -314,6 +343,29 @@ class Autotuner:
             refs["engine"] = engine
             batch = self.batch_factory(engine.train_batch_size())
             refs["batch"] = batch
+            if t.exact_memory_check:
+                # exact OOM gate: the compiler's own memory ledger for the
+                # EXACT step this candidate would run (AOT lower+compile,
+                # nothing executed; the compile is cached for the real
+                # steps). Near the HBM boundary this overrides whatever the
+                # first-order model guessed — in both directions.
+                ma = engine.aot_memory_analysis(
+                    batch, gas=tune.get("gas") or None)
+                if ma is not None:
+                    need = (ma["argument"] + ma["output"] - ma["alias"]
+                            + ma["temp"] + ma["generated_code"])
+                    exp.extras["memory_analysis"] = ma
+                    exp.extras["hbm_exact"] = need
+                    if hbm and need > t.exact_memory_fraction * hbm:
+                        self.pruned_exact += 1
+                        exp.status = "oom"
+                        exp.error = (
+                            f"exact memory_analysis: {need / 2**30:.2f}G "
+                            f"(argument+output-alias+temp+code) > "
+                            f"{t.exact_memory_fraction:.0%} of "
+                            f"{hbm / 2**30:.1f}G HBM — pruned before "
+                            f"execution")
+                        return
             warm = max(1, t.start_profile_step)
             for _ in range(warm):
                 loss = engine.train_batch(batch)
@@ -328,6 +380,9 @@ class Autotuner:
             exp.step_time_s = dt
             exp.tok_per_sec = tokens / dt
             exp.status = "ok"
+            mfu = self._measured_mfu(model, exp.tok_per_sec)
+            if mfu is not None:
+                exp.extras["measured_mfu"] = mfu
             if t.metric == METRIC_LATENCY:
                 exp.metric_val = -dt
             elif t.metric == METRIC_FLOPS and hasattr(model, "config") and \
@@ -359,6 +414,38 @@ class Autotuner:
                 pass
             gc.collect()
 
+    def _measured_mfu(self, model, tok_per_sec: float) -> Optional[float]:
+        """Measured MFU of one candidate (None when the model exposes no
+        flops_per_token — calibration then covers HBM only)."""
+        mc = getattr(model, "config", None)
+        if mc is None or not hasattr(mc, "flops_per_token"):
+            return None
+        try:
+            import jax
+
+            from deepspeed_tpu.accelerator import get_accelerator
+
+            seq = self.seq_len or getattr(mc, "n_positions", 1024)
+            peak = get_accelerator().peak_flops()
+            n_dev = len(jax.devices())
+            return round(tok_per_sec / n_dev * mc.flops_per_token(seq)
+                         / peak, 4)
+        except Exception:
+            return None
+
+    def _hbm_bytes(self) -> Optional[int]:
+        """The pruning budget: ``assume_hbm_bytes`` when set (planning for
+        another chip / testing off-device), else the local device's
+        ``bytes_limit``; None when neither is known (no pruning)."""
+        if self.tuning.assume_hbm_bytes:
+            return int(self.tuning.assume_hbm_bytes)
+        try:
+            import jax
+
+            return int(jax.local_devices()[0].memory_stats()["bytes_limit"])
+        except Exception:
+            return None
+
     @staticmethod
     def _batch_tokens(batch) -> int:
         import numpy as np
@@ -372,49 +459,112 @@ class Autotuner:
         x = np.asarray(x)
         return int(x.shape[0] * (x.shape[1] if x.ndim > 1 else 1))
 
+    def _candidate_entry(self, exp: Experiment) -> Dict[str, Any]:
+        """One predicted-vs-measured ledger record (kind=tune_candidate).
+        Measured HBM prefers the compiler's exact accounting (hbm_exact:
+        argument+output-alias+temp+code of the real step) over nothing —
+        runtime peak stats are allocator-lifetime, not per-program, so
+        they would overstate every candidate after the first."""
+        from deepspeed_tpu.perf import ledger as perf_ledger
+
+        tune = exp.ds_config.get("_tune", {})
+        fingerprint = ""
+        try:
+            from deepspeed_tpu.resilience.consistency import \
+                config_fingerprint
+
+            fingerprint = config_fingerprint(
+                {k: v for k, v in exp.ds_config.items() if k != "_tune"})
+        except Exception:
+            pass
+        return {
+            "kind": "tune_candidate", "exp_id": exp.exp_id,
+            "status": exp.status, "error": exp.error,
+            "tune": {k: v for k, v in tune.items() if v is not None},
+            "predicted": {"mfu": exp.extras.get("predicted_mfu"),
+                          "hbm_bytes": exp.extras.get("hbm_estimate")},
+            "measured": {"mfu": exp.extras.get("measured_mfu"),
+                         "hbm_bytes": exp.extras.get("hbm_exact")},
+            "metric": self.tuning.metric, "metric_val": exp.metric_val,
+            "tok_per_sec": exp.tok_per_sec, "step_time_s": exp.step_time_s,
+            "git_rev": perf_ledger.git_rev(), "fingerprint": fingerprint,
+        }
+
+    def _ledger_path(self) -> Optional[str]:
+        t = self.tuning
+        if t.ledger_path == "":
+            return None
+        return t.ledger_path or os.path.join(t.results_dir,
+                                             "perf_ledger.jsonl")
+
+    def _ledger_append(self, path: Optional[str], entry):
+        """``entry`` may be a dict or a zero-arg builder — construction
+        happens INSIDE the guard, so a disabled ledger skips the work
+        entirely (fingerprint hashing, git lookup) and a broken entry
+        builder degrades to a warning, never a dead search."""
+        if path is None:
+            return
+        try:
+            from deepspeed_tpu.perf import ledger as perf_ledger
+
+            perf_ledger.append_entry(path,
+                                     entry() if callable(entry) else entry)
+        except Exception as e:       # the ledger must never kill the search
+            logger.warning(f"autotuner: perf ledger append failed: {e}")
+
     def tune(self) -> Optional[Dict[str, Any]]:
-        """Run the search; returns the best ds_config (without _tune keys)."""
+        """Run the search; returns the best ds_config (without _tune keys).
+
+        Every candidate appends one ``tune_candidate`` entry (predicted vs
+        measured MFU / HBM) to the perf ledger, and the search closes with
+        a ``tune_summary`` entry carrying the pruning counters — the raw
+        material of ``ds_perf calibration``.
+        """
         t = self.tuning
         os.makedirs(t.exps_dir, exist_ok=True)
         os.makedirs(t.results_dir, exist_ok=True)
         cands = self._order(self.candidate_space())
         logger.info(f"autotuner: {len(cands)} candidates "
                     f"({t.tuner_type}, metric={t.metric})")
-        hbm = None
-        if t.hbm_prune_fraction:
-            try:
-                import jax
+        import jax
 
-                hbm = int(jax.local_devices()[0].memory_stats()["bytes_limit"])
-            except Exception:
-                hbm = None
+        from deepspeed_tpu.perf.calibration import predict_mfu
+
+        ledger_path = self._ledger_path()
+        hbm = self._hbm_bytes()
+        n_dev = len(jax.devices())
         best: Optional[Experiment] = None
         since_improved = 0
         for i, cfg in enumerate(cands):
             exp = Experiment(exp_id=i, ds_config=cfg)
             self.experiments.append(exp)
-            if hbm is not None:
-                import jax
-
-                est = self.estimate_hbm_bytes(cfg.get("_tune", {}),
-                                              len(jax.devices()), hbm=hbm)
-                if est is not None and est > t.hbm_prune_fraction * hbm:
-                    # hopeless by the first-order model: skip the compile
-                    exp.status = "pruned"
-                    exp.error = (f"estimated {est/2**30:.1f}G > "
-                                 f"{t.hbm_prune_fraction:.0%} of "
-                                 f"{hbm/2**30:.1f}G HBM")
-                    exp.extras["hbm_estimate"] = est
-                    with open(os.path.join(t.exps_dir, f"exp_{i}.json"), "w") as f:
-                        json.dump(exp.record(), f, indent=2)
-                    logger.info(f"autotuner exp {i}: pruned "
-                                f"(tune={cfg.get('_tune')}, {exp.error})")
-                    continue
-            self._run_one(exp)
+            tune = cfg.get("_tune", {})
+            est = self.estimate_hbm_bytes(tune, n_dev, hbm=hbm)
+            if est is not None:
+                exp.extras["hbm_estimate"] = est
+            exp.extras["predicted_mfu"] = predict_mfu(tune)
+            if hbm is not None and t.hbm_prune_fraction and est is not None \
+                    and est > t.hbm_prune_fraction * hbm:
+                # hopeless by the first-order model: skip the compile. The
+                # threshold is deliberately loose (default 1.5x HBM) — the
+                # exact memory_analysis gate in _run_one owns the boundary.
+                self.pruned_first_order += 1
+                exp.status = "pruned"
+                exp.error = (f"estimated {est/2**30:.1f}G > "
+                             f"{t.hbm_prune_fraction:.0%} of "
+                             f"{hbm/2**30:.1f}G HBM")
+                logger.info(f"autotuner exp {i}: pruned "
+                            f"(tune={tune}, {exp.error})")
+            else:
+                self._run_one(exp, hbm=hbm)
+                logger.info(f"autotuner exp {i}: {exp.status} "
+                            f"tune={tune} tok/s={exp.tok_per_sec:.0f}")
             with open(os.path.join(t.exps_dir, f"exp_{i}.json"), "w") as f:
                 json.dump(exp.record(), f, indent=2)
-            logger.info(f"autotuner exp {i}: {exp.status} "
-                        f"tune={cfg.get('_tune')} tok/s={exp.tok_per_sec:.0f}")
+            self._ledger_append(ledger_path,
+                                lambda: self._candidate_entry(exp))
+            if exp.status == "pruned":
+                continue
             if exp.status == "ok" and (best is None or exp.metric_val > best.metric_val):
                 best = exp
                 since_improved = 0
@@ -423,13 +573,21 @@ class Autotuner:
                 if t.tuner_early_stopping and since_improved >= t.tuner_early_stopping:
                     logger.info("autotuner: early stopping")
                     break
+        counters = {"pruned_first_order": self.pruned_first_order,
+                    "pruned_exact": self.pruned_exact,
+                    "experiments": len(self.experiments)}
         summary = {"num_experiments": len(self.experiments),
                    "best_exp_id": best.exp_id if best else None,
                    "metric": t.metric,
                    "best_metric_val": best.metric_val if best else None,
+                   "counters": counters,
                    "experiments": [e.record() for e in self.experiments]}
         with open(os.path.join(t.results_dir, "summary.json"), "w") as f:
             json.dump(summary, f, indent=2)
+        self._ledger_append(ledger_path, {
+            "kind": "tune_summary", "counters": counters,
+            "best_exp_id": best.exp_id if best else None,
+            "metric": t.metric})
         if best is None:
             logger.warning("autotuner: no candidate succeeded")
             return None

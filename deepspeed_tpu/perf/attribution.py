@@ -1,0 +1,167 @@
+"""Attribution: what the already-running observability knows about a run.
+
+One call — :func:`collect` — folds the live telemetry session and the
+engine's profiling hooks into the ``attribution`` dict a perf-ledger entry
+embeds, so every benchmark number lands with its own breakdown:
+
+* **spans** — per-span p50/p99/count over the session tracer's recorded
+  host spans (``data``/``fwd``/``bwd``/``step``/``train_batch``, µs);
+* **memory** — live-buffer census by bucket (PR 5 ``memory_census()``)
+  plus the one-shot XLA ``memory_analysis`` of the compiled step;
+* **flops** — the flops-profiler jaxpr walk of the step the engine
+  actually compiled (per-global-batch FLOPs);
+* **exposed_comm_us_per_step** — the PR 5 critical-path extraction run
+  over this rank's own trace: comm-span time not overlapped by compute
+  (the before/after number ROADMAP Item 3 optimizes).
+
+Every piece degrades to absence, never to an exception: a run without a
+telemetry session gets ``{}`` spans, a backend without memory_analysis
+gets no ``executable`` block, and a failed census is logged and skipped —
+attribution must never kill the benchmark it is describing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _percentile(sorted_xs: List[float], p: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    if len(sorted_xs) == 1:
+        return sorted_xs[0]
+    idx = (len(sorted_xs) - 1) * (p / 100.0)
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_xs) - 1)
+    frac = idx - lo
+    return sorted_xs[lo] * (1 - frac) + sorted_xs[hi] * frac
+
+
+def span_breakdown(events: List[dict]) -> Dict[str, Dict[str, float]]:
+    """Per-span-name p50/p99/count/total over complete (``ph="X"``) trace
+    events — the step phase breakdown, in µs."""
+    by_name: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("ph") == "X" and "dur" in ev:
+            by_name.setdefault(str(ev.get("name", "?")), []).append(
+                float(ev["dur"]))
+    out = {}
+    for name, durs in by_name.items():
+        durs.sort()
+        out[name] = {"count": len(durs),
+                     "p50_us": round(_percentile(durs, 50), 1),
+                     "p99_us": round(_percentile(durs, 99), 1),
+                     "total_us": round(sum(durs), 1)}
+    return out
+
+
+def tracer_events(session) -> List[dict]:
+    """The session tracer's recorded events ([] when tracing is off). The
+    SpanMemoryTracer wrapper proxies attribute access to the wrapped
+    tracer, so this sees through it."""
+    if session is None:
+        return []
+    events = getattr(session.tracer, "events", None)
+    return list(events) if events else []
+
+
+def train_step_samples(events: List[dict], name: str = "train_batch",
+                       last: Optional[int] = None) -> List[float]:
+    """Per-step wall SECONDS from the ``train_batch`` span durations —
+    the noise-bound reservoir a ledger entry carries. ``last`` keeps only
+    the trailing N (the timed window; earlier spans are warmup/compile)."""
+    durs = [float(ev["dur"]) / 1e6 for ev in events
+            if ev.get("ph") == "X" and ev.get("name") == name
+            and "dur" in ev]
+    if last is not None and last > 0:
+        durs = durs[-last:]
+    return durs
+
+
+def trailing_window(events: List[dict],
+                    last: Optional[int]) -> List[dict]:
+    """Keep, per span name, only the LAST ``last`` complete-span events —
+    the measurement window. Without this, a line's span breakdown is
+    dominated by the warmup/compile step (a seconds-long ``train_batch``
+    span next to ms steady-state ones) and the p99 'attribution' points
+    the regression hunt at compilation. One-shot spans (< last
+    occurrences) pass through whole; non-span events are kept."""
+    if not last or last <= 0:
+        return events
+    idx_by_name: Dict[str, List[int]] = {}
+    for i, ev in enumerate(events):
+        if ev.get("ph") == "X" and "dur" in ev:
+            idx_by_name.setdefault(str(ev.get("name", "?")), []).append(i)
+    keep = set()
+    for idxs in idx_by_name.values():
+        keep.update(idxs[-last:])
+    return [ev for i, ev in enumerate(events)
+            if not (ev.get("ph") == "X" and "dur" in ev) or i in keep]
+
+
+def exposed_comm_from_events(events: List[dict],
+                             last_steps: Optional[int] = None
+                             ) -> Optional[float]:
+    """Average exposed-comm µs/step over this rank's own trace (single-rank
+    FleetTrace — the same math ``ds_prof merge`` runs fleet-wide), over
+    the LAST ``last_steps`` steps when given (the timed window)."""
+    if not events:
+        return None
+    from deepspeed_tpu.profiling.aggregate import FleetTrace
+
+    ft = FleetTrace()
+    ft.add_rank(0, events)
+    per_step = ft.exposed_comm_summary(align=False)["per_step"]
+    if not per_step:
+        return None
+    steps = sorted(per_step)
+    if last_steps and last_steps > 0:
+        steps = steps[-last_steps:]
+    return sum(per_step[s] for s in steps) / len(steps)
+
+
+def collect(engine, session=None, timed_steps: Optional[int] = None
+            ) -> Dict[str, Any]:
+    """The full attribution dict for one engine run. ``session`` defaults
+    to the live telemetry session; ``timed_steps`` windows the span
+    breakdown and the exposed-comm average to the last N steps (the
+    measurement window — warmup/compile spans otherwise dominate p99)."""
+    from deepspeed_tpu import telemetry
+
+    if session is None:
+        session = telemetry.get_session()
+    att: Dict[str, Any] = {}
+    events = tracer_events(session)
+    if events:
+        att["spans"] = span_breakdown(trailing_window(events, timed_steps))
+        exposed = exposed_comm_from_events(events, last_steps=timed_steps)
+        if exposed is not None:
+            att["exposed_comm_us_per_step"] = round(exposed, 1)
+    # ---- memory: census buckets + compiled-step accounting
+    try:
+        res = engine.memory_census()
+        att["memory"] = {
+            "bucket_bytes": {k: int(v) for k, v in res.bucket_bytes.items()},
+            "total_bytes": int(res.total_bytes),
+            "attributed_fraction": round(res.fraction_attributed, 4),
+        }
+    except Exception as e:
+        logger.warning(f"perf attribution: memory census failed: {e}")
+    try:
+        from deepspeed_tpu.profiling.memory import executable_memory
+
+        exe = executable_memory(engine)
+        if exe is not None:
+            att.setdefault("memory", {})["executable"] = exe
+    except Exception as e:
+        logger.warning(f"perf attribution: executable accounting failed: {e}")
+    # ---- flops: the jaxpr walk of the compiled step
+    try:
+        flops = float(engine._estimate_step_flops())
+        if flops > 0:
+            att["flops_per_batch"] = flops
+    except Exception as e:
+        logger.warning(f"perf attribution: flops estimate failed: {e}")
+    return att

@@ -1,0 +1,250 @@
+"""``bin/ds_perf`` — perf-ledger diff, regression gate, calibration report.
+
+Subcommands (all pure stdlib — run them on a laptop, in CI, anywhere):
+
+* ``ds_perf show <ledger>`` — latest entry per benchmark series, with
+  fingerprint/revision so "what changed" is visible at a glance.
+* ``ds_perf diff <A> <B> [--rel-tol 0.05]`` — compare the latest entries
+  of every series two ledgers share, with noise bounds: a delta only
+  counts as regression/improvement when the per-step samples clear a
+  Welch-style t gate (entries without samples fall back to the plain
+  threshold). ``A``/``B`` may be perf ledgers (JSONL) or historical
+  ``BENCH_rNN.json`` driver files.
+* ``ds_perf gate --baseline BENCH_r05.json [--candidate perf_ledger.jsonl]``
+  — CI teeth: exit 2 when a gated series regresses OR its newest
+  candidate entry is a failure line (a crashed headline bench fails the
+  gate even when an older success sits in the append-only ledger), exit
+  3 when a gated series was never measured (``--allow-missing``
+  downgrades that to a warning). Default gate set = the baseline's
+  headline entry (the driver format marks it); ``--metric SUBSTR`` gates
+  matching series instead, ``--all`` gates every shared series.
+* ``ds_perf calibration <ledger|results_dir>`` — predicted-vs-measured
+  cost-model error over the autotuner's ``tune_candidate`` entries.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from deepspeed_tpu.perf import calibration as cal
+from deepspeed_tpu.perf import ledger as led
+
+
+def _fmt_val(v: float) -> str:
+    return f"{v:.4f}" if abs(v) < 100 else f"{v:.1f}"
+
+
+def _load(path: str):
+    if not os.path.exists(path):
+        print(f"ds_perf: no such file: {path}", file=sys.stderr)
+        raise SystemExit(1)
+    return led.load_baseline(path)
+
+
+def _cmd_show(args) -> int:
+    latest = led.latest_by_series(_load(args.ledger))
+    if not latest:
+        print("ds_perf show: ledger holds no entries")
+        return 1
+    rows = [("series", "value", "unit", "rev", "fingerprint", "samples")]
+    for key in sorted(latest):
+        e = latest[key]
+        rows.append((key.split(" [", 1)[0], _fmt_val(float(e.get("value") or 0.0)),
+                     str(e.get("unit", "")), str(e.get("git_rev") or "-"),
+                     str(e.get("fingerprint") or "-")[:12],
+                     str(len(e.get("samples") or []))))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for i, r in enumerate(rows):
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            print("  ".join("-" * w for w in widths))
+    return 0
+
+
+def _select(series_keys, metric_substrs):
+    if not metric_substrs:
+        return list(series_keys)
+    return [k for k in series_keys
+            if any(s.lower() in k.lower() for s in metric_substrs)]
+
+
+def _cmd_diff(args) -> int:
+    old = led.latest_by_series(_load(args.old))
+    new = led.latest_by_series(_load(args.new))
+    shared = _select([k for k in old if k in new], args.metric)
+    if not shared:
+        print("ds_perf diff: the two ledgers share no benchmark series",
+              file=sys.stderr)
+        return 1
+    results = [led.compare(old[k], new[k], rel_tol=args.rel_tol)
+               for k in sorted(shared)]
+    if args.json:
+        print(json.dumps(results, indent=2))
+        return 0
+    for r in results:
+        mark = {"regression": "--", "improvement": "++",
+                "within_noise": "=="}[r["verdict"]]
+        noise = ""
+        if r["significant"] is not None:
+            noise = (f"  (t={r['t_stat']:+.1f} over {r['n_old']}/{r['n_new']}"
+                     f" samples: {'significant' if r['significant'] else 'noise'})")
+        elif r["t_stat"] is not None:
+            noise = (f"  ({r['n_old']}/{r['n_new']} samples: underpowered, "
+                     f"threshold verdict)")
+        fp = "  [config fingerprint changed]" if r["fingerprint_changed"] else ""
+        print(f"{mark} {r['series']}: {_fmt_val(r['old_value'])} -> "
+              f"{_fmt_val(r['new_value'])} ({r['rel_delta']:+.1%})"
+              f"{noise}{fp}")
+    return 0
+
+
+def _cmd_gate(args) -> int:
+    base = led.latest_by_series(_load(args.baseline))
+    cand_path = args.candidate
+    cand_entries = _load(cand_path)
+    cand = led.latest_by_series(cand_entries)
+    # the gate's question is "what did the NEWEST run do" — a gated
+    # benchmark whose newest entry is a failure must fail the gate even
+    # when an older success of the same series sits in the append-only
+    # ledger (and a gated series the run never measured is a failure by
+    # default, not a warning: a crashed bench exits the same way a
+    # regressed one does)
+    newest = led.newest_by_series(cand_entries)
+    if args.all:
+        gated = [k for k in base if k in cand or k in newest]
+    elif args.metric:
+        gated = _select(base.keys(), args.metric)
+    else:
+        gated = [k for k, e in base.items() if e.get("headline")]
+        if not gated:
+            gated = list(base)
+    if not gated:
+        print("ds_perf gate: no gated series selected", file=sys.stderr)
+        return 1
+    failures, crashed, missing, checked = [], [], [], []
+    for k in sorted(gated):
+        newest_e = newest.get(k)
+        if newest_e is not None and newest_e.get("failed"):
+            crashed.append(k)
+            continue
+        if k not in cand or (newest_e is not None
+                             and led.is_nonmeasurement(newest_e)):
+            missing.append(k)     # never measured, or newest run skipped it
+            continue
+        r = led.compare(base[k], cand[k], rel_tol=args.rel_tol)
+        checked.append(r)
+        if r["verdict"] == "regression" or not r["new_value"]:
+            failures.append(r)
+    if args.json:
+        print(json.dumps({"checked": checked, "missing": missing,
+                          "crashed": crashed,
+                          "failures": [f["series"] for f in failures],
+                          "rel_tol": args.rel_tol,
+                          "allow_missing": args.allow_missing}, indent=2))
+    else:
+        for r in checked:
+            ok = r not in failures
+            print(f"{'PASS' if ok else 'FAIL'} {r['series']}: "
+                  f"{_fmt_val(r['old_value'])} -> {_fmt_val(r['new_value'])} "
+                  f"({r['rel_delta']:+.1%}, tol {args.rel_tol:.0%})")
+        for k in crashed:
+            e = newest[k]
+            print(f"FAIL {k}: newest run FAILED "
+                  f"({e.get('error_type', '?')}; see ledger traceback"
+                  + (f", telemetry: {e['telemetry_dir']}"
+                     if e.get("telemetry_dir") else "") + ")")
+        for k in missing:
+            print(f"{'WARN' if args.allow_missing else 'FAIL'} {k}: "
+                  f"not measured in {cand_path}")
+    if failures or crashed:
+        return 2
+    if missing and not args.allow_missing:
+        return 3
+    return 0
+
+
+def _cmd_calibration(args) -> int:
+    path = args.ledger
+    if os.path.isdir(path):
+        path = os.path.join(path, "perf_ledger.jsonl")
+    if not os.path.exists(path):
+        print(f"ds_perf calibration: no such file: {path}", file=sys.stderr)
+        return 1
+    entries = led.load_entries(path)
+    rows = cal.calibration_rows(entries)
+    counters = {}
+    for e in entries:
+        if e.get("kind") == "tune_summary":
+            counters = e.get("counters") or {}
+    if args.json:
+        print(json.dumps({"rows": rows,
+                          "summary": cal.calibration_summary(rows),
+                          "counters": counters}, indent=2))
+        return 0
+    print(cal.render_calibration(rows, counters=counters, source=path))
+    return 0 if rows else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="ds_perf",
+        description="perf ledger: show / diff / regression gate / "
+                    "cost-model calibration")
+    sub = p.add_subparsers(dest="cmd")
+
+    s = sub.add_parser("show", help="latest entry per benchmark series")
+    s.add_argument("ledger", help="perf ledger JSONL (or BENCH_rNN.json)")
+
+    d = sub.add_parser("diff", help="compare two ledgers with noise bounds")
+    d.add_argument("old")
+    d.add_argument("new")
+    d.add_argument("--rel-tol", type=float, default=0.05,
+                   help="relative tolerance before a delta counts (default 5%%)")
+    d.add_argument("--metric", action="append", default=[],
+                   help="only series whose key contains SUBSTR (repeatable)")
+    d.add_argument("--json", action="store_true")
+
+    g = sub.add_parser("gate", help="exit 2 on a gated-series regression")
+    g.add_argument("--baseline", required=True,
+                   help="baseline ledger / BENCH_rNN.json")
+    g.add_argument("--candidate", default="perf_ledger.jsonl",
+                   help="candidate ledger (default ./perf_ledger.jsonl)")
+    g.add_argument("--rel-tol", type=float, default=0.08,
+                   help="allowed relative regression (default 8%%)")
+    g.add_argument("--metric", action="append", default=[],
+                   help="gate series whose key contains SUBSTR (repeatable); "
+                        "default: the baseline's headline entry")
+    g.add_argument("--all", action="store_true",
+                   help="gate every series the two files share")
+    g.add_argument("--allow-missing", action="store_true",
+                   help="downgrade 'gated series not measured in the "
+                        "candidate' from a failure (exit 3) to a warning — "
+                        "default is to fail, because a bench that crashed "
+                        "before its line looks exactly like one that was "
+                        "never run")
+    g.add_argument("--json", action="store_true")
+
+    c = sub.add_parser("calibration",
+                       help="predicted-vs-measured cost-model error report")
+    c.add_argument("ledger",
+                   help="perf ledger JSONL or a ds_tune results dir")
+    c.add_argument("--json", action="store_true")
+
+    args = p.parse_args(argv)
+    if args.cmd == "show":
+        return _cmd_show(args)
+    if args.cmd == "diff":
+        return _cmd_diff(args)
+    if args.cmd == "gate":
+        return _cmd_gate(args)
+    if args.cmd == "calibration":
+        return _cmd_calibration(args)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

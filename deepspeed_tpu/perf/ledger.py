@@ -1,0 +1,291 @@
+"""Append-only perf ledger: every benchmark number, with attribution.
+
+The BENCH_r0*.json history taught two lessons the hard way: a metric line
+that is just ``{"metric", "value"}`` cannot be diffed against anything
+(the config is crammed into the metric STRING), and a regression found
+five PRs later cannot be attributed to anything (the line carries no
+fingerprint, no environment, no breakdown). The ledger fixes both:
+
+* every run appends one JSON object per benchmark line to a ``.jsonl``
+  file (append-only — history is the point);
+* each entry is keyed by a **config/code fingerprint** (the same sha256
+  the PR 3 cross-rank consistency guard broadcasts at init, so "did the
+  config change?" has the same answer in both subsystems) plus the git
+  revision;
+* each entry carries per-step ``samples`` so two entries can be compared
+  with NOISE BOUNDS (Welch-style t gate over the step-time reservoirs)
+  instead of eyeballing two scalars;
+* ``attribution`` embeds the telemetry the run already collected —
+  per-span p50/p99, memory-census buckets, flops, exposed-comm µs/step —
+  so a regressed line says WHERE the time went.
+
+Everything here is pure stdlib: ``bin/ds_perf`` diffs ledgers on a laptop
+with no jax installed, exactly like ``bin/ds_prof`` merges traces.
+
+Baseline compatibility: :func:`load_baseline` also reads the historical
+driver format (``BENCH_rNN.json``: ``{"cmd", "rc", "tail", "parsed"}``
+where ``tail`` is the benched JSON lines) and bare JSON-lines text, so
+``ds_perf gate --baseline BENCH_r05.json`` works against the existing
+record without converting anything.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+
+# ------------------------------------------------------------------ identity
+_GIT_REV_CACHE: Dict[str, str] = {}
+
+
+def git_rev(cwd: Optional[str] = None) -> str:
+    """Short git revision of ``cwd`` (or this file's repo); "" when not a
+    checkout. Cached — bench ladders call this once per line."""
+    key = cwd or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if key not in _GIT_REV_CACHE:
+        try:
+            _GIT_REV_CACHE[key] = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=key,
+                capture_output=True, text=True, timeout=5,
+            ).stdout.strip()
+        except Exception:
+            _GIT_REV_CACHE[key] = ""
+    return _GIT_REV_CACHE[key]
+
+
+def series_key(entry: Dict[str, Any]) -> str:
+    """The identity two entries must share to be comparable: an explicit
+    ``series`` field when present (failure/skip lines set it — their
+    metric string is ``"<label> FAILED: ..."``, which must still land in
+    the same series as the measurement it failed to produce), else the
+    metric string's config-free prefix (everything before " (") plus the
+    unit. Works for both ledger entries and the historical bench lines,
+    whose metric strings share the same ``"<name> <what> (knobs...)"``
+    shape."""
+    series = entry.get("series")
+    if series:
+        return f"{series} [{entry.get('unit', '')}]"
+    metric = str(entry.get("metric", ""))
+    name = metric.split(" (", 1)[0].strip()
+    return f"{name} [{entry.get('unit', '')}]"
+
+
+# ------------------------------------------------------------------ appending
+def append_entry(path: str, entry: Dict[str, Any]) -> Dict[str, Any]:
+    """Append one entry to the ledger (stamps schema version + timestamp);
+    returns the stamped entry. Append-only by design: the ledger IS the
+    history, ``ds_perf diff`` picks entries out of it."""
+    entry = dict(entry)
+    entry.setdefault("schema", SCHEMA_VERSION)
+    entry.setdefault("ts", time.time())
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, default=str) + "\n")
+    return entry
+
+
+def load_entries(path: str) -> List[Dict[str, Any]]:
+    """All well-formed entries of a ledger JSONL, in file order. A torn
+    final line (run killed mid-append) is skipped, not fatal."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def load_baseline(path: str) -> List[Dict[str, Any]]:
+    """Entries from ANY of the three formats a baseline can live in:
+    a perf ledger (JSONL), the driver's ``BENCH_rNN.json`` wrapper
+    (``tail`` = benched JSON lines, ``parsed`` = the headline), or bare
+    JSON-lines text. The driver format marks its ``parsed`` headline with
+    ``"headline": True`` so ``gate`` can default to it."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except ValueError:
+        data = None
+    if isinstance(data, dict) and "tail" in data and "parsed" in data:
+        entries = []
+        for line in str(data.get("tail", "")).splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                entries.append(json.loads(line))
+            except ValueError:
+                continue
+        parsed = data.get("parsed")
+        if isinstance(parsed, dict):
+            pk = series_key(parsed)
+            matched = False
+            for e in entries:
+                if series_key(e) == pk:
+                    e["headline"] = True
+                    matched = True
+            if not matched:
+                parsed = dict(parsed, headline=True)
+                entries.append(parsed)
+        return entries
+    if isinstance(data, dict):
+        return [data]
+    if isinstance(data, list):
+        return [e for e in data if isinstance(e, dict)]
+    return load_entries(path)
+
+
+def is_nonmeasurement(entry: Dict[str, Any]) -> bool:
+    """Failure/skip lines: a record of what did NOT get measured."""
+    return bool(entry.get("skipped") or entry.get("failed")
+                or "FAILED" in str(entry.get("metric", ""))
+                or "SKIPPED" in str(entry.get("metric", "")))
+
+
+def latest_by_series(entries: Sequence[Dict[str, Any]]
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Last REAL entry per series key (file order = append order = time
+    order). Skipped/failed lines never shadow a real measurement of the
+    same series — they are what ``show``/``diff`` should look past. The
+    gate additionally consults :func:`newest_by_series` so a crashed
+    gated benchmark cannot hide behind a previous run's success."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in entries:
+        k = series_key(e)
+        if is_nonmeasurement(e):
+            out.setdefault(k, e)     # better than nothing, but never shadows
+            continue
+        out[k] = e
+    return out
+
+
+def newest_by_series(entries: Sequence[Dict[str, Any]]
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Last entry per series key INCLUDING failures/skips — 'what did the
+    newest run actually do', the question the regression gate asks."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in entries:
+        out[series_key(e)] = e
+    return out
+
+
+# ------------------------------------------------------------- noise bounds
+def _mean_std(xs: Sequence[float]) -> Tuple[float, float, int]:
+    n = len(xs)
+    if n == 0:
+        return 0.0, 0.0, 0
+    mean = sum(xs) / n
+    if n < 2:
+        return mean, 0.0, n
+    var = sum((x - mean) ** 2 for x in xs) / (n - 1)
+    return mean, math.sqrt(var), n
+
+
+def welch_t(a: Sequence[float], b: Sequence[float]) -> Optional[float]:
+    """Welch's t statistic for mean(a) != mean(b); None when either side
+    has fewer than 2 samples or both have zero variance."""
+    ma, sa, na = _mean_std(a)
+    mb, sb, nb = _mean_std(b)
+    if na < 2 or nb < 2:
+        return None
+    se2 = sa * sa / na + sb * sb / nb
+    if se2 <= 0:
+        return None if ma == mb else math.inf
+    return (ma - mb) / math.sqrt(se2)
+
+# ~97.5th percentile of t for small df — indexed by min(n_a, n_b) - 1
+# (conservative df choice; Welch df would only ever be larger).
+_T_CRIT = {1: 12.71, 2: 4.30, 3: 3.18, 4: 2.78, 5: 2.57, 6: 2.45, 7: 2.36,
+           8: 2.31, 9: 2.26, 10: 2.23, 15: 2.13, 20: 2.09, 30: 2.04}
+
+# Minimum per-side sample count for the t gate to carry a verdict: with
+# fewer, a failed significance test means "underpowered", not "noise",
+# and must NOT exonerate a past-tolerance regression (a 2-sample ledger
+# entry would otherwise green-light a 28% drop — df=1's 12.71 critical
+# value is nearly unreachable).
+MIN_POWER_SAMPLES = 3
+
+
+def t_critical(na: int, nb: int) -> float:
+    df = max(1, min(na, nb) - 1)
+    for bound in sorted(_T_CRIT):
+        if df <= bound:
+            return _T_CRIT[bound]
+    return 1.96
+
+
+def compare(old: Dict[str, Any], new: Dict[str, Any],
+            rel_tol: float = 0.05) -> Dict[str, Any]:
+    """Compare two entries of one series with noise bounds.
+
+    ``value`` carries the headline scalar (higher = better for every
+    bench unit); ``samples`` (per-step wall seconds, lower = better) feed
+    the significance test when both sides have them. The verdict:
+
+    * ``regression``  — new value below tolerance AND (no/insufficient
+      samples, or the step-time delta is t-significant). A noisy pair
+      that cannot clear the t gate is ``within_noise``, not a regression
+      — exactly the r4 llama false-collapse this machinery exists to not
+      repeat. The t gate only gets to EXONERATE a delta when it has
+      statistical power: below ``MIN_POWER_SAMPLES`` per side (df=1
+      needs |t|>12.7 — nearly nothing clears that, so 'not significant'
+      means 'cannot tell', not 'fine') the verdict falls back to the
+      plain threshold, same as legacy sample-less entries. A changed
+      config fingerprint also disables exoneration — step-time noise
+      says nothing about a value change caused by a different config.
+    * ``improvement`` — symmetric.
+    * ``within_noise`` — everything else.
+    """
+    vo = float(old.get("value") or 0.0)
+    vn = float(new.get("value") or 0.0)
+    delta = vn - vo
+    rel = delta / vo if vo else (0.0 if vn == 0 else math.inf)
+    sa = [float(x) for x in (old.get("samples") or [])]
+    sb = [float(x) for x in (new.get("samples") or [])]
+    t = welch_t(sa, sb)
+    significant = None
+    if t is not None and min(len(sa), len(sb)) >= MIN_POWER_SAMPLES:
+        significant = abs(t) > t_critical(len(sa), len(sb))
+    out = {
+        "series": series_key(new),
+        "old_value": vo, "new_value": vn,
+        "delta": delta, "rel_delta": rel,
+        "old_rev": old.get("git_rev"), "new_rev": new.get("git_rev"),
+        "old_fingerprint": old.get("fingerprint"),
+        "new_fingerprint": new.get("fingerprint"),
+        "fingerprint_changed": (
+            bool(old.get("fingerprint")) and bool(new.get("fingerprint"))
+            and old.get("fingerprint") != new.get("fingerprint")),
+        "t_stat": t, "significant": significant,
+        "n_old": len(sa), "n_new": len(sb),
+    }
+    # the t gate runs on STEP-TIME samples; when the config fingerprint
+    # changed, the headline value and the step time are no longer two
+    # views of one experiment (e.g. tokens/step drifted: MFU halves while
+    # step time stays flat) — a flat step time must not exonerate a
+    # past-tolerance value change, so the verdict falls back to the plain
+    # threshold (the CLI tags the line '[config fingerprint changed]')
+    exonerated = significant is False and not out["fingerprint_changed"]
+    if rel < -rel_tol and not exonerated:
+        out["verdict"] = "regression"
+    elif rel > rel_tol and not exonerated:
+        out["verdict"] = "improvement"
+    else:
+        out["verdict"] = "within_noise"
+    return out

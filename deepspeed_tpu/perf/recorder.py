@@ -1,0 +1,118 @@
+"""PerfRecorder — the engine's ledger pen (``perf`` ds_config block).
+
+Imported ONLY when the block is present (strict no-op contract, same as
+``analysis`` / ``profiling``: without the block this module never enters
+``sys.modules``). The recorder owns nothing heavy — it stamps structured
+ledger entries from what the run already knows:
+
+* identity: config/code **fingerprint** (the PR 3
+  ``consistency.config_fingerprint`` — same hash the cross-rank guard
+  agrees on at init), git revision, backend/env facts;
+* attribution: :func:`deepspeed_tpu.perf.attribution.collect` over the
+  live telemetry session + engine profiling hooks;
+* the caller's headline (metric string / value / unit / model / knobs).
+
+``bench.py`` calls :meth:`PerfRecorder.record` once per ladder line; any
+training script can do the same through ``engine.perf_record(...)``.
+Entries append to ``perf.ledger_path`` (rank 0 only) and are returned to
+the caller either way.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.perf import attribution as _attribution
+from deepspeed_tpu.perf import ledger as _ledger
+from deepspeed_tpu.utils.logging import logger
+
+
+class PerfRecorder:
+    def __init__(self, engine, cfg):
+        self.engine = engine
+        self.cfg = cfg
+        self._fingerprint: Optional[str] = None
+
+    # ------------------------------------------------------------- identity
+    def fingerprint(self) -> str:
+        """The run's config/code fingerprint — PR 3's consistency hash, so
+        'same fingerprint' means 'the startup guard would have agreed'."""
+        if self._fingerprint is None:
+            from deepspeed_tpu.resilience.consistency import \
+                config_fingerprint
+
+            try:
+                self._fingerprint = config_fingerprint(
+                    self.engine._config.to_dict(),
+                    mesh=getattr(self.engine, "mesh", None))
+            except Exception as e:
+                logger.warning(f"perf: fingerprint failed: {e}")
+                self._fingerprint = ""
+        return self._fingerprint
+
+    @staticmethod
+    def env_facts() -> Dict[str, Any]:
+        import jax
+
+        return {
+            "backend": jax.default_backend(),
+            "n_dev": len(jax.devices()),
+            "n_proc": jax.process_count(),
+            "jax": jax.__version__,
+            "python": sys.version.split()[0],
+        }
+
+    # -------------------------------------------------------------- recording
+    def record(self, metric: str, value: float, unit: str,
+               model: Optional[str] = None,
+               config: Optional[Dict[str, Any]] = None,
+               seed: Optional[int] = None,
+               samples: Optional[list] = None,
+               timed_steps: Optional[int] = None,
+               extra: Optional[Dict[str, Any]] = None,
+               attribution: Optional[bool] = None) -> Dict[str, Any]:
+        """Build one structured ledger entry (and append it when
+        ``perf.ledger_path`` is set and this is process 0). The legacy
+        ``metric`` string stays the compat surface — drivers that parse
+        ``{"metric", "value", "unit"}`` keep working unchanged.
+        ``attribution`` defaults to the config block's knob (false =
+        headline + identity fields only: no census walk, no flops trace,
+        no span fold)."""
+        import jax
+
+        from deepspeed_tpu import telemetry
+
+        session = telemetry.get_session()
+        entry: Dict[str, Any] = {
+            "metric": metric, "value": value, "unit": unit,
+            "model": model,
+            "config": dict(config or {}),
+            "env": self.env_facts(),
+            "seed": seed,
+            "git_rev": _ledger.git_rev(),
+            "fingerprint": self.fingerprint(),
+        }
+        if session is not None:
+            entry["telemetry_dir"] = session.output_dir
+        events = _attribution.tracer_events(session)
+        if samples is None and events:
+            samples = _attribution.train_step_samples(events,
+                                                      last=timed_steps)
+        if samples:
+            entry["samples"] = [round(float(s), 6) for s in samples]
+        want_attribution = (self.cfg.attribution if attribution is None
+                            else attribution)
+        if want_attribution:
+            entry["attribution"] = _attribution.collect(
+                self.engine, session=session, timed_steps=timed_steps)
+        if extra:
+            entry.update(extra)
+        path = self.cfg.ledger_path
+        if path and jax.process_index() == 0:
+            try:
+                entry = _ledger.append_entry(path, entry)
+            except OSError as e:     # the ledger must never kill the run
+                logger.warning(f"perf: ledger append to {path!r} failed: {e}")
+        return entry

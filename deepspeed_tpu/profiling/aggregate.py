@@ -135,6 +135,7 @@ class FleetTrace:
     def __init__(self):
         self.by_rank: Dict[int, List[dict]] = {}
         self._offsets: Optional[Dict[int, float]] = None
+        self._aligned_cache: Optional[Dict[int, List[dict]]] = None
 
     @classmethod
     def from_files(cls, paths: Sequence[str]) -> "FleetTrace":
@@ -174,6 +175,7 @@ class FleetTrace:
     def add_rank(self, rank: int, events: List[dict]) -> None:
         self.by_rank[int(rank)] = list(events)
         self._offsets = None
+        self._aligned_cache = None
 
     # ------------------------------------------------------- clock alignment
     def clock_offsets(self) -> Dict[int, float]:
@@ -210,6 +212,11 @@ class FleetTrace:
     def _aligned(self, align: bool) -> Dict[int, List[dict]]:
         if not align:
             return self.by_rank
+        # cached: exposed_comm_summary calls this once per step, and merge
+        # follows with critical_path + to_chrome_trace — re-copying every
+        # skewed rank's events each time would be O(steps × events)
+        if self._aligned_cache is not None:
+            return self._aligned_cache
         offsets = self.clock_offsets()
         out = {}
         for rank, events in self.by_rank.items():
@@ -219,6 +226,7 @@ class FleetTrace:
             else:
                 out[rank] = [dict(ev, ts=ev["ts"] - off) if "ts" in ev else ev
                              for ev in events]
+        self._aligned_cache = out
         return out
 
     # ------------------------------------------------------------ merged view
@@ -286,23 +294,23 @@ class FleetTrace:
                     out.add(step)
         return sorted(out)
 
-    def critical_path(self, step: Optional[int] = None, align: bool = True,
-                      tolerance_us: float = 1.0) -> Optional[CriticalPath]:
-        """Longest dependency chain of leaf spans in one step, across ranks.
+    def _step_leaves(self, step: Optional[int], align: bool
+                     ) -> Tuple[Optional[int], List[Tuple[int, dict]]]:
+        """(resolved step, leaf spans of that step across ranks) — the
+        span-selection both :meth:`critical_path` and
+        :meth:`exposed_comm_us` run on.
 
         Spans belong to the step when their ``args.step`` matches, or (comm
         events, which carry no step) when they fall inside the step's
         ``train_batch`` window. Container spans — those fully enclosing
-        another selected span on the same rank — are dropped so the chain
-        is built from the phases, not the envelope. Dependency: A precedes
-        B when A ends no later than ``tolerance_us`` after B starts; the
-        path maximizes on-path duration (classic DAG longest-path DP).
+        another selected span on the same rank — are dropped so the
+        analyses see the phases, not the envelope.
         """
         aligned = self._aligned(align)
         if step is None:
             steps = self.steps()
             if not steps:
-                return None
+                return None, []
             step = steps[-1]
         windows = []
         spans: List[Tuple[int, dict]] = []
@@ -325,7 +333,7 @@ class FleetTrace:
                             and lo <= ev["ts"] and ev["ts"] + ev["dur"] <= hi):
                         spans.append((rank, ev))
         if not spans:
-            return None
+            return step, []
         # leaves only: drop spans that fully contain another selected span
         # on the same rank (train_batch encloses data/fwd/bwd/step/comm)
         def contains(outer, inner):
@@ -338,7 +346,21 @@ class FleetTrace:
                              for r2, ev2 in spans)]
         if not leaves:
             leaves = spans
-        leaves.sort(key=lambda x: (x[1]["ts"], x[1]["ts"] + x[1]["dur"]))
+        return step, leaves
+
+    def critical_path(self, step: Optional[int] = None, align: bool = True,
+                      tolerance_us: float = 1.0) -> Optional[CriticalPath]:
+        """Longest dependency chain of leaf spans in one step, across ranks.
+
+        Dependency: A precedes B when A ends no later than ``tolerance_us``
+        after B starts; the path maximizes on-path duration (classic DAG
+        longest-path DP). Span selection: :meth:`_step_leaves`.
+        """
+        step, leaves = self._step_leaves(step, align)
+        if not leaves:
+            return None
+        leaves = sorted(leaves,
+                        key=lambda x: (x[1]["ts"], x[1]["ts"] + x[1]["dur"]))
         n = len(leaves)
         best = [float(ev["dur"]) for _, ev in leaves]
         prev = [-1] * n
@@ -364,3 +386,82 @@ class FleetTrace:
         hi = max(ev["ts"] + ev["dur"] for _, ev in leaves)
         return CriticalPath(step=step, total_us=best[end], wall_us=hi - lo,
                             segments=chain)
+
+    # ----------------------------------------------------------- exposed comm
+    def exposed_comm_us(self, step: Optional[int] = None,
+                        align: bool = True) -> Optional[float]:
+        """EXPOSED communication µs in one step: wall time where at least
+        one comm span is running and NO compute span is — i.e. the union
+        of the step's comm leaf intervals minus the union of its non-comm
+        leaf intervals, fleet-wide once clocks are aligned.
+
+        This is the ROADMAP Item 3 before/after number: overlap work
+        (gather prefetch, reduce-scatter under backward) shrinks exactly
+        this quantity while the per-op comm histograms stay the same.
+        Returns None when the step has no leaf spans at all, 0.0 when it
+        has spans but no comm (nothing exposed).
+        """
+        step, leaves = self._step_leaves(step, align)
+        if not leaves:
+            return None
+        comm = _merge_intervals([(ev["ts"], ev["ts"] + ev["dur"])
+                                 for _, ev in leaves
+                                 if ev.get("cat") == "comm"])
+        compute = _merge_intervals([(ev["ts"], ev["ts"] + ev["dur"])
+                                    for _, ev in leaves
+                                    if ev.get("cat") != "comm"])
+        return _measure(_subtract_intervals(comm, compute))
+
+    def exposed_comm_summary(self, align: bool = True) -> Dict[str, Any]:
+        """Per-step exposed-comm µs + the average over all complete steps
+        — the ``exposed_comm_us_per_step`` line ``ds_prof merge`` prints
+        and the perf ledger records."""
+        per_step: Dict[int, float] = {}
+        for step in self.steps():
+            us = self.exposed_comm_us(step=step, align=align)
+            if us is not None:
+                per_step[step] = us
+        avg = (sum(per_step.values()) / len(per_step)) if per_step else None
+        return {"per_step": per_step, "avg_us_per_step": avg}
+
+
+# ------------------------------------------------------- interval arithmetic
+def _merge_intervals(ivs: List[Tuple[float, float]]
+                     ) -> List[Tuple[float, float]]:
+    """Union of half-open intervals, sorted and disjoint."""
+    ivs = sorted((lo, hi) for lo, hi in ivs if hi > lo)
+    out: List[Tuple[float, float]] = []
+    for lo, hi in ivs:
+        if out and lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def _subtract_intervals(a: List[Tuple[float, float]],
+                        b: List[Tuple[float, float]]
+                        ) -> List[Tuple[float, float]]:
+    """A minus B; both inputs must be merged (sorted, disjoint)."""
+    out: List[Tuple[float, float]] = []
+    j = 0
+    for lo, hi in a:
+        cur = lo
+        while j < len(b) and b[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(b) and b[k][0] < hi:
+            blo, bhi = b[k]
+            if blo > cur:
+                out.append((cur, blo))
+            cur = max(cur, bhi)
+            if cur >= hi:
+                break
+            k += 1
+        if cur < hi:
+            out.append((cur, hi))
+    return out
+
+
+def _measure(ivs: List[Tuple[float, float]]) -> float:
+    return sum(hi - lo for lo, hi in ivs)

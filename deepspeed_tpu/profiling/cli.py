@@ -25,6 +25,7 @@ import sys
 from deepspeed_tpu.profiling.aggregate import FleetTrace
 from deepspeed_tpu.profiling.report import (load_metrics_records,
                                             render_critical_path,
+                                            render_exposed_comm,
                                             render_memory_summary,
                                             render_straggler_report)
 
@@ -56,6 +57,13 @@ def _cmd_merge(args) -> int:
         os.replace(tmp, args.output)
     rows = ft.straggler_table(top_k=args.top, align=align)
     cp = ft.critical_path(step=args.step, align=align)
+    if args.step is not None:
+        exposed = {"per_step": {}, "avg_us_per_step": None}
+        us = ft.exposed_comm_us(step=args.step, align=align)
+        if us is not None:
+            exposed = {"per_step": {args.step: us}, "avg_us_per_step": us}
+    else:
+        exposed = ft.exposed_comm_summary(align=align)
     if args.json:
         print(json.dumps({
             "ranks": sorted(ft.by_rank),
@@ -63,6 +71,8 @@ def _cmd_merge(args) -> int:
             "stragglers": [r._asdict() for r in rows],
             "rank_cost_us": ft.rank_cost_summary(align=align),
             "critical_path": cp._asdict() if cp else None,
+            "exposed_comm_us_per_step": exposed["avg_us_per_step"],
+            "exposed_comm_us_by_step": exposed["per_step"],
             "output": args.output,
         }, indent=2, default=str))
         return 0
@@ -80,6 +90,8 @@ def _cmd_merge(args) -> int:
                                   top_k=args.top))
     print()
     print(render_critical_path(cp))
+    print()
+    print(render_exposed_comm(exposed))
     return 0
 
 

@@ -88,6 +88,20 @@ def render_straggler_report(rows, rank_cost: Dict[int, float],
     return "\n".join(out)
 
 
+def render_exposed_comm(summary: Optional[dict]) -> str:
+    """The exposed-communication line: comm time not overlapped by compute,
+    averaged per step (the before/after metric for overlap work)."""
+    if not summary or not summary.get("per_step"):
+        return ("exposed_comm_us_per_step: n/a (no comm spans matched to a "
+                "step window)")
+    per_step = summary["per_step"]
+    avg = summary["avg_us_per_step"]
+    worst_step = max(per_step, key=per_step.get)
+    return (f"exposed_comm_us_per_step: {avg:.0f} "
+            f"(avg over {len(per_step)} step(s); worst step {worst_step}: "
+            f"{format_us(per_step[worst_step])})")
+
+
 def render_critical_path(cp) -> str:
     """One step's longest dependency chain, segment by segment."""
     if cp is None:

@@ -108,7 +108,8 @@ RAW_BLOCK_KEYS = {
         "results_dir", "exps_dir", "fast", "mbs_list", "zero_stage_list",
         "remat_list", "gas_list", "tp_list", "offload_list",
         "offload_overlap_list", "flash_block_list", "heads_list",
-        "hbm_prune_fraction"}),
+        "hbm_prune_fraction", "exact_memory_check", "exact_memory_fraction",
+        "assume_hbm_bytes", "ledger_path"}),
     "data_efficiency": frozenset({"enabled", "seed", "data_sampling",
                                   "data_routing"}),
     "data_efficiency.data_sampling": frozenset({
@@ -484,6 +485,24 @@ class ProfilingConfig(DeepSpeedConfigModel):
     leak_min_growth_bytes: int = Field(1 << 20, ge=0, description="ignore total growth below this across the window (steady-state jitter)")
 
 
+class PerfConfig(DeepSpeedConfigModel):
+    """Perf ledger (deepspeed_tpu/perf/): structured, attributed benchmark
+    records. With the block present the engine exposes ``perf_record()``,
+    which appends one JSONL entry per headline number — separate
+    model/config/env/seed/git_rev fields, the PR 3 config/code fingerprint
+    as the comparison key, per-step samples for ``ds_perf diff``'s noise
+    bounds, and attribution from the live telemetry session (span
+    p50/p99, memory-census buckets, flops, exposed-comm µs/step).
+    ``bench.py`` drives it for every ladder line; ``bin/ds_perf``
+    diffs/gates the resulting ledgers. STRICT no-op when the block is
+    absent: the perf package is never imported and the engine records
+    nothing (same contract as ``analysis`` / ``profiling``). See
+    docs/BENCH.md for the ledger schema and gate semantics."""
+    enabled: bool = Field(True, description="arm the perf recorder (the block being present opts in; set false to keep the block but skip the work)")
+    ledger_path: str = Field("", description="append each perf_record() entry to this JSONL ledger (process 0 only); empty = entries are returned to the caller but not persisted")
+    attribution: bool = Field(True, description="embed the telemetry/profiling attribution (span p50/p99, memory census, flops, exposed comm) in each entry; false = headline + identity fields only")
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Verified checkpoints + recovery policy (resilience/ package). See
     docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
@@ -538,6 +557,9 @@ class DeepSpeedConfig:
         # profiler is a STRICT no-op (module never imported) without it
         self.profiling = ProfilingConfig(**pd.get("profiling", {}))
         self.profiling_present = "profiling" in pd
+        # presence matters, same contract again: no block, no perf package
+        self.perf = PerfConfig(**pd.get("perf", {}))
+        self.perf_present = "perf" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -605,7 +627,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "profiling", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "perf", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

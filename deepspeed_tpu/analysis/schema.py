@@ -52,6 +52,7 @@ def _block_models() -> Dict[str, type]:
         "resilience": C.ResilienceConfig, "watchdog": C.WatchdogConfig,
         "telemetry": C.TelemetryConfig, "analysis": C.AnalysisConfig,
         "profiling": C.ProfilingConfig, "perf": C.PerfConfig,
+        "serving": C.ServingConfig,
         "compression_training": CompressionConfig,
     }
 
@@ -174,6 +175,43 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "step tracer, but telemetry.trace is false — there are no "
                 "spans to hook",
                 "profiling.span_memory vs telemetry.trace")
+    srv = cfg.serving
+    if "serving" in pd and srv.enabled:
+        if not tel.enabled:
+            add("warning",
+                "serving is enabled without telemetry: the serving/* SLO "
+                "series (admitted/shed/timed-out counters, queue depth, "
+                "TTFT-vs-deadline) go to the no-op registry and ds_serve "
+                "status / ds_metrics --serving will be blind — requests "
+                "still terminate deterministically, you just cannot prove "
+                "it from the logs",
+                "serving.enabled vs telemetry.enabled")
+        if wd.enabled and srv.decode_tick_timeout_s > wd.min_step_timeout:
+            add("warning",
+                f"serving.decode_tick_timeout_s ({srv.decode_tick_timeout_s:g}s) "
+                f"exceeds the watchdog floor watchdog.min_step_timeout "
+                f"({wd.min_step_timeout:g}s): a hung decode tick would trip "
+                "the ENGINE watchdog (whole-process abort/restart) before "
+                "the per-request timeout can resolve it cleanly — keep the "
+                "tick deadline at or below the watchdog floor",
+                "serving.decode_tick_timeout_s vs watchdog.min_step_timeout")
+        if srv.max_queue_depth > 0 and srv.hbm_bytes > 0:
+            add("warning",
+                f"serving.max_queue_depth ({srv.max_queue_depth}) overrides "
+                "the memory-census KV-budget sizing, but serving.hbm_bytes "
+                "is also set: if the explicit bound admits more KV cache "
+                "than the budget holds, requests OOM instead of shedding — "
+                "drop max_queue_depth (let the budget size admission) or "
+                "drop hbm_bytes",
+                "serving.max_queue_depth vs serving.hbm_bytes")
+        if srv.default_deadline_s < srv.decode_tick_timeout_s:
+            add("info",
+                f"serving.default_deadline_s ({srv.default_deadline_s:g}s) is "
+                f"below decode_tick_timeout_s ({srv.decode_tick_timeout_s:g}s): "
+                "a request's whole budget fits inside one tick, so deadline "
+                "misses are detected at tick granularity — expected for "
+                "latency-tight SLOs, just know the detection latency",
+                "serving.default_deadline_s vs serving.decode_tick_timeout_s")
     perf = cfg.perf
     if "perf" in pd and perf.enabled and perf.attribution \
             and not (tel.enabled and tel.trace):

@@ -122,6 +122,14 @@ class DSElasticAgent:
         """Programmatic preemption (tests / external watchers)."""
         self._preempted = True
 
+    @property
+    def preempted(self) -> bool:
+        """The LOCAL preemption flag (host-granular, not yet max-reduced
+        across the mesh). The serving front-end polls this each worker
+        iteration to begin a graceful drain the moment SIGTERM lands,
+        without waiting for a step boundary."""
+        return self._preempted
+
     def _preempt_sync(self, step: int) -> bool:
         """Cross-host preemption coordination: GCE delivers the notice to ONE
         host of a pod slice, but the orbax checkpoint (and a coherent stop

@@ -77,6 +77,27 @@ def build_generate_fn(module, max_new_tokens: int, do_sample: bool,
     return gen
 
 
+def _decode_scan_step(module, params, do_sample: bool, temperature: float,
+                      top_k: int, top_p: float, eos: int):
+    """One token of the decode loop (sample → mask finished rows → one
+    ``module.decode_step``) as a ``lax.scan`` body. The SINGLE source of the
+    per-token logic, shared by the fused/observed generate paths and the
+    serving front-end's chunked decode (serving/frontend.py) — the three
+    consumers cannot diverge numerically."""
+
+    def step(carry, _):
+        logits, cache, done, rng = carry
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits, sub, temperature, top_k, top_p,
+                      greedy=not do_sample)
+        nxt = jnp.where(done, jnp.int32(max(eos, 0)), nxt)
+        done = done | (nxt == eos)
+        logits, cache = module.decode_step(params, nxt, cache)
+        return (logits, cache, done, rng), nxt
+
+    return step
+
+
 def build_generate_parts(module, max_new_tokens: int, do_sample: bool,
                          temperature: float, top_k: int, top_p: float,
                          eos_token_id: Optional[int], param_transform=None):
@@ -104,23 +125,54 @@ def build_generate_parts(module, max_new_tokens: int, do_sample: bool,
         if param_transform is not None:
             params = param_transform(params)
         B = ids.shape[0]
-
-        def step(carry, _):
-            logits, cache, done, rng = carry
-            rng, sub = jax.random.split(rng)
-            nxt = _sample(logits, sub, temperature, top_k, top_p,
-                          greedy=not do_sample)
-            nxt = jnp.where(done, jnp.int32(max(eos, 0)), nxt)
-            done = done | (nxt == eos)
-            logits, cache = module.decode_step(params, nxt, cache)
-            return (logits, cache, done, rng), nxt
-
+        step = _decode_scan_step(module, params, do_sample, temperature,
+                                 top_k, top_p, eos)
         done0 = jnp.zeros((B,), jnp.bool_)
         _, toks = jax.lax.scan(step, (logits, cache, done0, rng),
                                None, length=max_new_tokens)
         return jnp.concatenate([ids, toks.T.astype(ids.dtype)], axis=1)
 
     return prefill, decode
+
+
+def build_serving_programs(module, max_total_len: int, chunk_tokens: int,
+                           do_sample: bool, temperature: float, top_k: int,
+                           top_p: float, eos_token_id: Optional[int],
+                           param_transform=None):
+    """``(prefill, decode_chunk)`` for the serving front-end's tick loop
+    (serving/frontend.py): the cache is sized once at ``max_total_len`` and
+    decode advances ``chunk_tokens`` per call, returning the full carry so
+    the HOST can check deadlines / cancellation / drain between chunks —
+    the price of interruptibility is one dispatch gap per chunk instead of
+    one per request. Per-token logic is :func:`_decode_scan_step`, the same
+    scan body ``generate()`` compiles, so a request served through the
+    front-end emits exactly the tokens ``generate()`` would."""
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    def prefill(params, ids):
+        if param_transform is not None:
+            params = param_transform(params)
+        B, _ = ids.shape
+        cache = module.init_cache(B, max_total_len)
+        if hasattr(module, "cache_partition_specs"):
+            cache = jax.lax.with_sharding_constraint(
+                cache, module.cache_partition_specs())
+        logits, cache = module.prefill(params, ids, cache)
+        done = jnp.zeros((B,), jnp.bool_)
+        return logits, cache, done
+
+    def decode_chunk(params, logits, cache, done, rng):
+        if param_transform is not None:
+            params = param_transform(params)
+        step = _decode_scan_step(module, params, do_sample, temperature,
+                                 top_k, top_p, eos)
+        (logits, cache, done, rng), toks = jax.lax.scan(
+            step, (logits, cache, done, rng), None, length=chunk_tokens)
+        # (B, chunk) int32 — rows past their EOS hold the EOS token, same
+        # post-EOS convention as generate()
+        return logits, cache, done, rng, toks.T
+
+    return prefill, decode_chunk
 
 
 class InferenceEngine:

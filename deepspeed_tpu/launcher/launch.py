@@ -38,6 +38,12 @@ from deepspeed_tpu.utils.logging import logger
 # exit code for a supervisor kill (distinct from any child exit so restart
 # policy can tell "wedged, killed by us" from "crashed on its own")
 HEARTBEAT_KILL_EXIT_CODE = 86
+# exit code a serving child (bin/ds_serve / ServingFrontEnd) uses for a
+# GRACEFUL drain after SIGTERM/preemption: admission stopped, in-flight
+# requests finished or deadline-capped, partials flushed. Distinct from 86
+# (wedged, killed by us) and from 0 (work complete) so a supervision loop
+# can reschedule the drained server without treating it as a crash.
+DRAIN_EXIT_CODE = 87
 
 
 def parse_args(args=None):
@@ -197,6 +203,11 @@ def main(args=None):
                              poll_interval=args.poll_interval)
     if reason != "exited":
         logger.error(f"launcher: child terminated by supervisor ({reason})")
+    elif code == DRAIN_EXIT_CODE:
+        # not a crash: the serving child drained cleanly after SIGTERM/
+        # preemption — restart policy should reschedule, not back off
+        logger.info("launcher: child exited via graceful drain "
+                    f"(exit {DRAIN_EXIT_CODE})")
     sys.exit(code)
 
 

@@ -180,3 +180,109 @@ def render_memory_summary(records: List[dict],
     else:
         out.append("leak suspects: none")
     return "\n".join(out)
+
+
+# ------------------------------------------------------------ serving summary
+# mirror of serving/frontend.py ServerState.CODES — this module is pure
+# stdlib and must not import the serving package (jax) to render a log
+SERVING_STATE_NAMES = {0: "starting", 1: "ready", 2: "degraded",
+                       3: "draining", 4: "dead"}
+
+
+def render_serving_summary(records: List[dict],
+                           source: Optional[str] = None,
+                           status: Optional[dict] = None) -> str:
+    """The operator SLO view of a serving run, from the ``serving/*``
+    registry series (last snapshot per series) plus the optional
+    ``serving_status.json`` payload ``ds_serve status`` passes in:
+    health state + queue, the request-lifecycle ledger (admitted must
+    equal the sum of terminal outcomes — the no-silent-drops invariant,
+    visible from the JSONL alone), latency percentiles vs deadline, and
+    the circuit-breaker transition history."""
+    counters, hists, gauges = {}, {}, {}
+    for rec in records:
+        name = rec.get("name", "")
+        if not name.startswith("serving/"):
+            continue
+        short = name[len("serving/"):]
+        labels = rec.get("labels") or {}
+        key = short if not labels else \
+            short + "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+        if rec.get("kind") == "histogram":
+            hists[short] = rec
+        elif rec.get("kind") == "gauge":
+            gauges[short] = rec.get("value")
+        else:
+            counters[key] = rec.get("value", 0)
+    if not (counters or hists or gauges) and status is None:
+        return ("no serving/* series found"
+                + (f" in {source}" if source else "")
+                + " — enable the ds_config `serving` + `telemetry` blocks")
+    out = ["serving summary" + (f": {source}" if source else "")]
+
+    state = None
+    if status is not None:
+        state = status.get("state")
+    elif "state" in gauges:
+        state = SERVING_STATE_NAMES.get(int(gauges["state"]), "?")
+    line = f"state: {state or '?'}"
+    if "capacity" in gauges:
+        line += f"  capacity: {int(gauges['capacity'])}"
+    if "queue_depth" in gauges:
+        line += f"  queue_depth: {int(gauges['queue_depth'])}"
+    if status is not None and status.get("breaker"):
+        line += f"  breaker: {status['breaker']}"
+    out.append(line)
+
+    lifecycle = [(k, v) for k, v in sorted(counters.items())
+                 if not k.startswith(("circuit_transitions",
+                                      "state_transitions",
+                                      "tokens_streamed"))]
+    if lifecycle:
+        out.append("")
+        out.append("request lifecycle:")
+        table = [("outcome", "count")]
+        for k, v in lifecycle:
+            table.append((k, f"{int(v)}"))
+        out.append("\n".join("  " + ln for ln in _table(table).splitlines()))
+        admitted = counters.get("admitted", 0)
+        if admitted:
+            terminal = sum(v for k, v in counters.items()
+                           if k in ("completed", "timed_out", "drained",
+                                    "failed")
+                           or k.startswith("shed_admitted{"))
+            live = int(gauges.get("queue_depth", 0))   # queued + in flight
+            tick = ("OK" if int(terminal) + live == int(admitted)
+                    else "MISMATCH — an admitted request is unaccounted for")
+            out.append(f"  (no-silent-drops ledger: admitted {int(admitted)} "
+                       f"== completed+timed_out+drained+failed+shed_admitted "
+                       f"[{int(terminal)}] + still-live [{live}] … {tick}; "
+                       "at-the-door shed{…} refusals sit outside the "
+                       "admitted ledger)")
+        if "tokens_streamed" in counters:
+            out.append(f"  tokens streamed: {int(counters['tokens_streamed'])}")
+
+    if hists:
+        out.append("")
+        out.append("latency (s unless noted):")
+        table = [("series", "count", "p50", "p90", "p99", "max")]
+        for short in ("ttft_seconds", "request_seconds", "queue_wait_seconds",
+                      "ttft_deadline_fraction", "tokens_per_request"):
+            rec = hists.get(short)
+            if rec is None:
+                continue
+            fmt = lambda v: "-" if v is None else f"{v:.4g}"
+            table.append((short, f"{int(rec.get('count', 0))}",
+                          fmt(rec.get("p50")), fmt(rec.get("p90")),
+                          fmt(rec.get("p99")), fmt(rec.get("max"))))
+        if len(table) > 1:
+            out.append("\n".join("  " + ln for ln in _table(table).splitlines()))
+
+    trans = [(k, v) for k, v in sorted(counters.items())
+             if k.startswith("circuit_transitions")]
+    if trans:
+        out.append("")
+        out.append("circuit breaker transitions:")
+        for k, v in trans:
+            out.append(f"  {k[len('circuit_transitions'):]:<28} {int(v)}x")
+    return "\n".join(out)

@@ -373,7 +373,7 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
     max_delay_s: float = Field(0.02, ge=0.0, description="upper bound of an injected delay (s)")
     hang_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-op probability of an injected interruptible HANG (watchdog detection drills)")
     hang_s: float = Field(3600.0, ge=0.0, description="duration of an injected hang (s); the watchdog is expected to fire well before it ends")
-    ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/train_step); empty = all")
+    ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/train_step/decode_step); empty = all")
     collective_mismatch: bool = Field(False, description="perturb this rank's ds_doctor-recorded collective sequence (swap/mutate/phantom, seed-deterministic) so the static deadlock detector has a reproducible divergent rank to catch")
     collective_mismatch_rank: int = Field(-1, ge=-1, description="process whose recorded sequence is perturbed (-1 = every recording process)")
 
@@ -503,6 +503,39 @@ class PerfConfig(DeepSpeedConfigModel):
     attribution: bool = Field(True, description="embed the telemetry/profiling attribution (span p50/p99, memory census, flops, exposed comm) in each entry; false = headline + identity fields only")
 
 
+class ServingConfig(DeepSpeedConfigModel):
+    """Fault-tolerant serving front-end (deepspeed_tpu/serving/ +
+    ``bin/ds_serve``): a request-lifecycle manager around the inference
+    engine. Bounded admission queue (sized from the KV-cache HBM budget
+    unless ``max_queue_depth`` pins it), structured load shedding
+    (``ShedError`` carrying queue depth + estimated wait), per-request
+    deadlines enforced at admission and every decode tick via the
+    watchdog's ``run_with_deadline`` (a hung device step becomes a clean
+    per-request timeout, not a wedged server), a circuit breaker around
+    the engine (K consecutive tick failures → open, probe half-opens),
+    and graceful drain on SIGTERM/preemption (admission stops, in-flight
+    decodes finish or deadline-cap, partials flush, the process exits
+    with launcher-recognizable code 87). Health state machine
+    starting/ready/degraded/draining/dead exported as ``serving/*``
+    telemetry and a ``ds_serve status`` view. STRICT no-op when the block
+    is absent: the serving package is never imported and zero threads
+    start (same contract as ``analysis``/``profiling``/``perf``). See
+    docs/CONFIG.md 'serving' section for the state-machine table."""
+    enabled: bool = Field(True, description="arm the serving front-end (the block being present opts in; set false to keep the block but refuse to serve)")
+    max_queue_depth: int = Field(0, ge=0, description="hard bound on admitted requests (queued + in flight); 0 = size it from the KV-cache HBM budget (kv_budget_fraction × free HBM ÷ per-request KV bytes)")
+    kv_budget_fraction: float = Field(0.6, gt=0.0, le=1.0, description="fraction of post-params HBM granted to request KV caches when sizing the admission bound")
+    hbm_bytes: int = Field(0, ge=0, description="device HBM to budget against; 0 = probe the device (memory_stats), falling back to 16 GiB when the backend reports none (CPU)")
+    default_deadline_s: float = Field(30.0, gt=0.0, description="per-request deadline when the request carries none; enforced at admission (estimated TTFT must fit) and at every decode tick")
+    decode_tick_tokens: int = Field(16, gt=0, description="tokens decoded per tick — the cancellation/deadline granularity; smaller = faster aborts, more dispatch gaps")
+    decode_tick_timeout_s: float = Field(10.0, gt=0.0, description="hard deadline per warm decode tick (run_with_deadline); a tick exceeding it resolves the request as a partial timeout — keep it at or below watchdog.min_step_timeout so the per-request timeout fires before the engine watchdog")
+    startup_tick_timeout_s: float = Field(300.0, gt=0.0, description="tick deadline before a program shape has run (first prefill/decode compiles)")
+    breaker_threshold: int = Field(3, ge=1, description="consecutive tick failures that open the circuit (readiness → degraded, queued requests shed with retry-after)")
+    breaker_cooldown_s: float = Field(5.0, gt=0.0, description="open-circuit hold before a probe request may half-open it")
+    drain_grace_s: float = Field(10.0, ge=0.0, description="extra budget an in-flight request gets to finish during drain before it is deadline-capped to a partial")
+    shed_retry_after_s: float = Field(1.0, ge=0.0, description="retry-after hint carried by queue-full ShedErrors (circuit-open sheds carry the remaining cooldown instead)")
+    max_program_variants: int = Field(8, ge=1, description="distinct (do_sample, temperature, top_k, top_p, eos) combinations the server will compile programs for; a request needing a new combination past the bound sheds with reason sampling_variant_limit — client-controlled floats must not grow compiled-program memory or serialize the worker on endless compiles")
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Verified checkpoints + recovery policy (resilience/ package). See
     docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
@@ -560,6 +593,10 @@ class DeepSpeedConfig:
         # presence matters, same contract again: no block, no perf package
         self.perf = PerfConfig(**pd.get("perf", {}))
         self.perf_present = "perf" in pd
+        # presence matters, same contract again: no block, no serving
+        # package (never imported, zero threads)
+        self.serving = ServingConfig(**pd.get("serving", {}))
+        self.serving_present = "serving" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -627,7 +664,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "profiling", "perf", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "perf", "serving", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

@@ -1,0 +1,48 @@
+"""Fault-tolerant serving front-end over the inference engine.
+
+"Heavy traffic from millions of users" is a lifecycle problem before it is
+a throughput problem: a bare ``InferenceEngine.generate()`` loop has no
+story for what happens when the queue grows past memory, a decode step
+fails or hangs, or the host gets a preemption SIGTERM mid-stream. This
+package is the robustness layer — the contract is that **every admitted
+request terminates deterministically**: with tokens, with a partial + a
+reason, or with a structured shed. Nothing is silently dropped and the
+process never wedges.
+
+* :class:`~deepspeed_tpu.serving.frontend.ServingFrontEnd` — the request
+  lifecycle manager: bounded admission queue sized from the KV-cache HBM
+  budget, per-request deadlines enforced at admission and at every decode
+  tick (the watchdog's ``run_with_deadline`` turns a hung device step into
+  a clean per-request timeout), a circuit breaker around the engine, and
+  graceful drain on SIGTERM / elastic-agent preemption.
+* :class:`~deepspeed_tpu.serving.admission.Request` /
+  :class:`~deepspeed_tpu.serving.admission.ShedError` — the request object
+  clients hold and the structured rejection (queue depth, estimated wait,
+  retry-after) they receive under overload.
+* :class:`~deepspeed_tpu.serving.breaker.CircuitBreaker` — K consecutive
+  tick failures open the circuit (readiness → degraded, queued requests
+  shed with retry-after); a probe request half-opens it after the
+  cooldown.
+* ``bin/ds_serve`` — run a server over a request trace, render the health/
+  SLO status view, or ``--smoke`` the whole admit→prefill→decode→drain
+  pipeline on CPU.
+
+Enabled by the ``serving`` ds_config block. STRICT no-op when the block is
+absent: nothing in the runtime imports this package and zero threads start
+(the same contract ``analysis``/``profiling``/``perf`` carry). Failure
+paths are drillable via the chaos injector's ``decode_step`` op
+(``fail``/``hang``/``delay`` — resilience/chaos.py).
+"""
+
+from deepspeed_tpu.serving.admission import (Request, ShedError,
+                                             kv_bytes_per_request,
+                                             resolve_capacity)
+from deepspeed_tpu.serving.breaker import CircuitBreaker
+from deepspeed_tpu.serving.frontend import (DRAIN_EXIT_CODE, ServerState,
+                                            ServingFrontEnd, from_ds_config)
+
+__all__ = [
+    "Request", "ShedError", "CircuitBreaker", "ServerState",
+    "ServingFrontEnd", "from_ds_config", "resolve_capacity",
+    "kv_bytes_per_request", "DRAIN_EXIT_CODE",
+]

@@ -1,0 +1,132 @@
+"""Circuit breaker around the inference engine.
+
+When the engine starts failing every tick (a sick device, a wedged
+runtime, a poisoned cache), admitting more traffic converts one failure
+into a thundering herd of slow failures. The breaker is the standard
+three-state machine, tuned for the serving tick loop:
+
+* **closed** — normal; consecutive tick failures are counted, a success
+  resets the count.
+* **open** — ``threshold`` consecutive failures tripped it: every
+  admission is refused with a retry-after equal to the remaining
+  cooldown, and the front-end sheds what is already queued (degraded
+  readiness). Time, not traffic, moves it on.
+* **half_open** — the cooldown elapsed: exactly ONE request (the probe)
+  is admitted. Its success closes the circuit; its failure re-opens it
+  and restarts the cooldown.
+
+Every transition lands in telemetry via the ``on_transition`` callback
+(the front-end counts ``serving/circuit_transitions{from,to}``).
+Thread-safe; the clock is injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    def __init__(self, threshold: int = 3, cooldown_s: float = 5.0,
+                 on_transition: Optional[Callable[[str, str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 lock: Optional[threading.RLock] = None):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got {threshold}")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.on_transition = on_transition
+        self._clock = clock
+        # the front-end passes ITS lock so breaker state and queue state
+        # mutate under one lock — two locks here would be an ABBA deadlock
+        # between submit (front-end → breaker) and the worker's
+        # record_failure → on_transition shed (breaker → front-end)
+        self._lock = lock if lock is not None else threading.RLock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self.transitions: list = []      # (from, to, monotonic) history
+
+    # ------------------------------------------------------------------ state
+    @property
+    def state(self) -> str:
+        """Current state; lazily moves open → half_open once the cooldown
+        has elapsed (time is the only thing that can)."""
+        with self._lock:
+            if self._state == OPEN and \
+                    self._clock() - self._opened_at >= self.cooldown_s:
+                self._transition(HALF_OPEN)
+            return self._state
+
+    def _transition(self, to: str) -> None:
+        frm = self._state
+        if frm == to:
+            return
+        self._state = to
+        if to == OPEN:
+            self._opened_at = self._clock()
+            self._probe_in_flight = False
+        if to == CLOSED:
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+        self.transitions.append((frm, to, self._clock()))
+        logger.warning(f"serving circuit breaker: {frm} -> {to} "
+                       f"(consecutive_failures={self._consecutive_failures})")
+        if self.on_transition is not None:
+            try:
+                self.on_transition(frm, to)
+            except Exception as e:      # telemetry garnish, never break the path
+                logger.warning(f"breaker on_transition callback failed: {e}")
+
+    # -------------------------------------------------------------- admission
+    def admits(self) -> Tuple[bool, float]:
+        """(may this request be admitted, retry-after hint). Half-open
+        admits exactly one probe at a time; open refuses with the
+        remaining cooldown."""
+        with self._lock:
+            st = self.state                      # may lazily half-open
+            if st == CLOSED:
+                return True, 0.0
+            if st == HALF_OPEN:
+                if self._probe_in_flight:
+                    return False, self.cooldown_s
+                self._probe_in_flight = True
+                return True, 0.0
+            remaining = max(0.0, self.cooldown_s -
+                            (self._clock() - self._opened_at))
+            return False, remaining
+
+    # ---------------------------------------------------------------- results
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == HALF_OPEN:
+                self._transition(CLOSED)
+            self._probe_in_flight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN:
+                self._transition(OPEN)           # the probe failed
+            elif self._state == CLOSED and \
+                    self._consecutive_failures >= self.threshold:
+                self._transition(OPEN)
+            self._probe_in_flight = False
+
+    def release_probe(self) -> None:
+        """Give the half-open probe slot back WITHOUT a verdict — for a
+        probe that ended by its own deadline (queue wait, drain) before
+        any tick could succeed or fail. Without this, a deadline-expired
+        probe would leave ``_probe_in_flight`` set forever and the
+        breaker wedged in half_open, shedding every future request."""
+        with self._lock:
+            self._probe_in_flight = False

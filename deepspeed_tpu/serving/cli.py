@@ -1,0 +1,240 @@
+"""ds_serve — run, drill, and inspect the fault-tolerant serving front-end.
+
+Subcommands (see ``bin/ds_serve``):
+
+* ``serve --model PRESET --trace FILE.jsonl [--config ds.json]`` — serve a
+  request trace (one JSON object per line: ``{"id", "prompt"|[ids] or
+  "prompt_len", "max_new_tokens", "deadline_s", "arrival_s"}``) through a
+  front-end with SIGTERM/SIGINT drain handlers installed; prints one
+  resolution JSON line per request; exits 87 (DRAIN_EXIT_CODE) on a
+  signal drain, 0 on trace exhaustion.
+* ``--smoke [--output_dir DIR]`` — CPU dry-run of the WHOLE pipeline
+  (admit → prefill → chunked decode → structured shed → drain) on a tiny
+  GPT-2 fixture with a synthetic trace; emits ``serving/*`` telemetry
+  that ``ds_metrics --serving`` renders, prints one JSON summary line.
+  Tier-1 runs this (tests/unit/test_serving.py), so the full serving
+  path cannot rot silently.
+* ``status DIR`` — handled by ``bin/ds_serve`` with stdlib only (an
+  operator's log box has no jax): renders ``serving_status.json`` + the
+  ``serving/*`` series from ``metrics.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _force_cpu() -> None:
+    """--smoke is a CPU dry-run; force the CPU backend when jax has not
+    initialized yet (under pytest the conftest already did)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def _tiny_engine(max_out_tokens: int = 64):
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=256, n_positions=128, n_embd=64,
+                     n_layer=2, n_head=4)
+    return InferenceEngine(
+        GPT2Model(cfg),
+        DeepSpeedInferenceConfig(dtype="float32",
+                                 max_out_tokens=max_out_tokens))
+
+
+def _preset_engine(preset: str, max_out_tokens: int, dtype: str):
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models.registry import resolve_family
+
+    model_cls, _make_batch, presets = resolve_family(preset)
+    if preset not in presets:
+        raise SystemExit(f"ds_serve: unknown model preset {preset!r}; "
+                         f"known: {sorted(presets)}")
+    return InferenceEngine(
+        model_cls(presets[preset]),
+        DeepSpeedInferenceConfig(dtype=dtype, max_out_tokens=max_out_tokens))
+
+
+def run_smoke(output_dir: Optional[str] = None) -> int:
+    """The full admit→prefill→decode→shed→drain pipeline on CPU. Exit 0
+    iff every submitted request reached a terminal status and the
+    telemetry landed."""
+    _force_cpu()
+    import tempfile
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.serving import ShedError, from_ds_config
+
+    out = output_dir or tempfile.mkdtemp(prefix="ds_serve_smoke_")
+    ds_cfg = DeepSpeedConfig({
+        "serving": {"max_queue_depth": 2, "decode_tick_tokens": 4,
+                    "decode_tick_timeout_s": 30.0, "breaker_threshold": 2,
+                    "breaker_cooldown_s": 0.5, "drain_grace_s": 10.0},
+        "telemetry": {"enabled": True, "output_dir": out,
+                      "flush_interval": 10_000, "trace": False},
+    })
+    engine = _tiny_engine(max_out_tokens=64)
+    fe = from_ds_config(engine, ds_cfg, start=False, status_dir=out)
+    terminal, shed_at_admission = [], 0
+    try:
+        # fill the bounded queue while the worker is down...
+        r1 = fe.submit(np.arange(8)[None, :] % 256, max_new_tokens=8,
+                       request_id="smoke-1")
+        r2 = fe.submit(np.arange(8, 16)[None, :] % 256, max_new_tokens=8,
+                       request_id="smoke-2")
+        # ...the third must shed with a structured queue-full error
+        try:
+            fe.submit(np.arange(4)[None, :], max_new_tokens=4,
+                      request_id="smoke-3")
+        except ShedError as e:
+            shed_at_admission += 1
+            assert e.reason == "queue_full", e.reason
+        fe.start()
+        terminal.append(r1.result(timeout=300))
+        terminal.append(r2.result(timeout=300))
+        # a hopeless deadline must terminate deterministically too
+        # (shed at admission on the service estimate, or a deadline
+        # resolution at the first tick — never a silent drop)
+        try:
+            r4 = fe.submit(np.arange(4)[None, :], max_new_tokens=4,
+                           deadline_s=1e-4, request_id="smoke-4")
+            terminal.append(r4.result(timeout=300))
+        except ShedError:
+            shed_at_admission += 1
+        fe.begin_drain("smoke")
+        code = fe.drain(timeout=60)
+    finally:
+        fe.close()
+        telemetry.flush()
+        telemetry.deconfigure()
+    ok = (all(r.done for r in terminal)
+          and terminal[0].status == "completed"
+          and terminal[1].status == "completed"
+          and len(terminal[0].tokens) == 8
+          and fe.state == "dead" and code == 0)
+    summary = {"smoke": "ok" if ok else "FAILED",
+               "telemetry_dir": out,
+               "resolved": [r.to_dict() for r in terminal],
+               "shed_at_admission": shed_at_admission,
+               "capacity": fe.capacity,
+               "counts": dict(fe.counts)}
+    print(json.dumps(summary))
+    return 0 if ok else 1
+
+
+def _load_trace(path: str):
+    with open(path) as f:
+        for n, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                raise SystemExit(f"ds_serve: malformed trace line {n} in {path}")
+
+
+def run_serve(args) -> int:
+    _force_cpu() if args.cpu else None
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.serving import ShedError, from_ds_config
+
+    ds_cfg = DeepSpeedConfig(args.config if args.config else {"serving": {}})
+    if not ds_cfg.serving_present:
+        raise SystemExit("ds_serve: the ds_config has no 'serving' block — "
+                         "add one (docs/CONFIG.md 'serving' section)")
+    engine = _preset_engine(args.model, args.max_out_tokens, args.dtype)
+    fe = from_ds_config(engine, ds_cfg, start=True)
+    if fe is None:
+        raise SystemExit("ds_serve: the ds_config sets serving.enabled=false "
+                         "— flip it on (or drop the key) to serve")
+    fe.install_signal_handlers()
+    t0 = time.monotonic()
+    pending = []
+    rng = np.random.default_rng(0)
+    for spec in _load_trace(args.trace):
+        arrival = float(spec.get("arrival_s", 0.0))
+        lag = t0 + arrival - time.monotonic()
+        if lag > 0:
+            time.sleep(lag)
+        prompt = spec.get("prompt")
+        if prompt is None:
+            n = int(spec.get("prompt_len", 8))
+            prompt = rng.integers(0, 255, size=(1, n)).tolist()
+        try:
+            req = fe.submit(np.asarray(prompt, np.int32),
+                            max_new_tokens=int(spec.get("max_new_tokens", 32)),
+                            deadline_s=spec.get("deadline_s"),
+                            request_id=spec.get("id"))
+            pending.append(req)
+        except ShedError as e:
+            print(json.dumps({"id": spec.get("id"), "status": "shed",
+                              "reason": e.reason, "queue_depth": e.queue_depth,
+                              "est_wait_s": e.est_wait_s,
+                              "retry_after_s": e.retry_after_s}))
+        if fe.state in ("draining", "dead"):
+            break
+    for req in pending:
+        try:
+            req.result(timeout=args.request_timeout)
+        except TimeoutError:
+            pass
+    if fe.state not in ("draining", "dead"):
+        fe.begin_drain("trace-complete")
+    code = fe.drain(timeout=args.request_timeout)
+    # print resolutions AFTER the drain: it resolves everything still in
+    # flight, so the one-line-per-request output carries terminal
+    # statuses — anything genuinely unresolved (a tick wedged past every
+    # deadline) is labeled, never passed off as a final state
+    for req in pending:
+        d = req.to_dict()
+        if not req.done:
+            d["status"] = "unresolved_at_exit"
+        print(json.dumps(d))
+    telemetry.flush()
+    return code
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ds_serve", description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--smoke", action="store_true",
+                   help="CPU dry-run of the full serving pipeline")
+    p.add_argument("--output_dir", default=None,
+                   help="telemetry/status dir for --smoke")
+    sub = p.add_subparsers(dest="command")
+    sv = sub.add_parser("serve", help="serve a request trace")
+    sv.add_argument("--trace", required=True, help="request trace JSONL")
+    sv.add_argument("--config", default=None, help="ds_config.json with a 'serving' block")
+    sv.add_argument("--model", default="gpt2-tiny", help="model preset (models/registry)")
+    sv.add_argument("--dtype", default="bfloat16")
+    sv.add_argument("--max_out_tokens", type=int, default=1024)
+    sv.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    sv.add_argument("--request_timeout", type=float, default=600.0,
+                    help="client-side wait per pending request at trace end")
+    args = p.parse_args(argv)
+    if args.smoke:
+        return run_smoke(args.output_dir)
+    if args.command == "serve":
+        return run_serve(args)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

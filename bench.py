@@ -403,6 +403,11 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
             "flush_interval": 1_000_000}
         ds_config["profiling"] = {"sample_interval": 1_000_000}
         ds_config["perf"] = {"ledger_path": LEDGER}
+        # per-step goodput/badput ledger: every ledger entry carries the
+        # breakdown (compute / compile / exposed comm / data wait / ...)
+        # of its own timed window, and ds_perf gate gates the resulting
+        # goodput_fraction alongside the headline
+        ds_config["goodput"] = {}
 
     model = model_cls(config)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
@@ -462,6 +467,19 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
             from deepspeed_tpu import telemetry as _tel
 
             _tel.flush()
+            gp = (line.get("attribution") or {}).get("goodput") or {}
+            if gp.get("goodput_fraction") is not None:
+                total = sum(gp.get("buckets_us", {}).values()) or 1.0
+                top = max(((b, v) for b, v in gp["buckets_us"].items()
+                           if b != "compute"), key=lambda kv: kv[1],
+                          default=None)
+                note = (f"# goodput: {100.0 * gp['goodput_fraction']:.1f}% "
+                        f"compute over {len(gp.get('per_step', []))} timed "
+                        "step(s)")
+                if top is not None:
+                    note += (f"; top badput: {top[0]} "
+                             f"{100.0 * top[1] / total:.1f}%")
+                print(note, file=sys.stderr)
         except Exception as e:
             print(f"# perf record failed: {e}", file=sys.stderr)
 

@@ -52,7 +52,7 @@ def _block_models() -> Dict[str, type]:
         "resilience": C.ResilienceConfig, "watchdog": C.WatchdogConfig,
         "telemetry": C.TelemetryConfig, "analysis": C.AnalysisConfig,
         "profiling": C.ProfilingConfig, "perf": C.PerfConfig,
-        "serving": C.ServingConfig,
+        "serving": C.ServingConfig, "goodput": C.GoodputConfig,
         "compression_training": CompressionConfig,
     }
 
@@ -212,6 +212,14 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "misses are detected at tick granularity — expected for "
                 "latency-tight SLOs, just know the detection latency",
                 "serving.default_deadline_s vs serving.decode_tick_timeout_s")
+    gp = cfg.goodput
+    if "goodput" in pd and gp.enabled and not (tel.enabled and tel.trace):
+        add("warning",
+            "goodput is enabled without telemetry step tracing: the ledger "
+            "classifies the tracer's spans, and with no spans every step "
+            "reads as 100% idle — enable the telemetry block (with trace: "
+            "true) for goodput/* series, ds_top and per-entry breakdowns",
+            "goodput.enabled vs telemetry.trace")
     perf = cfg.perf
     if "perf" in pd and perf.enabled and perf.attribution \
             and not (tel.enabled and tel.trace):

@@ -288,12 +288,18 @@ class DSElasticAgent:
 
                 telemetry.get_registry().counter("resilience/elastic_restarts").inc()
                 delay = self.restart_backoff.next_delay()
-                self.restart_log.append({
+                record = {
                     "restart": self.restart_count,
                     "error": f"{type(e).__name__}: {e}",
                     "step": int(self.engine.state.step) if self.engine is not None else None,
                     "backoff_s": round(delay, 3),
-                })
+                    # wall-clock stamp: ds_prof goodput matches this record
+                    # to the inter-session gap it explains (the sessions'
+                    # clock anchors put the gap on the same epoch axis)
+                    "ts": time.time(),
+                }
+                self.restart_log.append(record)
+                self._persist_restart_record(record)
                 logger.warning(f"elastic agent: step failure ({e}); "
                                f"restart {self.restart_count}/{self.max_restarts} "
                                f"after {delay:.2f}s backoff")
@@ -303,6 +309,29 @@ class DSElasticAgent:
                 resume = self._has_checkpoint()
                 self.engine = None
                 time.sleep(delay)
+
+    @staticmethod
+    def _persist_restart_record(record: dict) -> None:
+        """Append the restart record to ``restart_log.jsonl`` beside the
+        live telemetry session's metrics — the downtime annotations
+        ``ds_prof goodput`` reads. Only reached on the single-host
+        restart path (multi-host failures re-raise before accounting),
+        so no rank gate is needed. Best-effort end to end: accounting
+        must never block a restart, so even a wedged telemetry/session
+        lookup is swallowed."""
+        try:
+            import json
+
+            from deepspeed_tpu import telemetry
+
+            session = telemetry.get_session()
+            if session is None:
+                return
+            path = os.path.join(session.output_dir, "restart_log.jsonl")
+            with open(path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except Exception as e:
+            logger.warning(f"elastic agent: restart_log append failed: {e}")
 
     def _status(self, status: str, engine) -> dict:
         return {"status": status,

@@ -140,6 +140,35 @@ def kernel_report():
     return rows
 
 
+def goodput_section(args):
+    """Render the newest session trace's bucket table from a telemetry
+    output dir (or an explicit trace file)."""
+    from deepspeed_tpu.goodput.ledger import load_trace_file, session_ledger
+    from deepspeed_tpu.goodput.report import (find_session_traces,
+                                              render_session_table)
+
+    if not args:
+        print("usage: ds_report goodput <telemetry_dir | trace.json>",
+              file=sys.stderr)
+        return 2
+    paths = [p for p in find_session_traces(args) if os.path.isfile(p)]
+    if not paths:
+        print(f"ds_report goodput: no trace files under {args}",
+              file=sys.stderr)
+        return 2
+    # the newest session: rotation preserves history as trace.session<N>,
+    # so the un-suffixed trace.json (sorted last by mtime, not name) is
+    # the live one — pick by mtime to be robust to either layout
+    newest = max(paths, key=lambda p: os.path.getmtime(p))
+    trace = load_trace_file(newest)
+    led = session_ledger(trace["events"])
+    if led is None:
+        print(f"ds_report goodput: {newest} holds no spans", file=sys.stderr)
+        return 2
+    print(render_session_table(led, source=newest))
+    return 0
+
+
 def main(args=None):
     args = list(sys.argv[1:] if args is None else args)
     if args and args[0] == "doctor":
@@ -148,6 +177,11 @@ def main(args=None):
         from deepspeed_tpu.analysis.cli import doctor_section
 
         return doctor_section(args[1:])
+    if args and args[0] == "goodput":
+        # `ds_report goodput <telemetry_dir>` — the LATEST session's
+        # goodput bucket table (job-level cross-restart stitching is
+        # `ds_prof goodput`'s job)
+        return goodput_section(args[1:])
     line = "-" * 72
     print(line)
     print("deepspeed_tpu environment report")

@@ -1,0 +1,40 @@
+"""Goodput/badput accounting — where every wall-second of a job went.
+
+ROADMAP Items 1-3 all reduce to one question the raw telemetry cannot
+answer by itself: of the wall time a job burned, how much was USEFUL
+training compute vs. compile, exposed communication, data wait,
+checkpoint I/O, watchdog stalls, straggler wait, restart downtime, or
+plain idle? This package composes the existing ingredients — telemetry
+step spans (PR 2), ``ds_prof merge``'s exposed-comm extraction (PR 5/6),
+the elastic agent's ``restart_log`` (PR 1/3) — into a CLOSED time ledger:
+
+* :mod:`~deepspeed_tpu.goodput.taxonomy` — the bucket set and the
+  priority order that makes the partition disjoint (every second lands
+  in exactly one bucket, so the ledger sums to wall clock by
+  construction);
+* :mod:`~deepspeed_tpu.goodput.ledger` — per-step and per-session
+  classification of one rank's trace events into buckets;
+* :mod:`~deepspeed_tpu.goodput.report` — the job-level view: stitch
+  telemetry sessions across elastic restarts on their wall-clock
+  anchors, charge inter-session gaps to ``restart`` (annotated from
+  ``restart_log``), render the "where did my fleet-seconds go" table
+  (``ds_prof goodput DIR...`` / ``ds_report goodput DIR``);
+* :mod:`~deepspeed_tpu.goodput.recorder` — the engine-side meter
+  (``goodput`` ds_config block): per-step ``goodput/*`` registry series
+  + the attribution dict perf-ledger entries embed;
+* :mod:`~deepspeed_tpu.goodput.tail` / :mod:`~deepspeed_tpu.goodput.top`
+  — the stdlib JSONL tail-follower shared by ``ds_metrics --follow``
+  and the live ``bin/ds_top`` fleet view.
+
+Everything except :mod:`recorder` is pure stdlib — reports and ``ds_top``
+run on a laptop with no jax. STRICT no-op contract: without the
+``goodput`` ds_config block the engine never imports this package (same
+pattern as ``profiling`` / ``perf`` / ``serving``, asserted in tests).
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.goodput.taxonomy import (BADPUT_BUCKETS, BUCKETS,
+                                            GOODPUT_BUCKETS)
+
+__all__ = ["BUCKETS", "GOODPUT_BUCKETS", "BADPUT_BUCKETS"]

@@ -1,0 +1,154 @@
+"""JSONL tail-follower — the one reader ``ds_top`` and ``ds_metrics
+--follow`` share.
+
+The telemetry JSONL exporter appends one object per metric per flush; a
+live viewer needs the NEW records since its last look, across the
+realities of files on disk: the file may not exist yet (exporter not
+flushed), may be truncated (a fresh run re-using the output dir), may be
+rotated (same path, new inode), and its last line may be torn
+(mid-append read). Pure stdlib, binary-offset based (seek math must not
+care about multi-byte characters).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class JSONLTailer:
+    """Incremental reader of an append-mostly JSONL file.
+
+    ``poll()`` returns the records appended since the last poll. On
+    truncation or rotation (size shrank / inode changed) the reader
+    starts over from offset 0 — the new file IS the new truth, and the
+    caller's accumulated state should be rebuilt from what poll returns
+    (records re-delivered after a reset are the new file's content, not
+    duplicates of the old one). A torn final line is left unconsumed
+    until its newline arrives; a line that is complete but malformed is
+    counted in ``bad_lines`` and skipped.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self._sig: Optional[Tuple[int, int]] = None   # (st_dev, st_ino)
+        self.bad_lines = 0
+        self.resets = 0
+
+    def poll(self) -> List[dict]:
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            if self._sig is not None:       # file vanished: treat as rotation
+                self._sig, self._pos = None, 0
+                self.resets += 1
+            return []
+        sig = (st.st_dev, st.st_ino)
+        if self._sig is not None and (sig != self._sig
+                                      or st.st_size < self._pos):
+            self._pos = 0                   # rotated or truncated: start over
+            self.resets += 1
+        self._sig = sig
+        if st.st_size <= self._pos:
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(self._pos)
+            chunk = f.read()
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []                       # only a torn line so far
+        consumed = chunk[:end + 1]
+        self._pos += len(consumed)
+        out = []
+        for raw in consumed.splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw.decode("utf-8", errors="replace"))
+            except (ValueError, UnicodeDecodeError):
+                self.bad_lines += 1
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+            else:
+                self.bad_lines += 1
+        return out
+
+
+class MetricsFollower:
+    """Last-record-per-series view over a tailed telemetry metrics.jsonl —
+    the same (kind, name, labels) keying ``load_metrics_records`` uses,
+    kept live. A tailer reset (rotation/truncation) clears the view."""
+
+    def __init__(self, path: str):
+        self.tailer = JSONLTailer(path)
+        self._last = {}
+        self._order = []
+
+    @staticmethod
+    def _key(rec: dict):
+        try:
+            return (rec["kind"], rec["name"],
+                    tuple(sorted((rec.get("labels") or {}).items())))
+        except (KeyError, TypeError):
+            return None
+
+    def poll(self) -> bool:
+        """Absorb new records; True when anything changed — including a
+        rotation/truncation reset that delivered nothing yet (the viewer
+        must drop the dead file's numbers, not keep displaying them)."""
+        resets = self.tailer.resets
+        recs = self.tailer.poll()
+        if self.tailer.resets != resets:
+            self._last, self._order = {}, []
+        changed = bool(recs) or self.tailer.resets != resets
+        for rec in recs:
+            key = self._key(rec)
+            if key is None:
+                self.tailer.bad_lines += 1
+                continue
+            if key not in self._last:
+                self._order.append(key)
+            self._last[key] = rec
+        return changed
+
+    def records(self) -> List[dict]:
+        return [self._last[k] for k in self._order]
+
+
+def follow_loop(path: str, render: Callable[[List[dict]], str],
+                interval: float = 2.0, max_polls: Optional[int] = None,
+                out=None, clear: Optional[bool] = None,
+                on_render=None) -> int:
+    """The ONE tail loop ``ds_top`` and ``ds_metrics --follow`` share:
+    poll the follower, re-render on change (and on the first poll so an
+    empty file still shows a frame), ANSI-repaint when writing to a tty,
+    sleep between polls. ``max_polls`` bounds the loop for tests;
+    ``on_render(follower, out)`` runs after each write (viewers surface
+    the cumulative bad-line count their own way — a JSON consumer's
+    stdout must stay clean, a tty frame wants it inline)."""
+    out = sys.stdout if out is None else out
+    clear = out.isatty() if clear is None else clear
+    follower = MetricsFollower(path)
+    polls = 0
+    first = True
+    while max_polls is None or polls < max_polls:
+        changed = follower.poll()
+        if changed or first:
+            text = render(follower.records())
+            if clear:
+                out.write("\x1b[2J\x1b[H")
+            out.write(text + "\n")
+            out.flush()
+            if on_render is not None:
+                on_render(follower, out)
+            first = False
+        polls += 1
+        if max_polls is None or polls < max_polls:
+            time.sleep(interval)
+    return 0

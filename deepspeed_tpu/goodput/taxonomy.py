@@ -1,0 +1,98 @@
+"""The closed badput taxonomy: bucket names + span classification.
+
+Every wall-second of a job is assigned to exactly ONE bucket. The
+partition is made disjoint by a fixed priority order (a second covered by
+both a compile span and the enclosing ``train_batch`` span is compile,
+not compute), so per-step and job-level ledgers sum to the measured wall
+window EXACTLY by construction — "sums to wall clock" is a property of
+the math, not a hope about the instrumentation.
+
+Buckets (priority order, highest first):
+
+``watchdog_stall``  time inside a step that ended in a watchdog expiry
+                    (the stall span the watchdog stamps on firing);
+``compile``         backend compilation (the jax.monitoring
+                    compile-duration listener the goodput recorder
+                    installs stamps these; cat="compile");
+``checkpoint``      save/load spans (cat="checkpoint");
+``data_wait``       the engine's ``data`` span — host input pipeline;
+``straggler_wait``  inside a matched collective, time spent waiting for
+                    the last-arriving rank (fleet-level only: needs >= 2
+                    ranks; rank-local ledgers report 0);
+``exposed_comm``    comm spans not overlapped by compute (the same
+                    interval math as ``FleetTrace.exposed_comm_us``);
+``compute``         the remaining time covered by train-phase spans —
+                    the GOODPUT bucket;
+``restart``         downtime between telemetry sessions of one rank
+                    (elastic restart; job-level only, annotated from
+                    ``DSElasticAgent.restart_log``);
+``idle``            everything else inside the measured window.
+
+Pure stdlib — report tooling must run far from any accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+# priority order: earlier wins where spans overlap. `restart` and `idle`
+# are computed residually (gaps), never from spans, so they close the
+# partition.
+BUCKETS = ("watchdog_stall", "compile", "checkpoint", "data_wait",
+           "straggler_wait", "exposed_comm", "compute", "restart", "idle")
+
+GOODPUT_BUCKETS = ("compute",)
+BADPUT_BUCKETS = tuple(b for b in BUCKETS if b not in GOODPUT_BUCKETS)
+
+# span categories / names -> bucket (everything span-classifiable; the
+# residual buckets have no span class on purpose)
+_CAT_BUCKET = {"stall": "watchdog_stall", "compile": "compile",
+               "checkpoint": "checkpoint"}
+
+# compute evidence: host spans that mean "the step is executing device
+# work (or dispatching it)". train_batch encloses fwd/bwd/step, but the
+# classification unions intervals, so nesting never double-counts.
+COMPUTE_SPANS = ("train_batch", "fwd", "bwd", "step")
+
+
+def is_span(ev: dict) -> bool:
+    return ev.get("ph") == "X" and "dur" in ev
+
+
+def span_bucket(ev: dict) -> Optional[str]:
+    """The bucket a single span event argues for, or None when the event
+    carries no classification weight (metadata, instants, serving spans —
+    those are request-scoped, not step-scoped)."""
+    if not is_span(ev):
+        return None
+    cat = str(ev.get("cat", ""))
+    if cat in _CAT_BUCKET:
+        return _CAT_BUCKET[cat]
+    name = str(ev.get("name", ""))
+    if name == "save_checkpoint" or name == "load_checkpoint":
+        return "checkpoint"
+    if name == "data":
+        return "data_wait"
+    if name == "watchdog_stall":
+        return "watchdog_stall"
+    if name == "compile":
+        return "compile"
+    if cat == "comm":
+        return "exposed_comm"       # demoted to overlap-aware exposed time
+    if name in COMPUTE_SPANS:
+        return "compute"
+    return None
+
+
+def interval(ev: dict) -> Tuple[float, float]:
+    return (float(ev["ts"]), float(ev["ts"]) + float(ev["dur"]))
+
+
+def bucket_intervals(events: List[dict]) -> Dict[str, List[Tuple[float, float]]]:
+    """Raw (unmerged, overlapping) intervals per span-classifiable bucket."""
+    out: Dict[str, List[Tuple[float, float]]] = {}
+    for ev in events:
+        b = span_bucket(ev)
+        if b is not None:
+            out.setdefault(b, []).append(interval(ev))
+    return out

@@ -139,6 +139,18 @@ def collect(engine, session=None, timed_steps: Optional[int] = None
         exposed = exposed_comm_from_events(events, last_steps=timed_steps)
         if exposed is not None:
             att["exposed_comm_us_per_step"] = round(exposed, 1)
+    # ---- goodput: the per-step badput ledger over the timed window.
+    # Gated on the engine's meter (the `goodput` ds_config block) so the
+    # strict no-op contract holds: without the block the goodput package
+    # is never imported, with it the ledger entry carries the breakdown.
+    meter = getattr(engine, "_goodput", None)
+    if meter is not None and events:
+        try:
+            gp = meter.attribution(events, timed_steps=timed_steps)
+            if gp:
+                att["goodput"] = gp
+        except Exception as e:
+            logger.warning(f"perf attribution: goodput ledger failed: {e}")
     # ---- memory: census buckets + compiled-step accounting
     try:
         res = engine.memory_census()

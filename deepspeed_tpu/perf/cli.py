@@ -136,7 +136,8 @@ def _cmd_gate(args) -> int:
             continue
         r = led.compare(base[k], cand[k], rel_tol=args.rel_tol)
         checked.append(r)
-        if r["verdict"] == "regression" or not r["new_value"]:
+        if r["verdict"] == "regression" or not r["new_value"] \
+                or r.get("goodput_regressed"):
             failures.append(r)
     if args.json:
         print(json.dumps({"checked": checked, "missing": missing,
@@ -147,9 +148,15 @@ def _cmd_gate(args) -> int:
     else:
         for r in checked:
             ok = r not in failures
-            print(f"{'PASS' if ok else 'FAIL'} {r['series']}: "
-                  f"{_fmt_val(r['old_value'])} -> {_fmt_val(r['new_value'])} "
-                  f"({r['rel_delta']:+.1%}, tol {args.rel_tol:.0%})")
+            line = (f"{'PASS' if ok else 'FAIL'} {r['series']}: "
+                    f"{_fmt_val(r['old_value'])} -> {_fmt_val(r['new_value'])} "
+                    f"({r['rel_delta']:+.1%}, tol {args.rel_tol:.0%})")
+            if "new_goodput" in r:
+                line += (f" goodput {r['old_goodput']:.3f} -> "
+                         f"{r['new_goodput']:.3f}"
+                         + (" [REGRESSED]" if r.get("goodput_regressed")
+                            else ""))
+            print(line)
         for k in crashed:
             e = newest[k]
             print(f"FAIL {k}: newest run FAILED "

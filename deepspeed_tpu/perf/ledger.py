@@ -230,6 +230,20 @@ def t_critical(na: int, nb: int) -> float:
     return 1.96
 
 
+def _goodput_step_samples(entry: Dict[str, Any]) -> List[float]:
+    """Per-step goodput fractions out of an entry's embedded per-step
+    ledgers — the noise reservoir the goodput gate's t test runs on."""
+    steps = (((entry.get("attribution") or {}).get("goodput") or {})
+             .get("per_step")) or []
+    out = []
+    for s in steps:
+        wall = float(s.get("wall_us") or 0.0)
+        if wall > 0:
+            out.append(float((s.get("buckets_us") or {}).get("compute", 0.0))
+                       / wall)
+    return out
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             rel_tol: float = 0.05) -> Dict[str, Any]:
     """Compare two entries of one series with noise bounds.
@@ -275,6 +289,32 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         "t_stat": t, "significant": significant,
         "n_old": len(sa), "n_new": len(sb),
     }
+    # goodput_fraction rides along as a second gated metric when BOTH
+    # entries carry it (entries recorded under the `goodput` ds_config
+    # block): a headline that holds while goodput collapses means the
+    # job got its throughput by burning more wall time on badput —
+    # exactly the regression the taxonomy exists to catch. The drop is
+    # judged in ABSOLUTE fraction points against rel_tol (goodput is
+    # already a ratio; a 5% *relative* drop of a 0.2 goodput would be
+    # a 1-point blip), under the SAME noise discipline as the headline:
+    # per-step goodput fractions (from the embedded per-step ledgers)
+    # feed a t gate that may exonerate a past-tolerance drop — one
+    # stall-y step in a short window must not fail CI — with the same
+    # power floor and fingerprint-change escape hatch.
+    go, gn = old.get("goodput_fraction"), new.get("goodput_fraction")
+    if go is not None and gn is not None:
+        out["old_goodput"] = float(go)
+        out["new_goodput"] = float(gn)
+        out["goodput_delta"] = float(gn) - float(go)
+        ga = _goodput_step_samples(old)
+        gb = _goodput_step_samples(new)
+        gt = welch_t(ga, gb)
+        g_sig = None
+        if gt is not None and min(len(ga), len(gb)) >= MIN_POWER_SAMPLES:
+            g_sig = abs(gt) > t_critical(len(ga), len(gb))
+        g_exonerated = g_sig is False and not out["fingerprint_changed"]
+        out["goodput_regressed"] = (out["goodput_delta"] < -rel_tol
+                                    and not g_exonerated)
     # the t gate runs on STEP-TIME samples; when the config fingerprint
     # changed, the headline value and the step time are no longer two
     # views of one experiment (e.g. tokens/step drifted: MFU halves while

@@ -107,6 +107,12 @@ class PerfRecorder:
         if want_attribution:
             entry["attribution"] = _attribution.collect(
                 self.engine, session=session, timed_steps=timed_steps)
+            gf = (entry["attribution"].get("goodput") or {}).get(
+                "goodput_fraction")
+            if gf is not None:
+                # hoisted to the top level so ds_perf compare/gate can
+                # treat it as a first-class gated metric
+                entry["goodput_fraction"] = gf
         if extra:
             entry.update(extra)
         path = self.cfg.ledger_path

@@ -34,44 +34,77 @@ _RANK_IN_NAME = re.compile(r"rank[ _.]?(\d+)", re.IGNORECASE)
 
 
 # ------------------------------------------------------------------ loading
-def load_trace_events(path: str) -> Tuple[List[dict], Optional[int]]:
-    """Events + best-effort rank from one trace file.
+def load_trace_events(path: str, warnings: Optional[List[str]] = None,
+                      meta_out: Optional[dict] = None
+                      ) -> Tuple[List[dict], Optional[int]]:
+    """Events + best-effort rank from one trace file — THE trace parser
+    (``ds_prof merge`` and the goodput loaders all go through it, so the
+    format heuristics cannot drift between analyses).
 
     Accepts the writer's Chrome JSON (``{"traceEvents": [...]}``), a bare
     event list, or JSONL (one event object per line). Rank comes from the
     ``process_name`` metadata ("... rank N"), else the filename, else the
-    events' pid, else None (caller falls back to file order).
+    events' pid, else None (caller falls back to file order). A torn
+    JSONL tail (a run killed mid-append) is skipped LOUDLY — appended to
+    ``warnings`` when the caller passes a list — never a silent hole and
+    never fatal to the rest of the file. ``meta_out``, when given, is
+    updated with the file's ``metadata`` dict (clock anchor, dropped
+    span count) plus ``torn_lines``: the skipped-line count.
     """
     with open(path) as f:
         text = f.read()
+    bad = 0
     try:
         data = json.loads(text)
         if isinstance(data, dict):
-            # whole-file trace, or a one-event JSONL (also valid JSON)
-            events = data["traceEvents"] if "traceEvents" in data else [data]
+            if "traceEvents" in data:
+                events = data["traceEvents"]
+                if meta_out is not None:
+                    meta_out.update(data.get("metadata") or {})
+            else:
+                # a one-event JSONL (also valid JSON)
+                events = [data]
         else:
             events = data
     except json.JSONDecodeError:
         # JSONL: every line is an object, so the whole file is not valid JSON
-        events = [json.loads(line) for line in text.splitlines() if line.strip()]
-    rank = None
+        events = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                bad += 1
+        if bad and warnings is not None:
+            warnings.append(f"{path}: skipped {bad} torn/malformed JSONL "
+                            "line(s) — events after a kill mid-append are "
+                            "incomplete")
+    if meta_out is not None:
+        meta_out["torn_lines"] = bad
+    return events, rank_from_events(events, path)
+
+
+def rank_from_events(events: List[dict], path: str) -> Optional[int]:
+    """Best-effort rank of an already-parsed event list: the
+    ``process_name`` metadata ("... rank N"), else the filename, else a
+    unanimous event pid, else None. Shared with the goodput trace loader
+    so the heuristics cannot drift (and the file is not parsed twice)."""
     for ev in events:
         if ev.get("ph") == "M" and ev.get("name") == "process_name":
             m = _RANK_IN_NAME.search(str((ev.get("args") or {}).get("name", "")))
             if m:
-                rank = int(m.group(1))
-                break
-    if rank is None:
-        m = _RANK_IN_NAME.search(path.replace("\\", "/").rsplit("/", 1)[-1])
-        if m:
-            rank = int(m.group(1))
-    if rank is None:
-        pids = {ev.get("pid") for ev in events if ev.get("ph") != "M"}
-        if len(pids) == 1:
-            (only,) = pids
-            if isinstance(only, int):
-                rank = only
-    return events, rank
+                return int(m.group(1))
+    m = _RANK_IN_NAME.search(path.replace("\\", "/").rsplit("/", 1)[-1])
+    if m:
+        return int(m.group(1))
+    pids = {ev.get("pid") for ev in events if ev.get("ph") != "M"}
+    if len(pids) == 1:
+        (only,) = pids
+        if isinstance(only, int):
+            return only
+    return None
 
 
 # ----------------------------------------------------------------- matching
@@ -134,8 +167,10 @@ class FleetTrace:
 
     def __init__(self):
         self.by_rank: Dict[int, List[dict]] = {}
+        self.warnings: List[str] = []
         self._offsets: Optional[Dict[int, float]] = None
         self._aligned_cache: Optional[Dict[int, List[dict]]] = None
+        self._dup_keys: Optional[Dict[int, set]] = None
 
     @classmethod
     def from_files(cls, paths: Sequence[str]) -> "FleetTrace":
@@ -143,7 +178,9 @@ class FleetTrace:
         overlapping globs) is deduplicated; two DIFFERENT files claiming
         the same rank is an error — silently relabelling one (a stale
         trace from a previous run, usually) would let its events 'match'
-        the current run's collectives and fabricate stragglers."""
+        the current run's collectives and fabricate stragglers. An empty
+        or span-less file is SKIPPED with a warning, never turned into a
+        phantom lane; torn JSONL tails are counted in ``warnings``."""
         ft = cls()
         taken: Dict[int, str] = {}
         pending = []
@@ -153,7 +190,12 @@ class FleetTrace:
             if real in seen_paths:
                 continue
             seen_paths.add(real)
-            events, rank = load_trace_events(path)
+            events, rank = load_trace_events(path, warnings=ft.warnings)
+            if not any(ev.get("ph") != "M" for ev in events):
+                ft.warnings.append(
+                    f"{path}: empty trace (no events) — skipped; a dead "
+                    "rank leaves a hole, not a silent empty lane")
+                continue
             if rank is None:
                 pending.append(events)
             elif rank in taken:
@@ -170,12 +212,58 @@ class FleetTrace:
                 next_rank += 1
             taken[next_rank] = "<unranked input>"
             ft.by_rank[next_rank] = events
+        ranks = sorted(ft.by_rank)
+        if ranks:
+            # rank 0 always exists in a real job — start the gap scan at
+            # 0 so a dead rank 0 (trace never flushed) is warned about too
+            missing = sorted(set(range(0, ranks[-1] + 1)) - set(ranks))
+            if missing:
+                ft.warnings.append(
+                    "missing rank trace(s): "
+                    + ", ".join(str(r) for r in missing)
+                    + f" (have {ranks}) — stragglers/critical-path cover "
+                    "only the ranks present")
         return ft
 
     def add_rank(self, rank: int, events: List[dict]) -> None:
         self.by_rank[int(rank)] = list(events)
         self._offsets = None
         self._aligned_cache = None
+        self._dup_keys = None
+
+    def _duplicate_keys(self) -> Dict[int, set]:
+        """Per rank: collective identities (op, seq, group) that appear
+        MORE than once in its trace. The per-(op, group) seq counters
+        reset with each telemetry session, so a rank that went through an
+        elastic restart mid-trace re-issues the same identities — letting
+        session 2's all_reduce#0 'match' session 1's on another rank would
+        fabricate huge skews. Duplicated identities are excluded from
+        clock alignment and straggler matching, LOUDLY (warnings)."""
+        if self._dup_keys is not None:
+            return self._dup_keys
+        out: Dict[int, set] = {}
+        for rank, events in self.by_rank.items():
+            seen = set()
+            dups = set()
+            for ev in events:
+                key = _collective_key(ev)
+                if key is None or not _is_span(ev):
+                    continue
+                if key in seen:
+                    dups.add(key)
+                else:
+                    seen.add(key)
+            if dups:
+                out[rank] = dups
+                msg = (f"rank {rank}: {len(dups)} collective identities "
+                       "appear more than once in one trace — an elastic "
+                       "restart mid-trace (per-session seq counters reset); "
+                       "duplicated identities are excluded from clock "
+                       "alignment and straggler matching")
+                if msg not in self.warnings:
+                    self.warnings.append(msg)
+        self._dup_keys = out
+        return out
 
     # ------------------------------------------------------- clock alignment
     def clock_offsets(self) -> Dict[int, float]:
@@ -186,11 +274,13 @@ class FleetTrace:
         matched collectives (or a single-rank trace) get offset 0."""
         if self._offsets is not None:
             return self._offsets
+        dups = self._duplicate_keys()
         ends: Dict[Tuple[str, int, str], Dict[int, float]] = {}
         for rank, events in self.by_rank.items():
+            skip = dups.get(rank, ())
             for ev in events:
                 key = _collective_key(ev)
-                if key is not None and _is_span(ev):
+                if key is not None and _is_span(ev) and key not in skip:
                     ends.setdefault(key, {})[rank] = ev["ts"] + ev["dur"]
         deviations: Dict[int, List[float]] = {r: [] for r in self.by_rank}
         for per_rank in ends.values():
@@ -254,11 +344,13 @@ class FleetTrace:
         """Cross-rank matches of comm span events by (op, seq, group),
         ordered by sequence. Matches present on fewer than two ranks are
         dropped (nothing to skew against)."""
+        dups = self._duplicate_keys()
         table: Dict[Tuple[str, int, str], Dict[int, Tuple[float, float]]] = {}
         for rank, events in self._aligned(align).items():
+            skip = dups.get(rank, ())
             for ev in events:
                 key = _collective_key(ev)
-                if key is not None and _is_span(ev):
+                if key is not None and _is_span(ev) and key not in skip:
                     table.setdefault(key, {})[rank] = (float(ev["ts"]),
                                                       float(ev["dur"]))
         return [CollectiveMatch(op=op, seq=seq, group=group, arrivals=arr)

@@ -10,6 +10,12 @@ Subcommands:
 * ``ds_prof memory <metrics.jsonl | telemetry_dir>`` — summarize the
   ``profiling/*`` series a run's memory profiler exported (same renderer
   as ``ds_metrics --memory``).
+* ``ds_prof goodput <dir|trace>... [--restart-log F] [--json]`` — the
+  job-level goodput/badput report: classify every wall-second of every
+  session (rotated ``trace.session*`` files included) into the closed
+  taxonomy, charge inter-session gaps to restart downtime via the
+  sessions' clock anchors + ``restart_log.jsonl``, print the
+  "where did my fleet-seconds go" table.
 
 The analyses themselves (aggregate/report) are pure stdlib — no device,
 no distributed init; traces from a 256-chip run merge fine on a laptop.
@@ -34,10 +40,14 @@ def _cmd_merge(args) -> int:
     paths = []
     for p in args.traces:
         if os.path.isdir(p):
+            # rotated session traces (trace.session<N>...) are EXCLUDED:
+            # two sessions of one rank would read as two rank claims (a
+            # loud error) or, worse, phantom-match collectives across
+            # restarts. Cross-session analysis is `ds_prof goodput`'s job.
             paths.extend(sorted(
                 os.path.join(p, f) for f in os.listdir(p)
-                if f.startswith("trace") and (f.endswith(".json")
-                                              or f.endswith(".jsonl"))))
+                if f.startswith("trace") and ".session" not in f
+                and (f.endswith(".json") or f.endswith(".jsonl"))))
         else:
             paths.append(p)
     if not paths:
@@ -47,6 +57,12 @@ def _cmd_merge(args) -> int:
         ft = FleetTrace.from_files(paths)
     except ValueError as e:                   # e.g. two files claim one rank
         print(f"ds_prof merge: {e}", file=sys.stderr)
+        return 2
+    if not ft.by_rank:
+        print("ds_prof merge: no usable trace events in the given files",
+              file=sys.stderr)
+        for w in ft.warnings:
+            print(f"ds_prof merge: warning: {w}", file=sys.stderr)
         return 2
     align = not args.no_align
     merged = ft.to_chrome_trace(align=align)
@@ -64,6 +80,9 @@ def _cmd_merge(args) -> int:
             exposed = {"per_step": {args.step: us}, "avg_us_per_step": us}
     else:
         exposed = ft.exposed_comm_summary(align=align)
+    # straggler/alignment analyses run above; collect their degradation
+    # warnings too (duplicate collective identities are detected lazily)
+    warnings = list(ft.warnings)
     if args.json:
         print(json.dumps({
             "ranks": sorted(ft.by_rank),
@@ -73,8 +92,11 @@ def _cmd_merge(args) -> int:
             "critical_path": cp._asdict() if cp else None,
             "exposed_comm_us_per_step": exposed["avg_us_per_step"],
             "exposed_comm_us_by_step": exposed["per_step"],
+            "warnings": warnings,
             "output": args.output,
         }, indent=2, default=str))
+        for w in warnings:
+            print(f"ds_prof merge: warning: {w}", file=sys.stderr)
         return 0
     nev = sum(len(e) for e in ft.by_rank.values())
     print(f"merged {len(ft.by_rank)} rank trace(s), {nev} events"
@@ -92,6 +114,42 @@ def _cmd_merge(args) -> int:
     print(render_critical_path(cp))
     print()
     print(render_exposed_comm(exposed))
+    for w in warnings:
+        print(f"ds_prof merge: warning: {w}", file=sys.stderr)
+    return 0
+
+
+def _cmd_goodput(args) -> int:
+    """Job-level goodput report: classify every wall-second of the given
+    session traces (dirs expand to ALL their trace files, rotated
+    ``trace.session*`` included — restarts are the point), charge
+    inter-session gaps to the ``restart`` bucket annotated from
+    ``restart_log.jsonl``, and print the fleet-seconds table."""
+    from deepspeed_tpu.goodput.report import (build_job_report,
+                                              find_session_traces,
+                                              load_restart_log,
+                                              render_goodput_report)
+
+    paths = find_session_traces(args.paths)
+    if not paths:
+        print("ds_prof goodput: no trace files found", file=sys.stderr)
+        return 2
+    restart_log = (load_restart_log(args.restart_log, explicit=True)
+                   if args.restart_log else load_restart_log(args.paths))
+    report = build_job_report(paths, restart_log=restart_log,
+                              straggler=not args.no_straggler)
+    if args.json:
+        slim = {k: v for k, v in report.items() if k != "per_rank"}
+        slim["per_rank"] = {
+            str(r): {"sessions": pr["sessions"], "wall_s": pr["wall_s"],
+                     "buckets_us": pr["buckets_us"]}
+            for r, pr in report["per_rank"].items()}
+        print(json.dumps(slim, indent=2, default=str))
+    else:
+        print(render_goodput_report(
+            report, source=", ".join(args.paths)))
+    if not report["ranks"]:
+        return 2
     return 0
 
 
@@ -130,11 +188,26 @@ def main(argv=None) -> int:
                    help="machine-readable report instead of tables")
     mem = sub.add_parser("memory", help="summarize profiling/* memory series")
     mem.add_argument("path", help="metrics.jsonl or the telemetry output dir")
+    gp = sub.add_parser("goodput",
+                        help="job-level goodput/badput report across "
+                             "sessions and elastic restarts")
+    gp.add_argument("paths", nargs="+",
+                    help="telemetry output dir(s) or session trace files "
+                         "(dirs include rotated trace.session* files)")
+    gp.add_argument("--restart-log", action="append", default=[],
+                    help="explicit restart_log.jsonl path(s); default: "
+                         "restart_log.jsonl found in the given dirs")
+    gp.add_argument("--no-straggler", action="store_true",
+                    help="skip the cross-rank straggler-wait attribution")
+    gp.add_argument("--json", action="store_true",
+                    help="machine-readable report instead of the table")
     args = parser.parse_args(argv)
     if args.cmd == "merge":
         return _cmd_merge(args)
     if args.cmd == "memory":
         return _cmd_memory(args)
+    if args.cmd == "goodput":
+        return _cmd_goodput(args)
     parser.print_help()
     return 2
 

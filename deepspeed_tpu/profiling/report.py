@@ -267,6 +267,7 @@ def render_serving_summary(records: List[dict],
         out.append("latency (s unless noted):")
         table = [("series", "count", "p50", "p90", "p99", "max")]
         for short in ("ttft_seconds", "request_seconds", "queue_wait_seconds",
+                      "prefill_seconds", "decode_chunk_seconds",
                       "ttft_deadline_fraction", "tokens_per_request"):
             rec = hists.get(short)
             if rec is None:
@@ -277,6 +278,18 @@ def render_serving_summary(records: List[dict],
                           fmt(rec.get("p99")), fmt(rec.get("max"))))
         if len(table) > 1:
             out.append("\n".join("  " + ln for ln in _table(table).splitlines()))
+        # TTFT decomposition from the request-scoped spans: where does the
+        # first token's latency come from — sitting in the queue, or the
+        # prefill compute itself? (p50s of independent series, so the sum
+        # is an approximation; it still answers "queue or compute")
+        ttft = hists.get("ttft_seconds")
+        qw = hists.get("queue_wait_seconds")
+        pf = hists.get("prefill_seconds")
+        if ttft and ttft.get("count") and qw and pf:
+            out.append(f"  ttft decomposition (p50): queue-wait "
+                       f"{qw.get('p50', 0):.4g}s + prefill "
+                       f"{pf.get('p50', 0):.4g}s ~= ttft "
+                       f"{ttft.get('p50', 0):.4g}s")
 
     trans = [(k, v) for k, v in sorted(counters.items())
              if k.startswith("circuit_transitions")]

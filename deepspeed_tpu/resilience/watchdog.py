@@ -127,25 +127,36 @@ def _cancel_async_exc(tid: int) -> None:
     ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid), None)
 
 
-def _count_timeout(kind: str) -> None:
+def _count_timeout(kind: str, stall_s: Optional[float] = None) -> None:
     from deepspeed_tpu import telemetry
 
     telemetry.get_registry().counter(
         "resilience/watchdog_timeouts", labels={"kind": kind}).inc()
     telemetry.get_tracer().instant("watchdog_timeout", cat="resilience",
                                    kind=kind)
+    if stall_s is not None and stall_s > 0:
+        # the stall itself as a complete span ending NOW: the goodput
+        # ledger charges this window to `watchdog_stall` instead of
+        # letting a wedged step masquerade as compute
+        telemetry.get_tracer().complete("watchdog_stall", stall_s * 1e6,
+                                        cat="stall", kind=kind)
 
 
 def run_with_deadline(fn: Callable, timeout: float, name: str = "op",
                       dump_path: Optional[str] = None,
-                      on_timeout_info: Optional[Callable[[], str]] = None):
+                      on_timeout_info: Optional[Callable[[], str]] = None,
+                      stall_span: bool = True):
     """Run ``fn()`` under a hard deadline; return its value or re-raise its
     exception. On expiry: all-thread stack dump, ``watchdog_timeouts``
     counter, and a clean :class:`WatchdogTimeout` in the CALLER — the
     wedged worker thread cannot be cancelled, only disowned (daemon), which
     is the point: the caller gets control back instead of blocking forever.
     ``on_timeout_info()`` (e.g. the barrier's missing-rank roster) is
-    appended to the message."""
+    appended to the message. ``stall_span=False`` suppresses the goodput
+    ``watchdog_stall`` span on expiry — for callers whose deadline is a
+    REQUEST budget, not a hang detector (the serving tick loop): a
+    routine SLO miss over healthy compute must not read as a wedged
+    engine in the time ledger."""
     if timeout is None or timeout <= 0:
         raise ValueError(f"run_with_deadline({name!r}): timeout must be positive, got {timeout!r}")
     result: dict = {}
@@ -162,7 +173,7 @@ def run_with_deadline(fn: Callable, timeout: float, name: str = "op",
     t = threading.Thread(target=worker, name=f"ds-deadline-{name}", daemon=True)
     t.start()
     if not done.wait(timeout):
-        _count_timeout("deadline")
+        _count_timeout("deadline", stall_s=timeout if stall_span else None)
         extra = ""
         if on_timeout_info is not None:
             try:
@@ -328,7 +339,7 @@ class StepWatchdog:
                f"{self.factor:g} × p{int(self.percentile * 100)} of recent steps))")
         self.trips += 1
         self.last_trip_reason = msg
-        _count_timeout(self.name)
+        _count_timeout(self.name, stall_s=waited)
         logger.error(msg)
         dump_all_stacks(self.dump_path, reason=msg)
         if self.on_timeout == "kill":

@@ -503,6 +503,26 @@ class PerfConfig(DeepSpeedConfigModel):
     attribution: bool = Field(True, description="embed the telemetry/profiling attribution (span p50/p99, memory census, flops, exposed comm) in each entry; false = headline + identity fields only")
 
 
+class GoodputConfig(DeepSpeedConfigModel):
+    """Goodput/badput accounting (deepspeed_tpu/goodput/): classify every
+    wall-second of a step into a CLOSED taxonomy (compute / compile /
+    exposed comm / data wait / checkpoint / watchdog stall / straggler
+    wait / restart / idle) from the telemetry step spans, export the
+    per-step breakdown as ``goodput/*`` series (``bin/ds_top`` tails
+    them live), embed it in perf-ledger entries (``ds_perf gate`` gates
+    the resulting ``goodput_fraction``), and stamp real backend-compile
+    seconds as ``compile`` spans via a ``jax.monitoring`` listener.
+    Job-level reports that stitch sessions across elastic restarts are
+    ``ds_prof goodput DIR...``'s job — pure log crunching, no config
+    needed. STRICT no-op when the block is absent: the goodput package
+    is never imported and no listener is registered (same contract as
+    ``analysis`` / ``profiling`` / ``perf`` / ``serving``). See
+    docs/CONFIG.md 'goodput' section."""
+    enabled: bool = Field(True, description="arm the goodput meter (the block being present opts in; set false to keep the block but skip the work)")
+    compile_spans: bool = Field(True, description="register the jax.monitoring compile-duration listener so backend compiles land as `compile` spans (process-wide and permanent once installed — jax has no per-listener deregistration)")
+    tolerance: float = Field(0.05, gt=0.0, le=1.0, description="closure tolerance the acceptance checks hold the ledger to: per-step buckets must sum to within this fraction of the measured step wall window (the partition sums exactly by construction; the tolerance absorbs span-boundary jitter against independently measured step time)")
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Fault-tolerant serving front-end (deepspeed_tpu/serving/ +
     ``bin/ds_serve``): a request-lifecycle manager around the inference
@@ -597,6 +617,10 @@ class DeepSpeedConfig:
         # package (never imported, zero threads)
         self.serving = ServingConfig(**pd.get("serving", {}))
         self.serving_present = "serving" in pd
+        # presence matters, same contract again: no block, no goodput
+        # package (never imported, no compile listener)
+        self.goodput = GoodputConfig(**pd.get("goodput", {}))
+        self.goodput_present = "goodput" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -664,7 +688,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "profiling", "perf", "serving", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

@@ -482,6 +482,17 @@ class DeepSpeedEngine:
             from deepspeed_tpu.perf.recorder import PerfRecorder
 
             self._perf_recorder = PerfRecorder(self, self._config.perf)
+        # ---- goodput meter -------------------------------------------------
+        # closed per-step badput ledger over the telemetry spans + the
+        # jax.monitoring compile-span listener (goodput/recorder.py) behind
+        # the ``goodput`` ds_config block. STRICT no-op when the block is
+        # absent: the goodput package is never imported, no listener is
+        # registered — same contract as ``analysis``/``profiling``/``perf``.
+        self._goodput = None
+        if self._config.goodput_present and self._config.goodput.enabled:
+            from deepspeed_tpu.goodput.recorder import GoodputMeter
+
+            self._goodput = GoodputMeter(self._config.goodput, engine=self)
         self._flops_probe = None
         dist.configure(self._config)
         self.flops_profiler_cfg = self._config.flops_profiler_config
@@ -1653,6 +1664,10 @@ class DeepSpeedEngine:
         session = _telemetry.get_session()
         if session is not None:
             self._record_step_telemetry(session, metrics, step)
+        if self._goodput is not None:
+            # classifies the PREVIOUS step (this step's train_batch span is
+            # still open here) — live goodput/* series lag one step
+            self._goodput.on_step(step)
         if self._mem_profiler is not None:
             self._mem_profiler.maybe_sample(self, step)
 

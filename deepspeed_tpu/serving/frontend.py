@@ -445,15 +445,29 @@ class ServingFrontEnd:
                 jax.block_until_ready(out)
             return out
 
+        phase = str(warm_key[0])        # "prefill" | "decode"
+        t_tick = time.monotonic()
         try:
-            out = run_with_deadline(run, timeout=budget,
-                                    name=f"serve-tick[{req.id}]")
+            # request-scoped span: with the admission_wait span this lets
+            # ds_metrics --serving decompose TTFT into queue-wait vs
+            # compute, and a merged trace show WHICH request a tick served
+            with _telemetry.get_tracer().span(phase, cat="serving",
+                                              request=req.id):
+                # a tick bound by the REQUEST's budget (budget < cap) that
+                # expires is a deadline over healthy compute, not a hang —
+                # it must not stamp a goodput watchdog_stall span
+                out = run_with_deadline(run, timeout=budget,
+                                        name=f"serve-tick[{req.id}]",
+                                        stall_span=budget >= cap)
         except WatchdogTimeout:
             if budget < cap:
                 # the request's own budget (or the drain cap) was the
                 # binding constraint — that is a deadline, not a hang
                 raise _RequestDeadline() from None
             raise
+        self._reg().histogram(
+            f"serving/{'prefill' if phase == 'prefill' else 'decode_chunk'}"
+            "_seconds").observe(time.monotonic() - t_tick)
         self._warm[warm_key] = self._warm.get(warm_key, 0) + 1
         # "K consecutive decode-step failures" is TICK-granular: every
         # healthy tick resets the streak (a deadline-partial request full
@@ -468,8 +482,12 @@ class ServingFrontEnd:
         req.started_at = time.monotonic()
         req.status = "running"
         reg = self._reg()
-        reg.histogram("serving/queue_wait_seconds").observe(
-            req.started_at - req.submitted_at)
+        wait_s = req.started_at - req.submitted_at
+        reg.histogram("serving/queue_wait_seconds").observe(wait_s)
+        # the admission wait as a complete span ending NOW: the first leg
+        # of the request-scoped admission_wait -> prefill -> decode chain
+        _telemetry.get_tracer().complete("admission_wait", wait_s * 1e6,
+                                         cat="serving", request=req.id)
         eos = 0 if req.eos_token_id is None else max(int(req.eos_token_id), 0)
         pkey = self._program_key(req)
         try:

@@ -94,6 +94,7 @@ class TelemetrySession:
                 TRACE_FILE.replace(".json", f".rank{rank}.json")
             os.makedirs(cfg.output_dir, exist_ok=True)
             self.trace_path = os.path.join(cfg.output_dir, name)
+            _rotate_stale_trace(self.trace_path)
         if cfg.monitor and monitor is not None:
             self.exporters.append(MonitorExporter(monitor))
         self._last_step = 0
@@ -118,6 +119,29 @@ class TelemetrySession:
                 self.tracer.write(self.trace_path)
             except Exception as exc:
                 logger.warning(f"telemetry trace write failed: {exc}")
+
+
+def _rotate_stale_trace(path: str) -> None:
+    """A new session must not clobber the previous session's trace — an
+    elastic restart used to overwrite ``trace.json`` and destroy exactly
+    the evidence a post-mortem (and ``ds_prof goodput``'s downtime
+    accounting) needs. Rotate the old file aside as
+    ``trace.session<N>[...].json``; ``ds_prof merge`` excludes rotated
+    sessions from its default dir scan (two sessions of one rank must not
+    read as two ranks), ``ds_prof goodput`` includes them (restarts are
+    the point)."""
+    if not os.path.exists(path):
+        return
+    head, tail = os.path.split(path)
+    suffix = tail[len("trace"):]                # ".json" / ".rank3.json"
+    for n in range(1, 10_000):
+        rotated = os.path.join(head, f"trace.session{n}{suffix}")
+        if not os.path.exists(rotated):
+            break
+    try:
+        os.replace(path, rotated)
+    except OSError as exc:
+        logger.warning(f"telemetry: could not rotate stale trace {path}: {exc}")
 
 
 _session: Optional[TelemetrySession] = None

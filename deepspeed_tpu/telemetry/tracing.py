@@ -27,7 +27,12 @@ class StepTracer:
     never grows memory without bound on a long run)."""
 
     def __init__(self, max_events: int = 100_000, pid: int = 0):
+        # monotonic+epoch clock anchor, captured back-to-back: span ``ts``
+        # values are µs since _t0, and epoch0 places that zero on wall
+        # time — how ds_prof goodput stitches sessions across elastic
+        # restarts, and how merged Perfetto timelines get absolute time
         self._t0 = time.perf_counter()
+        self.epoch0 = time.time()
         self.pid = int(pid)
         self.max_events = int(max_events)
         self.events: List[dict] = []
@@ -83,7 +88,9 @@ class StepTracer:
                  "args": {"name": f"deepspeed_tpu rank {self.pid}"}}]
         return {"traceEvents": meta + self.events, "displayTimeUnit": "ms",
                 "metadata": {"rank": self.pid, "max_events": self.max_events,
-                             "dropped_events": self.dropped}}
+                             "dropped_events": self.dropped,
+                             "clock_anchor": {"epoch_s": self.epoch0,
+                                              "monotonic_s": self._t0}}}
 
     def write(self, path: str) -> None:
         """Atomic dump (tmp + replace): a reader mid-run never sees a

@@ -30,7 +30,12 @@ to BENCH_LEDGER (default ./perf_ledger.jsonl); the legacy metric string
 stays for tail-line parsers. BENCH_PERF=0 opts out (bare measurement).
 `python bench.py --smoke [--ledger PATH]` is the CI-sized CPU dry run of
 the whole pipeline; `ds_perf gate --baseline BENCH_r05.json` fails a
-build on a headline regression.
+build on a headline regression. `--devices N` (BENCH_DEVICES) fakes an
+N-device CPU mesh (--xla_force_host_platform_device_count) so the
+ZeRO-3/dp sharding paths run off-TPU; `--overlap overlapped|serial|off`
+(BENCH_OVERLAP) adds the `overlap` ds_config block — run the same line
+under `serial` then `overlapped` and `ds_perf diff --metric exposed_comm`
+prices the hidden-collectives win from the two ledger entries.
 
 Env knobs: BENCH_MODEL, BENCH_BS (per-chip microbatch), BENCH_SEQ,
 BENCH_STEPS, BENCH_GAS, BENCH_REMAT (none|full|dots|attn|attn_mlp; default
@@ -141,6 +146,36 @@ if "--ledger" in sys.argv[1:]:
     if _i + 1 >= len(sys.argv):
         sys.exit("bench.py: --ledger requires a path argument")
     os.environ["BENCH_LEDGER"] = sys.argv[_i + 1]
+# --devices N (or BENCH_DEVICES): simulated multi-device mode — N virtual
+# CPU devices via --xla_force_host_platform_device_count, so the ZeRO/dp
+# sharding paths (and the overlap engine's gather schedules) are
+# exercisable off-TPU: `bench.py --smoke --devices 8` runs the gpt2-tiny
+# line as a real ZeRO-3 8-way job in CI. Must land in XLA_FLAGS before the
+# jax import below initializes the backend.
+if "--devices" in sys.argv[1:]:
+    _i = sys.argv[1:].index("--devices") + 1
+    if _i + 1 >= len(sys.argv):
+        sys.exit("bench.py: --devices requires a count argument")
+    os.environ["BENCH_DEVICES"] = sys.argv[_i + 1]
+_devices = int(os.environ.get("BENCH_DEVICES", 0))
+if _devices > 1:
+    _fl = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _fl:
+        os.environ["XLA_FLAGS"] = (
+            f"{_fl} --xla_force_host_platform_device_count={_devices}".strip())
+    os.environ["JAX_PLATFORMS"] = "cpu"   # simulated devices are a CPU mode
+# --overlap MODE (or BENCH_OVERLAP): add the `overlap` ds_config block to
+# every engine-backed line. "overlapped" = the restructured schedule,
+# "serial" = the measured un-overlapped baseline whose gather phase lands
+# as comm spans — running the same line under both yields the two ledger
+# entries whose exposed_comm_us_per_step delta prices the overlap win
+# (`ds_perf diff --metric exposed_comm`). Unset = no block (strict no-op).
+if "--overlap" in sys.argv[1:]:
+    _i = sys.argv[1:].index("--overlap") + 1
+    if _i + 1 >= len(sys.argv):
+        sys.exit("bench.py: --overlap requires a mode "
+                 "(overlapped|serial|off)")
+    os.environ["BENCH_OVERLAP"] = sys.argv[_i + 1]
 
 import jax
 import numpy as np
@@ -377,6 +412,12 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         "gradient_clipping": 1.0,
         "steps_per_print": 0,
     }
+    overlap_mode = os.environ.get("BENCH_OVERLAP", "")
+    if overlap_mode and overlap_mode != "off":
+        if overlap_mode not in ("overlapped", "serial"):
+            raise ValueError(f"BENCH_OVERLAP={overlap_mode!r} not in "
+                             "('overlapped', 'serial', 'off')")
+        ds_config["overlap"] = {"schedule": overlap_mode}
     if gas > 1:
         # bf16 accumulator: gas>1 must not add a resident fp32 grad tree on
         # top of the full optimizer state (16G HBM budget)
@@ -438,9 +479,10 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
 
     final_loss = float(loss)
     off_tag = f", offload={offload}" if offload != "none" else ""
+    ov_tag = f", overlap={overlap_mode}" if overlap_mode else ""
     line = {
         "metric": f"{model_name} pretrain MFU (bs={per_chip_bs}/chip, seq={seq}, "
-                  f"{n_dev} chip(s), gas={gas}{off_tag}, "
+                  f"{n_dev} chip(s), gas={gas}{off_tag}{ov_tag}, "
                   f"tok/s/chip={tok_per_sec_chip:.0f}, "
                   f"TFLOPs/chip={achieved/1e12:.1f}, loss={final_loss:.3f})",
         "value": round(mfu, 4),
@@ -460,6 +502,7 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
                         "remat": remat, "offload": offload, "n_dev": n_dev,
                         "steps": steps, "batch_size": batch_size,
                         "n_head": config.n_head,
+                        "overlap": overlap_mode or None,
                         "flash_block": getattr(config, "flash_block", None)},
                 extra={"vs_baseline": line["vs_baseline"],
                        "tok_per_sec_chip": round(tok_per_sec_chip, 1),

@@ -53,6 +53,7 @@ def _block_models() -> Dict[str, type]:
         "telemetry": C.TelemetryConfig, "analysis": C.AnalysisConfig,
         "profiling": C.ProfilingConfig, "perf": C.PerfConfig,
         "serving": C.ServingConfig, "goodput": C.GoodputConfig,
+        "overlap": C.OverlapConfig,
         "compression_training": CompressionConfig,
     }
 
@@ -212,6 +213,41 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "misses are detected at tick granularity — expected for "
                 "latency-tight SLOs, just know the detection latency",
                 "serving.default_deadline_s vs serving.decode_tick_timeout_s")
+    ov = cfg.overlap
+    if "overlap" in pd and ov.enabled:
+        if stage < 3 and ov.param_prefetch > 0:
+            add("warning",
+                f"overlap.param_prefetch={ov.param_prefetch} with ZeRO stage "
+                f"{stage}: params are only dp-sharded at stage 3, so there "
+                "is no per-layer gather to prefetch — the layer scan stays "
+                "unrestructured (set zero_optimization.stage: 3, or "
+                "param_prefetch: 0 to silence this)",
+                "overlap.param_prefetch vs zero_optimization.stage")
+        if ov.schedule == "serial" and not (tel.enabled and tel.trace):
+            add("warning",
+                "overlap.schedule='serial' is the MEASURED un-overlapped "
+                "baseline — its blocking gather phase exists to land as "
+                "comm spans — but telemetry step tracing is off, so the "
+                "exposed-comm cost is paid and never recorded; enable the "
+                "telemetry block (trace: true) or use "
+                "schedule='overlapped'",
+                "overlap.schedule vs telemetry.trace")
+        if zc.offload_param is not None:
+            add("warning",
+                "overlap with zero_optimization.offload_param: the step "
+                "restructuring is disabled for host-offloaded params "
+                "(their stream-in IS the gather); scheduler flags and the "
+                "async checkpoint snapshot still apply",
+                "overlap vs zero_optimization.offload_param")
+        if ov.param_prefetch > 2:
+            add("info",
+                f"overlap.param_prefetch={ov.param_prefetch}: each "
+                "prefetched layer keeps one more gathered slice resident; "
+                "past 1-2 layers ahead the scheduler rarely finds more "
+                "latency to hide and the engine clamps the depth below the "
+                "model's layer count — validate the trade with the ds_prof "
+                "memory census",
+                "overlap.param_prefetch")
     gp = cfg.goodput
     if "goodput" in pd and gp.enabled and not (tel.enabled and tel.trace):
         add("warning",

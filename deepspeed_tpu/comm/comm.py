@@ -162,6 +162,43 @@ def _group_desc(group) -> str:
         return "world"
 
 
+def record_engine_collective(op: str, shape, dtype, axes) -> None:
+    """Register an ENGINE-ISSUED collective with the ds_doctor recorder
+    (analysis/collectives.py record mode): GSPMD-inserted collectives —
+    the overlap engine's per-layer ZeRO-3 gathers and its serial gather
+    phase — never pass through the eager ``dist.*`` wrappers, so they
+    would be invisible to the cross-rank sequence fingerprint without
+    this hook. Called at TRACE time from the step builder; one `is None`
+    check when no recorder is installed."""
+    rec = _collective_recorder
+    if rec is None:
+        return
+    rec(op, tuple(int(s) for s in shape), str(dtype), tuple(axes))
+
+
+def record_phase_span(op: str, seconds: float, group_desc: str,
+                      nbytes: int = 0) -> None:
+    """Emit a rank-matchable ``cat="comm"`` trace span for an engine-level
+    collective PHASE — a separately dispatched XLA program whose content
+    is collectives (the overlap engine's serial ZeRO-3 gather), timed to
+    completion by the caller. Carries the same ``(op, seq, group)``
+    identity as the eager ``timed_op`` spans, so ``ds_prof merge`` aligns
+    and skews it across ranks and ``exposed_comm_us_per_step`` prices it."""
+    from deepspeed_tpu import telemetry
+
+    registry = telemetry.get_registry()
+    if registry.enabled:
+        registry.histogram("comm/op_latency_seconds",
+                           labels={"op": op, "size": str(int(nbytes))}
+                           ).observe(seconds)
+        registry.counter("comm/op_calls", labels={"op": op}).inc()
+        registry.counter("comm/op_bytes", labels={"op": op}).inc(int(nbytes))
+    telemetry.get_tracer().complete(
+        f"comm:{op}", seconds * 1e6, cat="comm", op=op,
+        seq=_next_collective_seq(op, group_desc), group=group_desc,
+        bytes=int(nbytes))
+
+
 def is_initialized() -> bool:
     return cdb is not None
 
@@ -448,10 +485,30 @@ def timed_op(func):
                 group = args[group_idx]
             _record_collective(func.__name__, tensor, group)
         registry = telemetry.get_registry()
-        if ((comms_logger is None and not registry.enabled)
-                or isinstance(tensor, jax.core.Tracer)):
+        in_trace = isinstance(tensor, jax.core.Tracer)
+        if (comms_logger is None and not registry.enabled) or in_trace:
+            if not in_trace:
+                # the `collective` chaos target fires on EAGER collectives
+                # whether or not anything is timing them (a watchdog drill
+                # without a telemetry block must still inject) — trace-time
+                # calls are excluded: a sleep during tracing is not a fault
+                from deepspeed_tpu.resilience import chaos as _chaos
+
+                inj = _chaos.active_injector()
+                if inj is not None and inj.targets("collective"):
+                    inj.before("collective", func.__name__)
             return func(tensor, *args, **kwargs)
         t0 = time.perf_counter()
+        from deepspeed_tpu.resilience import chaos as _chaos
+
+        inj = _chaos.active_injector()
+        if inj is not None and inj.targets("collective"):
+            # `collective` chaos target: a scripted/randomized delay or
+            # hang INSIDE the timed window inflates this op's comm span —
+            # stragglers and exposed-comm inflation become deterministically
+            # drillable without a slow interconnect (mirrors the
+            # train_step/decode_step step targets)
+            inj.before("collective", func.__name__)
         result = func(tensor, *args, **kwargs)
         jax.block_until_ready(result)
         latency = time.perf_counter() - t0

@@ -115,6 +115,26 @@ def profiling_report():
     return rows
 
 
+def overlap_report():
+    """The overlap engine's XLA latency-hiding scheduler preset
+    (runtime/overlap.py): which flags are live in this environment's
+    XLA_FLAGS. The engine appends missing ones at init ON TPU (a CPU/GPU
+    XLA aborts on unknown flags), but only child processes see flags
+    added after backend init — this report shows what the NEXT process
+    will actually run under."""
+    from deepspeed_tpu.runtime.overlap import scheduler_flag_status
+
+    import jax
+
+    rows = [("backend", jax.default_backend()),
+            ("preset applies", "yes (TPU)" if jax.default_backend() == "tpu"
+             else "no (TPU-compiler flags; engine skips them here)")]
+    for flag, present in scheduler_flag_status():
+        rows.append((flag.split("=", 1)[0].replace("--xla_", ""),
+                     "set" if present else "unset"))
+    return rows
+
+
 def kernel_report():
     rows = []
     try:
@@ -197,6 +217,10 @@ def main(args=None):
     print("profiling:")
     for k, v in profiling_report():
         print(f"  {k:<24} {v}")
+    print(line)
+    print("overlap (latency-hiding scheduler preset):")
+    for k, v in overlap_report():
+        print(f"  {k:<44} {v}")
     print(line)
     print("kernels/toolchain:")
     for k, v in kernel_report():

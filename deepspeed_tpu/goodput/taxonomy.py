@@ -65,6 +65,12 @@ def span_bucket(ev: dict) -> Optional[str]:
     those are request-scoped, not step-scoped)."""
     if not is_span(ev):
         return None
+    if (ev.get("args") or {}).get("background"):
+        # background-thread work (the overlap engine's async checkpoint
+        # commit) overlaps the step by DESIGN — charging it as badput
+        # would un-hide exactly what it hides; the wall time under it is
+        # classified by whatever the step itself is doing
+        return None
     cat = str(ev.get("cat", ""))
     if cat in _CAT_BUCKET:
         return _CAT_BUCKET[cat]

@@ -285,8 +285,12 @@ class BertModel:
                 x = carry + gate * (x - carry)
             return x, None
 
-        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], keep_p, pld_rngs),
-                            unroll=c.scan_unroll)
+        # overridable layer scan (overlap engine's ZeRO-3 gather prefetch;
+        # a plain lax.scan when nothing is installed)
+        from deepspeed_tpu.models.common import layer_scan
+
+        x, _ = layer_scan(scan_body, x, (params["blocks"], keep_p, pld_rngs),
+                          unroll=c.scan_unroll)
         return x
 
     def hidden_states(self, params, input_ids, token_type_ids=None,

@@ -22,6 +22,37 @@ NEG_INF_ATTN = -1e30
 
 _warned_flash_fallback = [False]
 
+# ---------------------------------------------------------------------------
+# layer-scan indirection (overlap engine hook)
+# ---------------------------------------------------------------------------
+# The trunk of every layer-stacked model scans its blocks through
+# `layer_scan` instead of calling `jax.lax.scan` directly. With nothing
+# installed it IS a plain lax.scan (identical trace, asserted in tests —
+# the overlap strict-no-op contract); the overlap engine
+# (runtime/overlap.py) installs a double-buffered implementation around
+# step TRACING so ZeRO-3 per-layer param gathers are issued one layer
+# ahead of the forward. Trace-time only: compiled programs never read
+# this global.
+_LAYER_SCAN_IMPL = None
+
+
+def set_layer_scan_impl(impl):
+    """Install (or clear, with None) the layer-scan override; returns the
+    previous implementation so context managers can restore it."""
+    global _LAYER_SCAN_IMPL
+    prev = _LAYER_SCAN_IMPL
+    _LAYER_SCAN_IMPL = impl
+    return prev
+
+
+def layer_scan(body, init, xs, unroll: int = 1):
+    """``jax.lax.scan`` over layer-stacked ``xs``, overridable by the
+    overlap engine (see :func:`set_layer_scan_impl`)."""
+    impl = _LAYER_SCAN_IMPL
+    if impl is None:
+        return jax.lax.scan(body, init, xs, unroll=max(1, int(unroll)))
+    return impl(body, init, xs, unroll)
+
 
 def alibi_slopes(n_head: int):
     """ALiBi per-head slopes, matching HF ``build_alibi_tensor`` (geometric

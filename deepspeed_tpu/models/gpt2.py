@@ -443,10 +443,15 @@ class GPT2Model:
                 x = carry + gate * (x - carry)
             return x, None
 
-        x, _ = jax.lax.scan(scan_body, x,
-                            (params["blocks"], layer_rngs, windows,
-                             keep_p, pld_rngs),
-                            unroll=max(1, int(c.scan_unroll)))
+        # layer_scan = lax.scan unless the overlap engine installed its
+        # double-buffered ZeRO-3 gather-prefetch implementation (trace-time
+        # indirection; identical trace when nothing is installed)
+        from deepspeed_tpu.models.common import layer_scan
+
+        x, _ = layer_scan(scan_body, x,
+                          (params["blocks"], layer_rngs, windows,
+                           keep_p, pld_rngs),
+                          unroll=max(1, int(c.scan_unroll)))
         return self._layer_norm(x, params["lnf_g"], params["lnf_b"])
 
     def hidden_states(self, params, input_ids, rng=None):

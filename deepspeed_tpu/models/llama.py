@@ -278,7 +278,11 @@ class LlamaModel:
         def scan_body(carry, blk):
             return block_fn(carry, blk, (cos, sin)), None
 
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        # overridable layer scan (overlap engine's ZeRO-3 gather prefetch;
+        # a plain lax.scan when nothing is installed)
+        from deepspeed_tpu.models.common import layer_scan
+
+        x, _ = layer_scan(scan_body, x, params["blocks"])
         return self._rms_norm(x, params["norm_g"])
 
     def hidden_states(self, params, input_ids, rng=None):

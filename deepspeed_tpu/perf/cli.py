@@ -71,10 +71,30 @@ def _select(series_keys, metric_substrs):
             if any(s.lower() in k.lower() for s in metric_substrs)]
 
 
+def _split_metrics(metric_args):
+    """``--metric`` accepts BOTH series-key substrings and attribution
+    metric names (``exposed_comm``, ``goodput``). The latter select WHAT
+    is judged — the embedded attribution value of each gated series —
+    not which series: `ds_perf gate --metric exposed_comm` gates the
+    default (headline) series set on its exposed-comm µs/step."""
+    attr = [m for m in metric_args if m in led.ATTRIBUTION_METRICS]
+    series = [m for m in metric_args if m not in led.ATTRIBUTION_METRICS]
+    return series, attr
+
+
+def _exposed_line(r):
+    if "new_exposed_comm_us" not in r:
+        return ""
+    return (f"  exposed_comm {r['old_exposed_comm_us']:.0f} -> "
+            f"{r['new_exposed_comm_us']:.0f} us/step"
+            + (" [REGRESSED]" if r.get("exposed_comm_regressed") else ""))
+
+
 def _cmd_diff(args) -> int:
     old = led.latest_by_series(_load(args.old))
     new = led.latest_by_series(_load(args.new))
-    shared = _select([k for k in old if k in new], args.metric)
+    series_sel, attr_sel = _split_metrics(args.metric)
+    shared = _select([k for k in old if k in new], series_sel)
     if not shared:
         print("ds_perf diff: the two ledgers share no benchmark series",
               file=sys.stderr)
@@ -97,7 +117,10 @@ def _cmd_diff(args) -> int:
         fp = "  [config fingerprint changed]" if r["fingerprint_changed"] else ""
         print(f"{mark} {r['series']}: {_fmt_val(r['old_value'])} -> "
               f"{_fmt_val(r['new_value'])} ({r['rel_delta']:+.1%})"
-              f"{noise}{fp}")
+              f"{noise}{fp}{_exposed_line(r)}")
+        if "exposed_comm" in attr_sel and "new_exposed_comm_us" not in r:
+            print(f"   {r['series']}: exposed_comm not recorded on both "
+                  "sides (needs telemetry-instrumented entries)")
     return 0
 
 
@@ -113,10 +136,11 @@ def _cmd_gate(args) -> int:
     # default, not a warning: a crashed bench exits the same way a
     # regressed one does)
     newest = led.newest_by_series(cand_entries)
+    series_sel, attr_sel = _split_metrics(args.metric)
     if args.all:
         gated = [k for k in base if k in cand or k in newest]
-    elif args.metric:
-        gated = _select(base.keys(), args.metric)
+    elif series_sel:
+        gated = _select(base.keys(), series_sel)
     else:
         gated = [k for k, e in base.items() if e.get("headline")]
         if not gated:
@@ -135,9 +159,17 @@ def _cmd_gate(args) -> int:
             missing.append(k)     # never measured, or newest run skipped it
             continue
         r = led.compare(base[k], cand[k], rel_tol=args.rel_tol)
+        if "exposed_comm" in attr_sel and "new_exposed_comm_us" not in r:
+            # gating ON exposed_comm but a side never recorded it: that is
+            # a missing measurement, not a pass — same policy as a series
+            # the run never measured
+            missing.append(f"{k} (exposed_comm attribution)")
+            continue
         checked.append(r)
         if r["verdict"] == "regression" or not r["new_value"] \
-                or r.get("goodput_regressed"):
+                or r.get("goodput_regressed") \
+                or ("exposed_comm" in attr_sel
+                    and r.get("exposed_comm_regressed")):
             failures.append(r)
     if args.json:
         print(json.dumps({"checked": checked, "missing": missing,
@@ -156,7 +188,7 @@ def _cmd_gate(args) -> int:
                          f"{r['new_goodput']:.3f}"
                          + (" [REGRESSED]" if r.get("goodput_regressed")
                             else ""))
-            print(line)
+            print(line + _exposed_line(r))
         for k in crashed:
             e = newest[k]
             print(f"FAIL {k}: newest run FAILED "
@@ -211,7 +243,9 @@ def main(argv=None) -> int:
     d.add_argument("--rel-tol", type=float, default=0.05,
                    help="relative tolerance before a delta counts (default 5%%)")
     d.add_argument("--metric", action="append", default=[],
-                   help="only series whose key contains SUBSTR (repeatable)")
+                   help="only series whose key contains SUBSTR (repeatable); "
+                        "the attribution metrics 'exposed_comm'/'goodput' "
+                        "instead select WHAT is compared")
     d.add_argument("--json", action="store_true")
 
     g = sub.add_parser("gate", help="exit 2 on a gated-series regression")
@@ -223,7 +257,11 @@ def main(argv=None) -> int:
                    help="allowed relative regression (default 8%%)")
     g.add_argument("--metric", action="append", default=[],
                    help="gate series whose key contains SUBSTR (repeatable); "
-                        "default: the baseline's headline entry")
+                        "default: the baseline's headline entry. "
+                        "'exposed_comm' gates the selected series on their "
+                        "exposed-comm µs/step attribution (lower is better; "
+                        "growth past tolerance + a 50µs floor fails) — the "
+                        "overlap win regresses like a headline metric")
     g.add_argument("--all", action="store_true",
                    help="gate every series the two files share")
     g.add_argument("--allow-missing", action="store_true",

@@ -214,6 +214,15 @@ def welch_t(a: Sequence[float], b: Sequence[float]) -> Optional[float]:
 _T_CRIT = {1: 12.71, 2: 4.30, 3: 3.18, 4: 2.78, 5: 2.57, 6: 2.45, 7: 2.36,
            8: 2.31, 9: 2.26, 10: 2.23, 15: 2.13, 20: 2.09, 30: 2.04}
 
+# Exposed-comm regression floor (µs/step): relative tolerance alone would
+# flag microsecond jitter on entries that expose next to nothing.
+EXPOSED_COMM_FLOOR_US = 50.0
+
+# Attribution-level metrics `ds_perf gate/diff --metric` understands in
+# addition to series-key substrings: these select WHAT is compared (the
+# embedded attribution value), not WHICH series.
+ATTRIBUTION_METRICS = ("exposed_comm", "goodput")
+
 # Minimum per-side sample count for the t gate to carry a verdict: with
 # fewer, a failed significance test means "underpowered", not "noise",
 # and must NOT exonerate a past-tolerance regression (a 2-sample ledger
@@ -301,6 +310,22 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     # feed a t gate that may exonerate a past-tolerance drop — one
     # stall-y step in a short window must not fail CI — with the same
     # power floor and fingerprint-change escape hatch.
+    # exposed_comm_us_per_step rides along the same way (entries recorded
+    # under a telemetry session carry it in `attribution`): LOWER is
+    # better — the overlap engine's whole point is shrinking it — so the
+    # regression direction flips vs the headline. Judged relative with an
+    # absolute floor (EXPOSED_COMM_FLOOR_US): a 0 → 40µs blip on a step
+    # that exposes nothing must not fail CI, a 0 → 20ms un-overlap must.
+    # `ds_perf gate --metric exposed_comm` turns the flag into teeth.
+    eo = (old.get("attribution") or {}).get("exposed_comm_us_per_step")
+    en = (new.get("attribution") or {}).get("exposed_comm_us_per_step")
+    if eo is not None and en is not None:
+        eo, en = float(eo), float(en)
+        out["old_exposed_comm_us"] = eo
+        out["new_exposed_comm_us"] = en
+        out["exposed_comm_delta_us"] = en - eo
+        out["exposed_comm_regressed"] = (
+            (en - eo) > max(rel_tol * max(eo, 1.0), EXPOSED_COMM_FLOOR_US))
     go, gn = old.get("goodput_fraction"), new.get("goodput_fraction")
     if go is not None and gn is not None:
         out["old_goodput"] = float(go)

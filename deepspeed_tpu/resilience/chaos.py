@@ -11,14 +11,20 @@ save at any point.
 Ops instrumented by the checkpoint engine: ``state_save`` (the orbax
 write), ``client_state``, ``sampler_sidecar``, ``manifest``, ``latest``.
 The training engine additionally calls ``before("train_step", ...)`` at
-each step, and the serving front-end (serving/frontend.py) calls
+each step, the serving front-end (serving/frontend.py) calls
 ``before("decode_step", ...)`` at each request tick (the prefill and
-every decode chunk) — both only when :meth:`ChaosInjector.targets` says a
-fault class aims there (an existing checkpoint-I/O drill must not
-silently expand into the step path). The step-oriented fault classes
-(``train_step`` and ``decode_step`` take the same three, so every
-serving failure path — a failed tick, a hung tick, a slow tick — is
-deterministically drillable without a real TPU fault):
+every decode chunk), and the comm layer calls ``before("collective",
+<op>)`` inside the timed window of every eager collective plus the
+overlap engine's serial ZeRO-3 gather phase — so an injected ``delay``
+or ``hang`` inflates that op's comm span exactly like a slow
+interconnect, making stragglers and exposed-comm inflation
+deterministically drillable. All of these fire only when
+:meth:`ChaosInjector.targets` says a fault class aims there (an existing
+checkpoint-I/O drill must not silently expand into the step path). The
+step-oriented fault classes (``train_step``, ``decode_step`` and
+``collective`` take the same three, so every serving failure path — a
+failed tick, a hung tick, a slow tick — is deterministically drillable
+without a real TPU fault):
 
 * ``hang`` (``hang_at`` scripted / ``hang_rate`` randomized) — stall for
   ``hang_s`` seconds in an INTERRUPTIBLE sleep loop, so the step
@@ -142,9 +148,9 @@ class ChaosInjector:
         """Does any fault class aim at ``op``? The engine's step hook only
         fires when one does: a checkpoint-I/O drill (``ops`` unset, rates
         only) must not silently expand its blast radius into the step path
-        — ``train_step``/``decode_step`` faults require naming the op in
-        ``ops``, a scripted ``*_at`` entry, or the (step-oriented)
-        ``hang_rate``."""
+        — ``train_step``/``decode_step``/``collective`` faults require
+        naming the op in ``ops``, a scripted ``*_at`` entry, or the
+        (step-oriented) ``hang_rate``."""
         if self.ops is not None:
             return op in self.ops
         if any(op in d for d in (self.fail_at, self.truncate_at,
@@ -196,7 +202,8 @@ class ChaosInjector:
         # hang-safe as the watchdog-armed train_step.
         rate_hang = (self.hang_rate
                      and (self.ops is not None
-                          or op in ("train_step", "decode_step"))
+                          or op in ("train_step", "decode_step",
+                                    "collective"))
                      and self._rng.random() < self.hang_rate)
         if n in self.hang_at.get(op, ()) or rate_hang:
             self._hang(op, n, path)

@@ -88,16 +88,42 @@ def _get_async_checkpointer():
     return _async_checkpointer
 
 
+import threading as _threading  # noqa: E402
+
 _pending_latest_threads: list = []
+_pending_lock = _threading.Lock()
+
+
+def register_pending_save(thread) -> None:
+    """Track a background save thread (the overlap engine's async
+    snapshot commit) so loads / subsequent saves / process exit join it
+    exactly like the async-orbax finalize threads."""
+    with _pending_lock:
+        _pending_latest_threads.append(thread)
 
 
 def wait_for_pending_saves():
     """Block until any in-flight async checkpoint write commits (and its
-    'latest' pointer advance lands)."""
+    'latest' pointer advance lands). Safe to call FROM a tracked save
+    thread (the overlap snapshot commit runs the ordinary save path,
+    which starts with this wait): a thread never joins itself — it stays
+    registered until a LATER wait drains it, so a concurrent main-thread
+    wait always sees (and joins) the in-flight write instead of
+    returning early against a half-written tag. List mutation is
+    lock-guarded: the main thread and a background commit may wait
+    concurrently."""
     if _async_checkpointer is not None:
         _async_checkpointer.wait_until_finished()
-    while _pending_latest_threads:
-        _pending_latest_threads.pop().join()
+    me = _threading.current_thread()
+    while True:
+        with _pending_lock:
+            t = next((x for x in _pending_latest_threads if x is not me),
+                     None)
+            if t is not None:
+                _pending_latest_threads.remove(t)
+        if t is None:
+            return
+        t.join()
 
 
 # the 'latest'-pointer advance runs on a daemon thread; a trainer that exits
@@ -107,13 +133,41 @@ import atexit  # noqa: E402
 atexit.register(wait_for_pending_saves)
 
 
+def capture_host_meta(engine) -> dict:
+    """The host-side training-progress facts a checkpoint's
+    client_state.json records, captured NOW: the async snapshot path
+    hands this to its background commit so the metadata describes the
+    same instant as the device snapshot — reading the live engine from
+    the background thread would pair step-N weights with step-N+k
+    LR-schedule/sampler positions (silent wrong-resume)."""
+    sampler = getattr(engine, "_data_sampler", None)
+    return {
+        "global_samples": engine.global_samples,
+        "micro_steps": engine.micro_steps,
+        "lr_scheduler": (engine.lr_scheduler.state_dict()
+                         if engine.lr_scheduler is not None else None),
+        "data_sampler": sampler.state_dict() if sampler is not None else None,
+    }
+
+
 def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
-                           client_state: Optional[dict] = None, save_latest: bool = True) -> bool:
+                           client_state: Optional[dict] = None, save_latest: bool = True,
+                           state=None, force_sync: bool = False,
+                           host_meta: Optional[dict] = None) -> bool:
+    """``state`` overrides the live ``engine.state`` (the overlap engine's
+    async snapshot passes its device-side copy — the live tree's buffers
+    are donated to the next step and must not be read from a background
+    thread); ``host_meta`` (a :func:`capture_host_meta` dict) likewise
+    overrides the live host-side progress facts so snapshot metadata is
+    consistent with the snapshot; ``force_sync`` bypasses the orbax
+    AsyncCheckpointer (the snapshot commit already runs on its own
+    thread — nesting a second async layer would just complicate the
+    'latest' ordering)."""
     import orbax.checkpoint as ocp
 
-    tag = tag or f"global_step{int(engine.state.step)}"
+    state = engine.state if state is None else state
+    tag = tag or f"global_step{int(state.step)}"
     path = _ckpt_dir(save_dir, tag)
-    state = engine.state
     policy = _retry_policy(engine)
     inj = _chaos.active_injector()
 
@@ -135,7 +189,8 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     pass
             retry(_drop_stale, policy, op="manifest")
 
-    use_async = bool(getattr(engine._config.checkpoint_config, "async_save", False))
+    use_async = bool(getattr(engine._config.checkpoint_config, "async_save", False)) \
+        and not force_sync
     if use_async:
         ckptr = _get_async_checkpointer()
         ckptr.wait_until_finished()           # one in-flight save at a time
@@ -163,8 +218,9 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         # manifest, so a write that lands corrupt (crash, chaos truncation)
         # fails verification at load time and the restore walks back
         manifest_files = {}
-        sampler_sd = (engine._data_sampler.state_dict()
-                      if getattr(engine, "_data_sampler", None) else None)
+        if host_meta is None:
+            host_meta = capture_host_meta(engine)
+        sampler_sd = host_meta["data_sampler"]
         if sampler_sd is not None and isinstance(
                 sampler_sd.get("admitted"), np.ndarray):
             # the admitted draw order is O(admitted-samples) int64 — sidecar
@@ -178,9 +234,9 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             "tag": tag,
             "global_steps": int(state.step),
             "skipped_steps": int(state.skipped_steps),
-            "global_samples": engine.global_samples,
-            "micro_steps": engine.micro_steps,
-            "lr_scheduler": engine.lr_scheduler.state_dict() if engine.lr_scheduler is not None else None,
+            "global_samples": host_meta["global_samples"],
+            "micro_steps": host_meta["micro_steps"],
+            "lr_scheduler": host_meta["lr_scheduler"],
             "client_state": client_state or {},
             "zero_stage": engine.zero_stage,
             "dp_world_size": engine.dp_world_size,

@@ -373,7 +373,7 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
     max_delay_s: float = Field(0.02, ge=0.0, description="upper bound of an injected delay (s)")
     hang_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-op probability of an injected interruptible HANG (watchdog detection drills)")
     hang_s: float = Field(3600.0, ge=0.0, description="duration of an injected hang (s); the watchdog is expected to fire well before it ends")
-    ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/train_step/decode_step); empty = all")
+    ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/train_step/decode_step/collective); empty = all")
     collective_mismatch: bool = Field(False, description="perturb this rank's ds_doctor-recorded collective sequence (swap/mutate/phantom, seed-deterministic) so the static deadlock detector has a reproducible divergent rank to catch")
     collective_mismatch_rank: int = Field(-1, ge=-1, description="process whose recorded sequence is perturbed (-1 = every recording process)")
 
@@ -523,6 +523,52 @@ class GoodputConfig(DeepSpeedConfigModel):
     tolerance: float = Field(0.05, gt=0.0, le=1.0, description="closure tolerance the acceptance checks hold the ledger to: per-step buckets must sum to within this fraction of the measured step wall window (the partition sums exactly by construction; the tolerance absorbs span-boundary jitter against independently measured step time)")
 
 
+class OverlapConfig(DeepSpeedConfigModel):
+    """Overlap engine (deepspeed_tpu/runtime/overlap.py): hide the ZeRO
+    collectives behind compute. Restructures the fused train step so the
+    XLA scheduler can overlap communication with computation: per-block
+    ZeRO-3 param gathers prefetched ``param_prefetch`` layers ahead of
+    the forward (double-buffered layer scan over the model's stacked
+    blocks, specs from the ShardingPlan), per-block gradient
+    reduce-scatter issued inside the backward scan (the gather's
+    custom-vjp transpose) instead of one fused post-backward reduction,
+    the XLA latency-hiding-scheduler flag preset applied once at engine
+    init (reported by ``ds_report``), and checkpoint snapshots taken as
+    a device-side copy with the device→host transfer + verified write on
+    a background thread. ``schedule: "serial"`` runs the measured
+    UN-overlapped baseline instead — a blocking, span-timed all-gather
+    phase before the compute program — so ``ds_prof merge`` /
+    ``ds_perf gate --metric exposed_comm`` can price exactly what the
+    overlapped schedule removes. STRICT no-op when the block is absent:
+    the overlap module is never imported, the step builder and models'
+    layer scan are byte-identical, and the checkpoint path is untouched
+    (asserted in tests — same bar as ``telemetry``/``profiling``/
+    ``goodput``). See docs/CONFIG.md 'overlap' section."""
+    enabled: bool = Field(True, description="arm the overlap engine (the block being present opts in; set false to keep the block but skip the work)")
+    schedule: str = Field("overlapped", description="'overlapped' = restructured step (prefetched gathers, in-scan reduce-scatter); 'serial' = the measured un-overlapped ZeRO-3 baseline: a blocking span-timed gather phase, then compute — the before side of the exposed-comm delta")
+    param_prefetch: int = Field(1, ge=0, le=8, description="layers of ZeRO-3 param gather issued ahead of the forward (double-buffered at 1; 0 disables the layer-scan restructure; clamped below the model's layer count)")
+    grad_reduce: str = Field("scan", description="'scan' = per-block gradient reduce-scatter inside the backward scan (overlapped with backward remat); 'post' = one fused post-backward reduction (the pre-overlap layout)")
+    remat_gather: bool = Field(True, description="recompute (re-gather) the prefetched params in the backward pass instead of saving L gathered layer slices — bounded memory, one extra gather per layer in backward")
+    scheduler_flags: bool = Field(True, description="append the XLA latency-hiding scheduler / async-collective-fusion flag preset to XLA_FLAGS at engine init (TPU scheduler flags; ds_report shows the live set — a backend initialized before engine init only hands them to launcher children)")
+    async_checkpoint: bool = Field(True, description="save_checkpoint takes a device-side snapshot copy and runs the device→host transfer + verified orbax/manifest write on a background thread — checkpoint badput stops charging the step, at the cost of one extra state copy resident until the write drains")
+
+    @field_validator("schedule")
+    @classmethod
+    def _schedule_known(cls, v):
+        if v not in ("overlapped", "serial"):
+            raise ValueError(f"overlap.schedule must be 'overlapped' or "
+                             f"'serial', got {v!r}")
+        return v
+
+    @field_validator("grad_reduce")
+    @classmethod
+    def _grad_reduce_known(cls, v):
+        if v not in ("scan", "post"):
+            raise ValueError(f"overlap.grad_reduce must be 'scan' or 'post', "
+                             f"got {v!r}")
+        return v
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Fault-tolerant serving front-end (deepspeed_tpu/serving/ +
     ``bin/ds_serve``): a request-lifecycle manager around the inference
@@ -621,6 +667,11 @@ class DeepSpeedConfig:
         # package (never imported, no compile listener)
         self.goodput = GoodputConfig(**pd.get("goodput", {}))
         self.goodput_present = "goodput" in pd
+        # presence matters, same contract again: no block, no overlap
+        # module (never imported; step builder + models' layer scan stay
+        # byte-identical, checkpoint path untouched)
+        self.overlap = OverlapConfig(**pd.get("overlap", {}))
+        self.overlap_present = "overlap" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -688,7 +739,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

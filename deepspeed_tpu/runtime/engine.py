@@ -389,6 +389,21 @@ class DeepSpeedEngine:
             raise ValueError("NVMe optimizer offload supports bf16/fp32 only "
                              "(fp16 dynamic loss scaling is a device-side loop)")
 
+        # ---- overlap engine (hide ZeRO collectives behind compute) -------
+        # runtime/overlap.py: prefetched per-block ZeRO-3 gathers
+        # (double-buffered layer scan), per-block grad reduce-scatter in
+        # the backward scan, the XLA latency-hiding scheduler preset, async
+        # checkpoint snapshots — or the measured un-overlapped "serial"
+        # schedule whose gather phase lands as a comm span. STRICT no-op
+        # when the block is absent: the module is never imported, the step
+        # builder and the models' layer scan trace byte-identically, and
+        # the checkpoint path is untouched (asserted in tests).
+        self._overlap = None
+        if self._config.overlap_present and self._config.overlap.enabled:
+            from deepspeed_tpu.runtime.overlap import OverlapEngine
+
+            self._overlap = OverlapEngine(self, self._config.overlap)
+
         # ---- materialize state sharded ----------------------------------
         self.state, self.state_shardings = self._init_state(init_fn, param_shapes, seed_key)
 
@@ -711,6 +726,8 @@ class DeepSpeedEngine:
         self._compiled_eval = None
         self._compiled_accum = None
         self._compiled_loss_grads = {}
+        if getattr(self, "_overlap", None) is not None:
+            self._overlap.invalidate_compiled()
         if hasattr(self, "_gen_compiled"):      # hybrid engine generation
             self._gen_compiled = {}
 
@@ -1068,12 +1085,18 @@ class DeepSpeedEngine:
                               loss_scale=scale, overflow=~finite)
         return new_state, metrics
 
-    def _accumulated_loss_grads(self, state: TrainState, batch, gas: int, scale):
+    def _accumulated_loss_grads(self, state: TrainState, batch, gas: int,
+                                scale, fwd_params=None):
         """Mean loss + mean grads over the accumulation window — shared by the
         fused train step and the NVMe host-step path (gas>1: lax.scan over
-        microbatches, reference engine grad-accumulation semantics)."""
+        microbatches, reference engine grad-accumulation semantics).
+        ``fwd_params`` overrides the forward's params (the overlap engine's
+        serial schedule feeds the pre-gathered copy; grads then fall out in
+        the gathered layout and the grad-spec constraint does the reduce)."""
         plan = self.plan
-        params_c = self._compute_params(state.params, step=state.step)
+        params_c = self._compute_params(
+            state.params if fwd_params is None else fwd_params,
+            step=state.step)
         if gas == 1:
             rng = jax.random.fold_in(state.rng, state.step)
             return self._micro_loss_and_grads(params_c, batch, rng, scale,
@@ -1118,11 +1141,23 @@ class DeepSpeedEngine:
             lambda g: (g.astype(jnp.float32) / gas).astype(g.dtype), acc)
 
     def _build_train_batch_fn(self, gas: int):
-        """Fused train step: scan over gradient-accumulation microbatches."""
+        """Fused train step: scan over gradient-accumulation microbatches.
+        With the overlap engine armed, the loss/grad trace runs under its
+        layer-scan override (runtime/overlap.py): per-block ZeRO-3 gathers
+        double-buffered one layer ahead, per-block reduce-scatter in the
+        backward scan. The override is trace-time only — installed around
+        the body's execution during jit tracing (and the ds_doctor
+        abstract re-trace, so the collective fingerprints see the same
+        schedule the engine compiles)."""
+        overlap = self._overlap
 
         def step_fn(state: TrainState, batch):
             scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
-            mean_loss, grads = self._accumulated_loss_grads(state, batch, gas, scale)
+            if overlap is None:
+                mean_loss, grads = self._accumulated_loss_grads(state, batch, gas, scale)
+            else:
+                with overlap.scan_context():
+                    mean_loss, grads = self._accumulated_loss_grads(state, batch, gas, scale)
             new_state, metrics = self._apply_grads(state, grads, mean_loss)
             return new_state, metrics
 
@@ -1443,6 +1478,13 @@ class DeepSpeedEngine:
                 phase = self.optimizer.phase_for_step(getattr(self, "_host_step", 0))
                 with self.mesh:
                     self.state, metrics = self._get_compiled_onebit(gas, phase)(self.state, batch)
+            elif self._overlap is not None and self._overlap.schedule == "serial":
+                # the measured un-overlapped ZeRO-3 schedule: a blocking,
+                # span-timed all-gather phase, then the compute program —
+                # what `overlap.schedule: "overlapped"` removes from the
+                # host timeline (runtime/overlap.py module docstring)
+                self.state, metrics = self._overlap.serial_step(
+                    self.state, batch, gas)
             else:
                 with self.mesh:
                     self.state, metrics = self._get_compiled_train_batch(gas)(self.state, batch)
@@ -2057,6 +2099,15 @@ class DeepSpeedEngine:
         self._touch_heartbeat_now()
         with _telemetry.get_tracer().span("save_checkpoint", cat="checkpoint"):
             try:
+                if self._overlap is not None and self._overlap.async_checkpoint:
+                    # overlap.async_checkpoint: this span covers only the
+                    # device-side snapshot copy; the device→host transfer
+                    # + verified write run on a background thread whose
+                    # span is tagged background=True (the goodput ledger
+                    # does not charge it to the step)
+                    return self._overlap.save_checkpoint_async(
+                        save_dir, tag=tag, client_state=client_state,
+                        save_latest=save_latest)
                 return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
                                               save_latest=save_latest)
             finally:

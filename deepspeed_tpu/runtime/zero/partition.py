@@ -328,6 +328,19 @@ def partition_report(plan: ShardingPlan, param_shapes: Any) -> str:
             axes.update(_axes_of(e))
         if any(a in plan.dp_axes for a in axes):
             n_sharded += n
+    if not plan.dp_axes:
+        # one-chip / no-dp mesh: "0.0% dp-sharded over axes ()" reads like
+        # a sharding bug when it is just a world of one — say WHY instead
+        dp_world = int(np.prod([plan.mesh.shape.get(a, 1)
+                                for a in DP_AXES] or [1]))
+        why = ("world size 1 — nothing to shard across"
+               if dp_world <= 1 else
+               "the configured shard axes have size 1 on this mesh")
+        return (f"ZeRO stage {plan.zero_stage}: {n_total/1e6:.1f}M params, "
+                f"dp sharding inactive ({why}); params/optimizer state "
+                "stay whole on each chip (expected on this topology, not "
+                "a sharding bug — the ZeRO placement activates when a "
+                "data-parallel mesh axis has size > 1)")
     pct = 100.0 * n_sharded / max(1, n_total)
     msg = (f"ZeRO stage {plan.zero_stage}: {n_total/1e6:.1f}M params, "
            f"{pct:.1f}% dp-sharded over axes {plan.dp_axes}")

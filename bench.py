@@ -449,6 +449,11 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         # of its own timed window, and ds_perf gate gates the resulting
         # goodput_fraction alongside the headline
         ds_config["goodput"] = {}
+    if SMOKE:
+        # the CPU dry run also drives the rewind ladder's tier-0 ring
+        # (snapshots every step at this size), so a broken snapshot path
+        # fails the smoke instead of the next real preemption
+        ds_config["rewind"] = {"ram_interval": 1, "keep": 1}
 
     model = model_cls(config)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)

@@ -49,7 +49,8 @@ def _block_models() -> Dict[str, type]:
         "gradient_compression": C.GradientCompressionConfig,
         "eigenvalue": C.EigenvalueConfig,
         "progressive_layer_drop": C.PLDConfig,
-        "resilience": C.ResilienceConfig, "watchdog": C.WatchdogConfig,
+        "resilience": C.ResilienceConfig, "rewind": C.RewindConfig,
+        "watchdog": C.WatchdogConfig,
         "telemetry": C.TelemetryConfig, "analysis": C.AnalysisConfig,
         "profiling": C.ProfilingConfig, "perf": C.PerfConfig,
         "serving": C.ServingConfig, "goodput": C.GoodputConfig,
@@ -248,6 +249,36 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "model's layer count — validate the trade with the ds_prof "
                 "memory census",
                 "overlap.param_prefetch")
+    rw = cfg.rewind
+    if "rewind" in pd and rw.enabled:
+        if not cfg.resilience.verify_on_load:
+            add("warning",
+                "rewind with resilience.verify_on_load=false: the restore "
+                "ladder prefers an emergency_step<N> tag over a stale "
+                "'latest' only because the tag VERIFIES — with "
+                "verification off, a truncated emergency flush (a host "
+                "reclaimed mid-write) would be restored instead of walked "
+                "past",
+                "rewind vs resilience.verify_on_load")
+        sent = cfg.resilience.sentinel
+        if sent.enabled and sent.patience >= rw.ram_interval * rw.keep:
+            add("warning",
+                f"resilience.sentinel.patience ({sent.patience}) >= "
+                f"rewind.ram_interval × keep ({rw.ram_interval} × {rw.keep}"
+                f" = {rw.ram_interval * rw.keep}): by the time the sentinel "
+                "trips, every tier-0 RAM snapshot in the ring may already "
+                "hold the diverging trajectory — the rewind would land "
+                "inside the cliff; raise rewind.keep or lower "
+                "rewind.ram_interval",
+                "resilience.sentinel.patience vs rewind.ram_interval")
+        if rw.emergency_save and not cfg.elasticity_config.enabled:
+            add("info",
+                "rewind.emergency_save is flushed by the elastic agent's "
+                "preemption watch (DSElasticAgent / bin/ds_elastic): "
+                "without an agent or launcher supervising the run, nothing "
+                "delivers the flush when SIGTERM lands — tier-0 RAM "
+                "snapshots and the sentinel's in-RAM rewind still work",
+                "rewind.emergency_save vs elasticity.enabled")
     gp = cfg.goodput
     if "goodput" in pd and gp.enabled and not (tel.enabled and tel.trace):
         add("warning",

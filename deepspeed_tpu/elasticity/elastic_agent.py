@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import os
 import signal
+import sys
 import time
 from typing import Any, Callable, Iterable, Optional
 
@@ -84,6 +85,9 @@ class DSElasticAgent:
         self._preempted = False
         self.restart_count = 0
         self.engine = None
+        # the failure record awaiting its recovery stamp (tier/steps_lost
+        # land after the NEXT successful bring-up restores)
+        self._pending_restart_record = None
         if install_signal_handlers:
             self._install_handlers()
             self._install_stack_dump_signal()
@@ -168,7 +172,64 @@ class DSElasticAgent:
             log_dist(f"elastic agent: resumed at step "
                      f"{int(self.engine.state.step)} on "
                      f"{self.engine.mesh.shape}", ranks=[0])
+        # runs on the NON-resume path too: a failure record whose restart
+        # starts fresh (nothing to restore) must still persist, bare
+        self._stamp_recovery()
         return self.engine
+
+    def _stamp_recovery(self):
+        """Merge the restore ladder's recovery facts ({tier, snapshot_step,
+        steps_lost, restore_s} — stamped by the load path on every
+        successful restore) into the goodput restart record: the pending
+        record from the failure that caused this bring-up, or a fresh
+        'resume' record when this process starts straight from a
+        checkpoint (the preemption→emergency-save→new-process path).
+        Records persist to restart_log.jsonl AFTER the stamp, so
+        ``ds_prof goodput`` / ``ds_top`` see the tier and steps_lost."""
+        rec = getattr(self.engine, "_last_recovery", None) or {}
+        restored_step = int(self.engine.state.step)
+        pending = self._pending_restart_record
+        self._pending_restart_record = None
+        if pending is None and not rec:
+            return
+        if not rec:
+            # fresh (non-resume) bring-up after a failure: nothing was
+            # recovered, but the failure record must not be lost
+            self._persist_restart_record(pending)
+            return
+        if pending is None:
+            pending = {"restart": self.restart_count,
+                       "error": f"resume from {rec.get('tier', '?')} tier",
+                       "step": restored_step, "backoff_s": 0.0,
+                       "ts": time.time()}
+            self.restart_log.append(pending)
+        pending.update({
+            "tier": rec.get("tier"),
+            "snapshot_step": rec.get("snapshot_step", restored_step),
+            "restore_s": rec.get("restore_s"),
+        })
+        steps_lost = rec.get("steps_lost")
+        if steps_lost is None and pending.get("step") is not None:
+            # the failing step minus where the ladder put us back
+            steps_lost = max(0, int(pending["step"]) - restored_step)
+        pending["steps_lost"] = steps_lost
+        self._persist_restart_record(pending)
+
+    def _ram_tier_available(self) -> bool:
+        """Does the process-global tier-0 ring hold a snapshot? Checked
+        WITHOUT importing the rewind module (the strict-no-op contract:
+        if it was never imported, no snapshot can exist). An agent pinned
+        to an explicit ``tag`` never counts the ring: the load path's
+        explicit-tag contract refuses to substitute any other source, so
+        treating the ring as resumable would wedge the restart loop on
+        a load that can only return nothing."""
+        if self.tag is not None:
+            return False
+        mod = sys.modules.get("deepspeed_tpu.resilience.rewind")
+        try:
+            return bool(mod and mod.ram_snapshots())
+        except Exception:
+            return False
 
     def _has_checkpoint(self) -> bool:
         """A checkpoint exists iff a tag this agent WILL load verifies as
@@ -191,6 +252,32 @@ class DSElasticAgent:
     def _checkpoint(self):
         self.engine.save_checkpoint(self.save_dir, tag=self.tag)
 
+    def _preemption_checkpoint(self):
+        """The stop-boundary save inside the preemption warning window.
+        With the rewind ladder armed, the EMERGENCY path runs instead of
+        the ordinary checkpoint: a fresh tier-0 snapshot flushed through
+        the verified manifest path as an ``emergency_step<N>`` tag — one
+        npz write, no orbax collective, sized for Cloud TPU's
+        tens-of-seconds budget. Falls back to the ordinary verified
+        checkpoint when the ladder is absent/disabled or the flush
+        fails."""
+        rm = getattr(self.engine, "_rewind", None)
+        if self.tag is not None:
+            # pinned-tag agents resume ONLY from that tag (the load path's
+            # explicit-tag contract): an emergency_step<N> tag would never
+            # be considered at resume — write the real thing instead
+            rm = None
+        if rm is not None and rm.emergency_enabled:
+            tag = rm.emergency_save(self.save_dir)
+            if tag is not None:
+                log_dist(f"elastic agent: emergency snapshot {tag!r} "
+                         "written; the restore ladder will prefer it over "
+                         "a stale 'latest'", ranks=[0])
+                return
+            logger.warning("elastic agent: emergency save failed; falling "
+                           "back to the ordinary checkpoint")
+        self._checkpoint()
+
     # --------------------------------------------------------------- run
     def run(self, batches: Iterable, num_steps: int,
             step_callback: Optional[Callable[[int, float], None]] = None) -> dict:
@@ -201,7 +288,10 @@ class DSElasticAgent:
         re-created per restart attempt via iter()). Returns a status dict.
         """
         batches_factory = batches if callable(batches) else (lambda: iter(batches))
-        resume = self._has_checkpoint()
+        # the RAM tier counts as "something to resume from": an in-process
+        # restart after a step failure must not train fresh weights just
+        # because no disk checkpoint interval was ever reached
+        resume = self._has_checkpoint() or self._ram_tier_available()
         try:
             return self._run_supervised(batches, batches_factory, num_steps,
                                         step_callback, resume)
@@ -255,7 +345,7 @@ class DSElasticAgent:
                 self._checkpoint()
                 return self._status("complete", engine)
             except PreemptionSignal:
-                self._checkpoint()
+                self._preemption_checkpoint()
                 log_dist("elastic agent: preemption checkpoint written; "
                          "exiting cleanly", ranks=[0])
                 return self._status("preempted", self.engine)
@@ -287,6 +377,12 @@ class DSElasticAgent:
                 from deepspeed_tpu import telemetry
 
                 telemetry.get_registry().counter("resilience/elastic_restarts").inc()
+                if self._pending_restart_record is not None:
+                    # the PREVIOUS failure's record never got its recovery
+                    # stamp (bring-up itself failed) — persist it bare
+                    # rather than silently dropping a restart from the log
+                    self._persist_restart_record(self._pending_restart_record)
+                    self._pending_restart_record = None
                 delay = self.restart_backoff.next_delay()
                 record = {
                     "restart": self.restart_count,
@@ -299,14 +395,20 @@ class DSElasticAgent:
                     "ts": time.time(),
                 }
                 self.restart_log.append(record)
-                self._persist_restart_record(record)
+                # persistence is DEFERRED to the next successful bring-up
+                # (_stamp_recovery), so the on-disk record carries the
+                # recovery's {tier, snapshot_step, steps_lost, restore_s};
+                # a run that gives up persists the bare record below
+                self._pending_restart_record = record
                 logger.warning(f"elastic agent: step failure ({e}); "
                                f"restart {self.restart_count}/{self.max_restarts} "
                                f"after {delay:.2f}s backoff")
                 if self.restart_count > self.max_restarts:
+                    self._persist_restart_record(record)
+                    self._pending_restart_record = None
                     raise
                 # one verification pass per restart: _bring_up trusts this
-                resume = self._has_checkpoint()
+                resume = self._has_checkpoint() or self._ram_tier_available()
                 self.engine = None
                 time.sleep(delay)
 
@@ -334,6 +436,15 @@ class DSElasticAgent:
             logger.warning(f"elastic agent: restart_log append failed: {e}")
 
     def _status(self, status: str, engine) -> dict:
+        if status in ("complete", "preempted"):
+            # the tier-0 ring's validity window is THIS supervised run:
+            # a completed (or emergency-flushed) run must not leave
+            # snapshots a LATER run in the same process could mistake
+            # for its own resume point (the ring is process-global so
+            # in-run restarts can reach it — that need ends here)
+            mod = sys.modules.get("deepspeed_tpu.resilience.rewind")
+            if mod is not None:
+                mod.clear_ram_snapshots()
         return {"status": status,
                 "final_step": int(engine.state.step),
                 "restarts": self.restart_count,

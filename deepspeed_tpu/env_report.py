@@ -160,6 +160,59 @@ def kernel_report():
     return rows
 
 
+def rewind_section(args):
+    """``ds_report rewind <save_dir>`` — the restore ladder's view of a
+    checkpoint directory: every candidate tag with its tier (emergency vs
+    ordinary), step, verification verdict, and which one the ladder would
+    pick. The tier-0 RAM ring is process-local and therefore invisible
+    here (its status lives in the run's own telemetry — `ds_top` /
+    `ds_metrics` render the rewind line)."""
+    from deepspeed_tpu.resilience.manifest import (candidate_tags,
+                                                   read_latest, tag_step,
+                                                   verify_tag)
+
+    if not args:
+        print("usage: ds_report rewind <checkpoint save_dir>",
+              file=sys.stderr)
+        return 2
+    save_dir = os.path.abspath(args[0])
+    if not os.path.isdir(save_dir):
+        print(f"ds_report rewind: no such directory: {save_dir}",
+              file=sys.stderr)
+        return 2
+    tags = candidate_tags(save_dir)
+    latest = read_latest(save_dir)
+    print(f"restore ladder for {save_dir}")
+    print("(tier-0 RAM snapshots are process-local: see the run's ds_top/"
+          "ds_metrics rewind line)")
+    if not tags:
+        print("  no candidate tags")
+        return 1
+    picked = None
+    rows = []
+    for tag in tags:
+        tag_dir = os.path.join(save_dir, tag)
+        emergency = os.path.isfile(os.path.join(tag_dir, "state",
+                                                "rewind_state.npz"))
+        tier = "tier-1 emergency" if emergency else "tier-2 checkpoint"
+        ok, reason = verify_tag(tag_dir)
+        parsed = tag_step(tag)
+        step = str(parsed) if parsed >= 0 else "?"
+        mark = ""
+        if ok and picked is None:
+            picked = tag
+            mark = "  <- ladder picks"
+        pointer = "  (= 'latest')" if tag == latest else ""
+        rows.append(f"  {tag:<28} {tier:<18} step {step:<8} "
+                    f"{GREEN_OK if ok else RED_NO}"
+                    f"{'' if ok else ' (' + reason + ')'}{pointer}{mark}")
+    print("\n".join(rows))
+    if picked is None:
+        print("  NOTHING restorable — every candidate failed verification")
+        return 1
+    return 0
+
+
 def goodput_section(args):
     """Render the newest session trace's bucket table from a telemetry
     output dir (or an explicit trace file)."""
@@ -202,6 +255,10 @@ def main(args=None):
         # goodput bucket table (job-level cross-restart stitching is
         # `ds_prof goodput`'s job)
         return goodput_section(args[1:])
+    if args and args[0] == "rewind":
+        # `ds_report rewind <save_dir>` — the restore ladder's view of a
+        # checkpoint dir (tiers, verification, what would be picked)
+        return rewind_section(args[1:])
     line = "-" * 72
     print(line)
     print("deepspeed_tpu environment report")

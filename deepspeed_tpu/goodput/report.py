@@ -226,7 +226,14 @@ def build_job_report(trace_paths: List[str],
                     restarts.append({
                         "rank": rank, "gap_s": gap_s,
                         "after": a["path"], "before": b["path"],
-                        "reasons": [r.get("error", "?") for r in reasons]})
+                        "reasons": [r.get("error", "?") for r in reasons],
+                        # the rewind ladder's recovery facts, when the
+                        # agent stamped them (PR 10): which tier served
+                        # the restore and what the failure actually cost
+                        "recoveries": [
+                            {k: r.get(k) for k in ("tier", "snapshot_step",
+                                                   "steps_lost", "restore_s")}
+                            for r in reasons if r.get("tier")]})
         per_rank[rank] = {
             "sessions": len(ledgers),
             "buckets_us": buckets,
@@ -292,6 +299,15 @@ def render_goodput_report(report: Dict[str, Any],
                     f"(before {os.path.basename(r['before'])})")
             if r["reasons"]:
                 line += " — " + "; ".join(r["reasons"])
+            for rec in r.get("recoveries") or []:
+                line += (f" [recovered from {rec.get('tier', '?')} tier"
+                         + (f" @step {rec['snapshot_step']}"
+                            if rec.get("snapshot_step") is not None else "")
+                         + (f", {rec['steps_lost']} step(s) lost"
+                            if rec.get("steps_lost") is not None else "")
+                         + (f", restore {rec['restore_s']:.3g}s"
+                            if rec.get("restore_s") is not None else "")
+                         + "]")
             out.append(line)
     if report["warnings"]:
         out.append("")

@@ -16,7 +16,54 @@ import json
 import os
 import sys
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+# mirrors resilience/rewind.py TIER_CODES (kept inline: this module is
+# pure stdlib and file-loaded by jax-free CLIs)
+REWIND_TIERS = {0: "none", 1: "ram", 2: "emergency", 3: "disk"}
+
+
+def render_rewind_line(gauges: Dict[str, float], counters: Dict[str, float],
+                       step=None) -> Optional[str]:
+    """The ds_rewind status line: per-tier snapshot age + the last
+    recovery (tier, steps lost, restore time). The ONE renderer ds_top
+    frames and the ``ds_metrics`` summary footer share — it lives here
+    (not goodput/top.py) because this module is the pure-stdlib one
+    ds_metrics already file-loads without dragging in the package."""
+    if not any(k.startswith("rewind/") for k in gauges) and \
+            not any(k.startswith("rewind/") for k in counters):
+        return None
+    parts = ["rewind:"]
+    snap_step = gauges.get("rewind/ram_snapshot_step")
+    if snap_step is not None:
+        seg = f"ram tier @step {int(snap_step)}"
+        if step is not None:
+            seg += f" (age {max(0, int(step) - int(snap_step))} step(s))"
+        held = gauges.get("rewind/ram_snapshots_held")
+        if held:
+            seg += f", {int(held)} held"
+        mb = gauges.get("rewind/ram_bytes")
+        if mb:
+            seg += f", {mb / 2**20:.1f} MiB"
+        parts.append(seg)
+    else:
+        parts.append("ram tier empty")
+    em = sum(v for k, v in counters.items()
+             if k.startswith("rewind/emergency_saves"))
+    if em:
+        parts.append(f"emergency saves {int(em)}")
+    tier_code = gauges.get("rewind/last_recovery_tier")
+    if tier_code:
+        seg = ("last recovery: "
+               f"{REWIND_TIERS.get(int(tier_code), '?')} tier")
+        if gauges.get("rewind/last_recovery_snapshot_step") is not None:
+            seg += f" @step {int(gauges['rewind/last_recovery_snapshot_step'])}"
+        if gauges.get("rewind/last_recovery_steps_lost") is not None:
+            seg += f", {int(gauges['rewind/last_recovery_steps_lost'])} step(s) lost"
+        if gauges.get("rewind/last_recovery_restore_s") is not None:
+            seg += f", restore {gauges['rewind/last_recovery_restore_s']:.3g}s"
+        parts.append(seg)
+    return "  ".join(parts)
 
 
 class JSONLTailer:

@@ -17,7 +17,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
-from deepspeed_tpu.goodput.tail import MetricsFollower
+from deepspeed_tpu.goodput.tail import MetricsFollower, render_rewind_line
 from deepspeed_tpu.goodput.taxonomy import GOODPUT_BUCKETS
 
 
@@ -127,6 +127,10 @@ def render_frame(records: List[dict], source: Optional[str] = None,
         out.append("goodput: (no complete step yet)")
     else:
         out.append("goodput: n/a — enable the ds_config `goodput` block")
+
+    rew = render_rewind_line(g, s["counters"], step=s["step"])
+    if rew:
+        out.append(rew)
 
     if s["comm_skew"] is not None:
         ratio, op, p50, mx = s["comm_skew"]

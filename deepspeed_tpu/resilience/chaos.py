@@ -34,6 +34,12 @@ without a real TPU fault):
   bounded stall under the watchdog deadline (latency, not a hang);
 * ``kill`` (``kill_at``) — SIGKILL the process mid-step: the launcher's
   liveness/heartbeat supervision is the only thing that can notice.
+* ``preempt`` (``preempt_at`` scripted / ``preempt_rate`` randomized) —
+  deliver SIGTERM to THIS process, exactly what Cloud TPU sends in the
+  preemption warning window: the elastic agent's signal handler sets its
+  flag, the run stops at the next sync boundary, and the rewind ladder's
+  emergency-save path (``rewind.emergency_save``) is deterministically
+  drillable without a real reclaim.
 
 One fault class targets the STATIC analyzer instead of the runtime:
 ``collective_mismatch`` perturbs this rank's ds_doctor-recorded
@@ -88,6 +94,8 @@ class ChaosInjector:
                  hang_at: Optional[Dict[str, Sequence[int]]] = None,
                  delay_at: Optional[Dict[str, Sequence[int]]] = None,
                  kill_at: Optional[Dict[str, Sequence[int]]] = None,
+                 preempt_at: Optional[Dict[str, Sequence[int]]] = None,
+                 preempt_rate: float = 0.0,
                  collective_mismatch: bool = False,
                  collective_mismatch_rank: int = -1):
         self._rng = random.Random(seed)
@@ -105,6 +113,8 @@ class ChaosInjector:
         self.hang_at = {k: set(v) for k, v in (hang_at or {}).items()}
         self.delay_at = {k: set(v) for k, v in (delay_at or {}).items()}
         self.kill_at = {k: set(v) for k, v in (kill_at or {}).items()}
+        self.preempt_at = {k: set(v) for k, v in (preempt_at or {}).items()}
+        self.preempt_rate = float(preempt_rate)
         self.collective_mismatch = bool(collective_mismatch)
         self.collective_mismatch_rank = int(collective_mismatch_rank)
         self._counts = defaultdict(int)
@@ -117,6 +127,7 @@ class ChaosInjector:
                   truncate_rate=cfg.truncate_rate, delay_rate=cfg.delay_rate,
                   max_delay_s=cfg.max_delay_s, hang_rate=cfg.hang_rate,
                   hang_s=cfg.hang_s, ops=cfg.ops or None,
+                  preempt_rate=cfg.preempt_rate,
                   collective_mismatch=cfg.collective_mismatch,
                   collective_mismatch_rank=cfg.collective_mismatch_rank)
         inj.source = "config"
@@ -154,9 +165,10 @@ class ChaosInjector:
         if self.ops is not None:
             return op in self.ops
         if any(op in d for d in (self.fail_at, self.truncate_at,
-                                 self.hang_at, self.delay_at, self.kill_at)):
+                                 self.hang_at, self.delay_at, self.kill_at,
+                                 self.preempt_at)):
             return True
-        return self.hang_rate > 0
+        return self.hang_rate > 0 or self.preempt_rate > 0
 
     def _count(self, op: str, action: str):
         from deepspeed_tpu import telemetry
@@ -194,6 +206,24 @@ class ChaosInjector:
             self._count(op, "kill")
             logger.warning(f"chaos: injected SIGKILL on {op} #{n} ({path})")
             _os.kill(_os.getpid(), _signal.SIGKILL)
+        # preempt: the Cloud TPU warning-window signal — SIGTERM to self.
+        # The elastic agent's handler sets its flag and RETURNS, so the
+        # step completes and the agent stops at the next sync boundary
+        # (where the emergency-save path runs); step-oriented like the
+        # randomized hangs — a rate never hits checkpoint I/O ops.
+        rate_preempt = (self.preempt_rate
+                        and (self.ops is not None
+                             or op in ("train_step", "decode_step"))
+                        and self._rng.random() < self.preempt_rate)
+        if n in self.preempt_at.get(op, ()) or rate_preempt:
+            import os as _os
+            import signal as _signal
+
+            self.log.append((op, "preempt", path))
+            self._count(op, "preempt")
+            logger.warning(f"chaos: injected SIGTERM (preempt) on {op} #{n} "
+                           f"({path})")
+            _os.kill(_os.getpid(), _signal.SIGTERM)
         # randomized hangs are step-oriented (the targets() contract): with
         # ops unset they never hit checkpoint I/O, where a default-hang_s
         # stall would run OUTSIDE any armed watchdog region — an explicit

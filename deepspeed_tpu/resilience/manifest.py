@@ -166,11 +166,20 @@ def _verify_tag(tag_dir: str) -> Tuple[bool, str]:
     return True, "ok"
 
 
+def tag_step(tag: str) -> int:
+    """The training step a tag name encodes (``global_step<N>`` /
+    ``emergency_step<N>`` style — any trailing integer), -1 when none.
+    THE step-parse rule: candidate ordering, the rewind ladder's
+    freshness gate, and ``ds_report rewind`` all call this, so they can
+    never disagree about a tag's step."""
+    m = _STEP_RE.search(tag)
+    return int(m.group(1)) if m else -1
+
+
 def _tag_sort_key(save_dir: str, tag: str):
     """Newest-first ordering: by step parsed from the tag name
     (``global_step<N>``-style), falling back to directory mtime."""
-    m = _STEP_RE.search(tag)
-    step = int(m.group(1)) if m else -1
+    step = tag_step(tag)
     try:
         mtime = os.path.getmtime(os.path.join(save_dir, tag))
     except OSError:

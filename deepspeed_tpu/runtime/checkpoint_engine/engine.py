@@ -141,12 +141,19 @@ def capture_host_meta(engine) -> dict:
     the background thread would pair step-N weights with step-N+k
     LR-schedule/sampler positions (silent wrong-resume)."""
     sampler = getattr(engine, "_data_sampler", None)
+    loader = getattr(engine, "dataloader", None)
     return {
         "global_samples": engine.global_samples,
         "micro_steps": engine.micro_steps,
         "lr_scheduler": (engine.lr_scheduler.state_dict()
                          if engine.lr_scheduler is not None else None),
         "data_sampler": sampler.state_dict() if sampler is not None else None,
+        # resumable dataloader position (epoch + batch index): replayed
+        # steps after a rewind/restore consume the SAME batches —
+        # exactly-once sample accounting instead of a silent re-draw
+        "data_loader": (loader.state_dict()
+                        if loader is not None and hasattr(loader, "state_dict")
+                        else None),
     }
 
 
@@ -243,6 +250,9 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             # curriculum data sampler (reference ds_sampler state in
             # client_sd): rng + draw order + position → mid-epoch resume
             "data_sampler": sampler_sd,
+            # dataloader position — the rewind ladder's exactly-once
+            # sample accounting rides every tier, including this one
+            "data_loader": host_meta.get("data_loader"),
         }
         manifest_files["client_state.json"] = json.dumps(
             meta, default=str).encode("utf-8")
@@ -339,29 +349,133 @@ def load_inference_params(load_dir: str, abstract_params: Any,
                             for k, v in restored_flat.items()})
 
 
+def apply_restored_meta(engine, meta: dict):
+    """Apply a restored checkpoint's host-side progress facts to the live
+    engine: sample/step counters, LR schedule, curriculum sampler,
+    dataloader position, and the host-step mirror that drives curriculum
+    difficulty + logging cadence. Shared by every tier of the restore
+    ladder (orbax tags, emergency tags, RAM snapshots)."""
+    if meta:
+        engine.global_samples = meta.get("global_samples", 0) or 0
+        engine.micro_steps = meta.get("micro_steps", 0) or 0
+        if engine.lr_scheduler is not None and meta.get("lr_scheduler"):
+            engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        sampler_sd = meta.get("data_sampler")
+        if sampler_sd:
+            if getattr(engine, "_data_sampler", None) is not None:
+                engine._data_sampler.load_state_dict(sampler_sd)
+            else:
+                # loader not built yet: deepspeed_io applies it on creation
+                engine._pending_sampler_state = sampler_sd
+        loader_sd = meta.get("data_loader")
+        if loader_sd:
+            loader = getattr(engine, "dataloader", None)
+            if loader is not None and hasattr(loader, "load_state_dict"):
+                try:
+                    loader.load_state_dict(loader_sd)
+                except ValueError as e:
+                    # a changed dataset/batch geometry: resuming the old
+                    # position would mis-account samples — start the
+                    # loader fresh and say so
+                    logger.warning(f"dataloader position NOT restored ({e}); "
+                                   "the loader starts from its beginning")
+            else:
+                logger.warning(
+                    "checkpoint carries a dataloader position but this "
+                    "engine has no loader to apply it to (pass "
+                    "training_data= or set engine.dataloader before "
+                    "load_checkpoint for exactly-once sample accounting)")
+    # host-side step counter drives curriculum difficulty + logging cadence:
+    # resume it from the restored device step, or a resumed run would replay
+    # the whole curriculum ramp from min difficulty
+    engine._host_step = int(engine.state.step)
+    sched = getattr(engine, "curriculum_scheduler", None)
+    if sched is not None and getattr(sched, "schedule_type", None) != "custom":
+        # custom schedules need the user's fn installed first; train_batch
+        # recomputes difficulty from _host_step on the next step anyway
+        sched.update_difficulty(engine._host_step + 1)
+    pld = getattr(engine, "progressive_layer_drop", None)
+    if pld is not None:
+        # the jitted step reads θ(t) from the restored state.step; re-sync the
+        # host-side reporting mirror so pld_theta() matches it after resume
+        pld.update_state(engine._host_step)
+
+
+def _best_restorable_step(load_dir: str, candidates, verify: bool,
+                          cache: dict) -> int:
+    """The step of the newest disk candidate that VERIFIES (candidates
+    arrive newest-first), -1 when none — what the RAM tier must beat to
+    win the ladder. Using an unverified candidate's step here would make
+    a corrupt newest tag evict a fresher valid RAM snapshot in favor of
+    an older disk checkpoint. Verification verdicts land in ``cache`` so
+    the candidate walk never re-hashes a tag."""
+    from deepspeed_tpu.resilience.manifest import tag_step
+
+    for cand in candidates:
+        if verify:
+            verdict = verify_tag(_ckpt_dir(load_dir, cand))
+            cache[cand] = verdict
+            if not verdict[0]:
+                continue
+        # an unparsable step (-1) offers no freshness evidence: RAM wins
+        return tag_step(cand)
+    return -1
+
+
 def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                            load_optimizer_states: bool = True,
                            load_module_only: bool = False):
-    """Verified restore with last-good fallback.
+    """Verified restore with last-good fallback — the rewind LADDER WALK.
 
-    Candidate tags are tried newest-first (an explicitly requested ``tag``
-    first): each must pass the manifest check (``resilience.verify_on_load``)
-    and then actually restore — orbax exceptions and corrupt metadata demote
-    to the next candidate rather than stranding the run. The 'latest'
-    pointer is a hint, not an authority: a tag whose save died between the
-    state commit and the pointer advance is still found and restored.
+    The freshest VERIFIED tier wins: the tier-0 host-RAM snapshot ring
+    (when the engine runs with the ``rewind`` block and the ring holds a
+    snapshot at least as new as the best disk candidate), then the disk
+    candidates newest-first — tier-1 ``emergency_step<N>`` tags restored
+    from their npz payload, tier-2 orbax tags as before. Each candidate
+    must pass the manifest check (``resilience.verify_on_load``) and then
+    actually restore — orbax exceptions, corrupt metadata, and emergency
+    snapshots whose world signature no longer matches all demote to the
+    next candidate rather than stranding the run. The 'latest' pointer is
+    a hint, not an authority: a tag whose save died between the state
+    commit and the pointer advance — or an emergency tag that never
+    advanced it — is still found and restored. Every successful restore
+    stamps ``engine._last_recovery = {tier, snapshot_step, steps_lost,
+    restore_s}``.
     """
     wait_for_pending_saves()              # an async save may still be writing
+    import time as _time
+
     import orbax.checkpoint as ocp
 
+    engine._last_recovery = None
     res = getattr(getattr(engine, "_config", None), "resilience", None)
     verify = res.verify_on_load if res is not None else True
     fallback = res.fallback_to_last_good if res is not None else True
+    rewind_mgr = getattr(engine, "_rewind", None)
 
     # the 'latest' pointer is a hint that candidate_tags deliberately
     # outranks with any newer committed auto-resume tag
     # (crash-between-commit-and-advance)
     candidates = candidate_tags(load_dir, preferred=tag)
+
+    # ---- tier-0: the host-RAM snapshot ring (rewind block only) ----------
+    # an explicit tag is a contract (see below) — the RAM tier never
+    # substitutes for it. Partial loads (load_module_only / no optimizer
+    # states) are explicit "weights from THAT source" requests the full
+    # in-RAM training state must not hijack, and a snapshot captured
+    # under a different checkpoint dir never serves a load pointed
+    # elsewhere (restore_from_ram's for_dir affinity). Otherwise the
+    # freshest verified tier wins.
+    verified_cache: dict = {}
+    if rewind_mgr is not None and tag is None and not load_module_only \
+            and load_optimizer_states:
+        info = rewind_mgr.restore_from_ram(
+            min_step=_best_restorable_step(load_dir, candidates, verify,
+                                           verified_cache),
+            for_dir=load_dir)
+        if info is not None:
+            return f"ram://step{info['snapshot_step']}", {}
+
     if tag is not None:
         # an explicit tag is a contract: restoring a DIFFERENT checkpoint
         # than the one asked for would be silent wrong-weights corruption —
@@ -381,25 +495,50 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         engine.state, engine.state_shardings)
     skipped = []
+    tier = "disk"
+    t_restore = _time.perf_counter()
     for cand in candidates:
         path = _ckpt_dir(load_dir, cand)
         if verify:
-            ok, reason = verify_tag(path)
+            cached = verified_cache.get(cand)
+            ok, reason = cached if cached is not None else verify_tag(path)
             if not ok:
                 logger.warning(f"skipping checkpoint {cand!r}: {reason}")
                 skipped.append(cand)
                 continue
+        is_emergency = os.path.isfile(
+            os.path.join(path, "state", "rewind_state.npz"))
+        if is_emergency and rewind_mgr is None:
+            # the strict no-op contract keeps the rewind module unloaded
+            # without its block — an emergency tag is then explicitly
+            # (loudly) not a candidate, never a half-understood one
+            logger.warning(
+                f"skipping emergency snapshot tag {cand!r}: the 'rewind' "
+                "ds_config block is absent (enable it to restore "
+                "preemption emergency saves)")
+            skipped.append(cand)
+            continue
         try:
-            with ocp.PyTreeCheckpointer() as ckptr:
-                restored_flat = ckptr.restore(
-                    os.path.join(path, "state"),
-                    restore_args=ocp.checkpoint_utils.construct_restore_args(_flatten_state(abstract)))
-            restored = _unflatten_like(engine.state, restored_flat)
-            meta = {}
-            meta_path = os.path.join(path, "client_state.json")
-            if os.path.isfile(meta_path):
-                with open(meta_path) as f:
-                    meta = json.load(f)
+            if is_emergency:
+                restored, meta = rewind_mgr.load_emergency_tag(path)
+                if restored is None:    # world mismatch — warned inside
+                    skipped.append(cand)
+                    continue
+                tier = "emergency"
+            else:
+                with ocp.PyTreeCheckpointer() as ckptr:
+                    restored_flat = ckptr.restore(
+                        os.path.join(path, "state"),
+                        restore_args=ocp.checkpoint_utils.construct_restore_args(_flatten_state(abstract)))
+                restored = _unflatten_like(engine.state, restored_flat)
+                meta = {}
+                meta_path = os.path.join(path, "client_state.json")
+                if os.path.isfile(meta_path):
+                    with open(meta_path) as f:
+                        meta = json.load(f)
+                tier = "disk"
+            # the curriculum sampler's admitted order rides a sidecar on
+            # BOTH tiers (json would corrupt the int64 array)
             sampler_sd = meta.get("data_sampler")
             if sampler_sd and sampler_sd.get("admitted_file"):
                 sampler_sd["admitted"] = np.load(
@@ -412,6 +551,19 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             continue
         break
     else:
+        if rewind_mgr is not None and tag is None and not load_module_only \
+                and load_optimizer_states:
+            # the disk tiers all failed: a RAM snapshot OLDER than the
+            # best (unrestorable) disk step is still infinitely better
+            # than nothing — walk the ring again without the freshness
+            # gate (dir affinity still applies)
+            info = rewind_mgr.restore_from_ram(for_dir=load_dir)
+            if info is not None:
+                logger.warning(
+                    f"no restorable disk checkpoint in {load_dir} (tried "
+                    f"{candidates}); recovered from the RAM tier @step "
+                    f"{info['snapshot_step']}")
+                return f"ram://step{info['snapshot_step']}", {}
         logger.warning(f"no restorable checkpoint in {load_dir} "
                        f"(tried {candidates}); nothing loaded")
         return None, {}
@@ -423,31 +575,19 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         state = restored
     engine.state = state
 
-    if meta:
-        engine.global_samples = meta.get("global_samples", 0)
-        engine.micro_steps = meta.get("micro_steps", 0)
-        if engine.lr_scheduler is not None and meta.get("lr_scheduler"):
-            engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-        if sampler_sd:
-            if getattr(engine, "_data_sampler", None) is not None:
-                engine._data_sampler.load_state_dict(sampler_sd)
-            else:
-                # loader not built yet: deepspeed_io applies it on creation
-                engine._pending_sampler_state = sampler_sd
-    # host-side step counter drives curriculum difficulty + logging cadence:
-    # resume it from the restored device step, or a resumed run would replay
-    # the whole curriculum ramp from min difficulty
-    engine._host_step = int(engine.state.step)
-    sched = getattr(engine, "curriculum_scheduler", None)
-    if sched is not None and getattr(sched, "schedule_type", None) != "custom":
-        # custom schedules need the user's fn installed first; train_batch
-        # recomputes difficulty from _host_step on the next step anyway
-        sched.update_difficulty(engine._host_step + 1)
-    pld = getattr(engine, "progressive_layer_drop", None)
-    if pld is not None:
-        # the jitted step reads θ(t) from the restored state.step; re-sync the
-        # host-side reporting mirror so pld_theta() matches it after resume
-        pld.update_state(engine._host_step)
+    apply_restored_meta(engine, meta)
+    rew_meta = (meta or {}).get("rewind") or {}
+    engine._last_recovery = {
+        "tier": tier,
+        "snapshot_step": int(engine.state.step),
+        # an emergency tag knows at save time how many steps it is behind
+        # the stop boundary; orbax tags leave it to the caller (the agent
+        # diffs against the failing step)
+        "steps_lost": rew_meta.get("steps_lost_at_save"),
+        "restore_s": round(_time.perf_counter() - t_restore, 4),
+    }
+    if rewind_mgr is not None:
+        rewind_mgr.note_recovery(engine._last_recovery)
     if skipped:
         log_dist(f"checkpoint fallback: restored {cand!r} after skipping "
                  f"{skipped} (corrupt/unverified)", ranks=[0])

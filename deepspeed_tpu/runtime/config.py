@@ -373,7 +373,8 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
     max_delay_s: float = Field(0.02, ge=0.0, description="upper bound of an injected delay (s)")
     hang_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-op probability of an injected interruptible HANG (watchdog detection drills)")
     hang_s: float = Field(3600.0, ge=0.0, description="duration of an injected hang (s); the watchdog is expected to fire well before it ends")
-    ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/train_step/decode_step/collective); empty = all")
+    preempt_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-step probability of an injected SIGTERM to self (the Cloud TPU preemption warning) — drills the elastic agent's preemption watch and the rewind emergency-save path")
+    ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/emergency_save/train_step/decode_step/collective); empty = all")
     collective_mismatch: bool = Field(False, description="perturb this rank's ds_doctor-recorded collective sequence (swap/mutate/phantom, seed-deterministic) so the static deadlock detector has a reproducible divergent rank to catch")
     collective_mismatch_rank: int = Field(-1, ge=-1, description="process whose recorded sequence is perturbed (-1 = every recording process)")
 
@@ -602,6 +603,35 @@ class ServingConfig(DeepSpeedConfigModel):
     max_program_variants: int = Field(8, ge=1, description="distinct (do_sample, temperature, top_k, top_p, eos) combinations the server will compile programs for; a request needing a new combination past the bound sheds with reason sampling_variant_limit — client-controlled floats must not grow compiled-program memory or serialize the worker on endless compiles")
 
 
+class RewindConfig(DeepSpeedConfigModel):
+    """ds_rewind tiered snapshots (resilience/rewind.py): a recovery
+    ladder that makes a failure cost *seconds* of work instead of a
+    checkpoint interval. Tier-0 is a cheap every-``ram_interval``-steps
+    host-RAM snapshot of the full TrainState (device→host copy plus the
+    same host-side progress facts a checkpoint records, kept in a
+    bounded in-process ring, never touching disk); tier-1 is the
+    **emergency save** — on SIGTERM/preemption the elastic agent
+    flushes the newest tier-0 snapshot through the verified
+    manifest path to local disk as an ``emergency_step<N>`` tag inside
+    the Cloud TPU warning window; tier-2 stays the ordinary verified
+    checkpoint. Restore is a ladder walk — the freshest VERIFIED tier
+    wins (RAM → emergency tag → ``latest``) — the bad-step sentinel
+    rewinds to the in-RAM tier instead of re-loading disk, snapshots
+    carry resumable dataloader state so replayed steps consume the
+    same batches exactly once, and every recovery stamps the goodput
+    restart record with ``{tier, snapshot_step, steps_lost,
+    restore_s}``. A snapshot restored on a CHANGED world size degrades
+    loudly to the verified disk tier instead of guessing. STRICT no-op
+    when the block is absent: the rewind module is never imported, zero
+    extra device copies or threads (asserted in tests). See
+    docs/CONFIG.md 'rewind' section for the tier/RPO table."""
+    enabled: bool = Field(True, description="arm the rewind manager (the block being present opts in; set false to keep the block but skip the work)")
+    ram_interval: int = Field(5, gt=0, description="take a tier-0 host-RAM snapshot every N healthy steps — the RAM-tier RPO: a recovery loses at most this many steps")
+    keep: int = Field(2, ge=1, description="tier-0 ring depth: how many RAM snapshots stay resident (cost = keep × state bytes of host RAM)")
+    emergency_save: bool = Field(True, description="on SIGTERM/preemption the elastic agent flushes the newest tier-0 snapshot through the verified manifest path to disk as an emergency_step<N> tag (the restore ladder prefers it over a stale 'latest')")
+    emergency_fresh: bool = Field(True, description="capture a fresh snapshot at the stop boundary before flushing (steps_lost 0) instead of flushing the possibly ram_interval-stale newest ring entry; false = flush-what-you-have, the fastest exit")
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Verified checkpoints + recovery policy (resilience/ package). See
     docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
@@ -646,6 +676,11 @@ class DeepSpeedConfig:
         self.aio_config = AioConfig(**pd.get("aio", {}))
         self.elasticity_config = ElasticityConfig(**pd.get("elasticity", {}))
         self.resilience = ResilienceConfig(**pd.get("resilience", {}))
+        # presence matters (same contract as `analysis`/`overlap`): no
+        # block, no rewind module (never imported, zero extra device
+        # copies or threads — the tier-0 ring does not exist)
+        self.rewind = RewindConfig(**pd.get("rewind", {}))
+        self.rewind_present = "rewind" in pd
         self.watchdog = WatchdogConfig(**pd.get("watchdog", {}))
         # presence matters: the engine's analyzer hook is a STRICT no-op
         # (package not even imported) when the block is absent
@@ -738,7 +773,7 @@ class DeepSpeedConfig:
         "csv_monitor", "pipeline", "tpu", "checkpoint", "data_types", "aio",
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
-        "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "watchdog", "analysis",
+        "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "rewind", "watchdog", "analysis",
         "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",

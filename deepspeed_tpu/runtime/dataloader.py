@@ -34,6 +34,19 @@ class RepeatingLoader:
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
 
+    # resumable position (rewind ladder): delegate to the wrapped loader
+    def state_dict(self):
+        if hasattr(self.loader, "state_dict"):
+            return self.loader.state_dict()
+        return None
+
+    def load_state_dict(self, sd):
+        if hasattr(self.loader, "load_state_dict"):
+            self.loader.load_state_dict(sd)
+            # the live iterator holds the OLD position; rebuild it so the
+            # next __next__ continues from the restored one
+            self.data_iter = iter(self.loader)
+
 
 def _default_collate(samples):
     """Stack a list of samples (dicts/tuples/arrays) into one numpy batch."""
@@ -63,6 +76,12 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.epoch = 0
         self.data_sampler = data_sampler
+        # resumable position: batches CONSUMED in the current pass (the
+        # counter advances before each yield, so a snapshot taken after
+        # processing batch b records b+1 — the replayed window after a
+        # rewind continues at b+1, never re-drawing or skipping a sample)
+        self._batch_idx = 0
+        self._resume_batch_idx: Optional[int] = None
         if data_sampler is not None:
             self.len = len(data_sampler) // self.batch_size
         else:
@@ -71,9 +90,62 @@ class DeepSpeedDataLoader:
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+        self._batch_idx = 0
+        self._resume_batch_idx = None
 
     def __len__(self):
         return self.len
+
+    # ------------------------------------------------- resumable position
+    def state_dict(self) -> dict:
+        """The loader's mid-epoch position plus the facts the order is
+        derived from. The order itself is deterministic in (seed, epoch),
+        so position + seed reproduces the exact remaining batch sequence —
+        what makes a rewind's replayed window consume the SAME batches
+        (exactly-once sample accounting). Sampler-driven loaders keep
+        their position in the sampler's own (checkpointed) state."""
+        return {
+            "epoch": self.epoch,
+            "batch_idx": self._batch_idx,
+            "batch_size": self.batch_size,
+            "seed": self.seed,
+            "shuffle": self.shuffle,
+            "drop_last": self.drop_last,
+            "dataset_size": len(self.dataset),
+            "sampler_driven": self.data_sampler is not None,
+        }
+
+    def load_state_dict(self, sd: dict):
+        """Resume iteration from a captured position. Raises ValueError
+        when the batch geometry or dataset changed — silently resuming a
+        position computed over a different index universe would repeat or
+        skip samples, the exact bug this state exists to prevent."""
+        for key, mine in (("batch_size", self.batch_size),
+                          ("seed", self.seed), ("shuffle", self.shuffle),
+                          ("drop_last", self.drop_last),
+                          ("dataset_size", len(self.dataset)),
+                          ("sampler_driven", self.data_sampler is not None)):
+            theirs = sd.get(key, mine)
+            if theirs != mine:
+                raise ValueError(
+                    f"dataloader state mismatch: {key} was {theirs!r} at "
+                    f"capture but is {mine!r} now — the sample order would "
+                    "not reproduce")
+        if self.data_sampler is not None:
+            return      # the sampler's own state carries the position
+        epoch = int(sd.get("epoch", 0))
+        idx = int(sd.get("batch_idx", 0))
+        if idx >= self.len:         # captured exactly at an epoch boundary
+            epoch, idx = epoch + 1, 0
+        self.epoch = epoch
+        self._batch_idx = idx
+        self._resume_batch_idx = idx
+
+    def _epoch_order(self):
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.default_rng(self.seed + self.epoch).shuffle(order)
+        return order
 
     def __iter__(self):
         nproc = jax.process_count()
@@ -87,15 +159,34 @@ class DeepSpeedDataLoader:
                     idx = idx[pid::nproc]
                 yield self.collate_fn([self.dataset[int(i)] for i in idx])
             return
-        n = len(self.dataset)
-        order = np.arange(n)
-        if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
-            rng.shuffle(order)
-        for b in range(self.len):
+        b = self._resume_batch_idx if self._resume_batch_idx is not None else 0
+        self._resume_batch_idx = None
+        epoch = self.epoch
+        order = self._epoch_order()
+        while b < self.len:
+            if self._resume_batch_idx is not None:
+                # a mid-iteration rewind (the sentinel / an in-RAM restore
+                # called load_state_dict while this generator is LIVE):
+                # jump back so the re-trodden steps consume the SAME
+                # batches instead of silently marching on
+                b = self._resume_batch_idx
+                self._resume_batch_idx = None
+                if self.epoch != epoch:
+                    epoch = self.epoch
+                    order = self._epoch_order()
+                continue
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
             if len(idx) < self.batch_size and self.drop_last:
-                return
+                break
             if nproc > 1:
                 idx = idx[pid::nproc]
+            self._batch_idx = b + 1
             yield self.collate_fn([self.dataset[int(i)] for i in idx])
+            b += 1
+        # a COMPLETED pass advances the epoch, so a RepeatingLoader's
+        # re-iteration draws the next epoch's order — which is also what
+        # makes a state captured exactly at the boundary (batch_idx ==
+        # len) unambiguous: the next batch anyone sees is epoch+1's
+        # first, exactly where load_state_dict resumes it
+        self.epoch = epoch + 1
+        self._batch_idx = 0

@@ -435,6 +435,19 @@ class DeepSpeedEngine:
                 spike_factor=res_cfg.sentinel.spike_factor,
                 window=res_cfg.sentinel.window,
                 max_rewinds=res_cfg.sentinel.max_rewinds)
+        # ---- rewind ladder (tiered in-memory checkpoints) ----------------
+        # resilience/rewind.py: tier-0 host-RAM snapshot ring every
+        # ram_interval steps, tier-1 emergency save on preemption, the
+        # ladder-walking restore. STRICT no-op when the ``rewind`` block
+        # is absent: the module is never imported, zero extra device
+        # copies or threads (asserted in tests) — the per-step cost of a
+        # disabled ladder is one `is None` check.
+        self._rewind = None
+        self._last_recovery = None
+        if self._config.rewind_present and self._config.rewind.enabled:
+            from deepspeed_tpu.resilience.rewind import RewindManager
+
+            self._rewind = RewindManager(self, self._config.rewind)
         from deepspeed_tpu.resilience import chaos as _chaos_mod
 
         if res_cfg.chaos.enabled:
@@ -1494,6 +1507,10 @@ class DeepSpeedEngine:
             self._post_step(metrics)
             if self._bad_step_sentinel is not None:
                 self._check_bad_step(metrics)
+            if self._rewind is not None:
+                # AFTER the sentinel: a step the sentinel flagged (or a
+                # rewound-to step) must not enter the tier-0 ring
+                self._rewind.maybe_snapshot(self._host_step, metrics)
             if self.eigenvalue is not None:
                 self._maybe_update_eigenvalue(batch)
             # the timer stop syncs on the loss, so the enclosing span's
@@ -1655,6 +1672,8 @@ class DeepSpeedEngine:
             self._post_step(metrics)
             if self._bad_step_sentinel is not None:
                 self._check_bad_step(metrics)
+            if self._rewind is not None:
+                self._rewind.maybe_snapshot(self._host_step, metrics)
             self.timers(STEP_GLOBAL_TIMER).stop(sync_obj=metrics.loss)
 
     def eval_batch(self, batch):
@@ -1825,38 +1844,58 @@ class DeepSpeedEngine:
 
     def _check_bad_step(self, metrics: StepMetrics):
         """Bad-step sentinel (resilience.sentinel config block): feed the
-        host-side loss/overflow to the sentinel; when it trips, rewind to the
-        last verified checkpoint (the load path walks back past corrupt tags
-        itself). With no checkpoint to rewind to, or past the rewind budget,
-        raise BadStepError for the elastic agent / launcher to handle."""
+        host-side loss/overflow to the sentinel; when it trips, rewind
+        through the SNAPSHOT LADDER — the in-RAM tier-0 snapshot when the
+        ``rewind`` block holds one (milliseconds, no disk reload), else
+        the last verified disk checkpoint (the load path walks back past
+        corrupt tags itself). With nothing to rewind to, or past the
+        rewind budget, raise BadStepError for the elastic agent /
+        launcher to handle. Each rewind counts
+        ``resilience/sentinel_rewinds{tier=}``."""
         from deepspeed_tpu.resilience.sentinel import BadStepError
 
         sentinel = self._bad_step_sentinel
         if not sentinel.observe(float(metrics.loss), overflow=bool(metrics.overflow)):
             return
         reason = sentinel.last_reason
-        if self._ckpt_save_dir is None:
+        has_ram = self._rewind is not None and self._rewind.has_ram_snapshot()
+        if self._ckpt_save_dir is None and not has_ram:
             raise BadStepError(
                 f"bad-step sentinel tripped ({reason}, patience="
                 f"{sentinel.patience}) and no checkpoint has been saved or "
-                "loaded this run — nothing to rewind to")
+                "loaded this run (and no RAM snapshot is held) — nothing "
+                "to rewind to")
         if self._sentinel_rewinds >= sentinel.max_rewinds:
             raise BadStepError(
                 f"bad-step sentinel tripped ({reason}) after "
                 f"{self._sentinel_rewinds} rewind(s) — giving up")
         self._sentinel_rewinds += 1
-        _telemetry.get_registry().counter("resilience/sentinel_rewinds").inc()
-        _telemetry.get_tracer().instant("sentinel_rewind", cat="resilience",
-                                        reason=reason)
         logger.warning(f"bad-step sentinel: {reason} for {sentinel.patience} "
-                       f"consecutive step(s); rewinding to last verified "
-                       f"checkpoint in {self._ckpt_save_dir} "
-                       f"(rewind {self._sentinel_rewinds}/{sentinel.max_rewinds})")
-        path, _ = self.load_checkpoint(self._ckpt_save_dir)
-        if path is None:
-            raise BadStepError(
-                f"bad-step sentinel tripped ({reason}) but no restorable "
-                f"checkpoint was found in {self._ckpt_save_dir}")
+                       f"consecutive step(s); rewinding through the snapshot "
+                       f"ladder (rewind "
+                       f"{self._sentinel_rewinds}/{sentinel.max_rewinds})")
+        tier = None
+        if has_ram:
+            info = self._rewind.restore_from_ram()
+            if info is not None:
+                tier = "ram"
+        if tier is None:
+            if self._ckpt_save_dir is None:
+                raise BadStepError(
+                    f"bad-step sentinel tripped ({reason}): the RAM "
+                    "snapshot was unusable and no checkpoint has been "
+                    "saved or loaded this run — nothing to rewind to")
+            path, _ = self.load_checkpoint(self._ckpt_save_dir)
+            if path is None:
+                raise BadStepError(
+                    f"bad-step sentinel tripped ({reason}) but no restorable "
+                    f"checkpoint was found in {self._ckpt_save_dir}")
+            tier = (getattr(self, "_last_recovery", None) or {}).get("tier",
+                                                                     "disk")
+        _telemetry.get_registry().counter(
+            "resilience/sentinel_rewinds", labels={"tier": tier}).inc()
+        _telemetry.get_tracer().instant("sentinel_rewind", cat="resilience",
+                                        reason=reason, tier=tier)
         sentinel.reset()
 
     # ------------------------------------------------------------ accessors

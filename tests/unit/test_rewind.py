@@ -1,0 +1,893 @@
+"""ds_rewind tests — the tiered snapshot ladder.
+
+All CPU-only and deterministic: faults come from the seedable chaos
+injector (including the new ``preempt`` class, which SIGTERMs the test
+process exactly like Cloud TPU's warning), never from timing. The
+acceptance drills:
+
+* kill a run mid-step → the elastic restart recovers from the tier-0
+  RAM ring with ≤ ``ram_interval`` steps lost and a restart record that
+  names the tier;
+* inject ``preempt`` → a verified ``emergency_step<N>`` tag that a fresh
+  process's restore ladder prefers over a stale ``latest``;
+* exactly-once dataloader resume: the replayed window consumes identical
+  batches (zero repeated, zero skipped samples), incl. ``drop_last`` and
+  uneven-shard edges;
+* a snapshot restored on a CHANGED world size degrades loudly to the
+  verified disk tier;
+* strict no-op without the block: module never imported, zero extra
+  threads.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.elasticity import DSElasticAgent
+from deepspeed_tpu.models.simple import SimpleModel
+from deepspeed_tpu.resilience import (BadStepError, ChaosError, ChaosInjector,
+                                      install_chaos, uninstall_chaos,
+                                      verify_tag)
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+
+pytestmark = pytest.mark.rewind
+
+HIDDEN = 16
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Fresh chaos, fresh tier-0 ring, untouched signal handlers."""
+    orig = {s: signal.getsignal(s) for s in (signal.SIGTERM, signal.SIGINT)}
+    yield
+    uninstall_chaos()
+    mod = sys.modules.get("deepspeed_tpu.resilience.rewind")
+    if mod is not None:
+        mod.clear_ram_snapshots()
+    for s, h in orig.items():
+        signal.signal(s, h)
+
+
+def make_engine(rewind=None, extra=None, data=8, tensor=1):
+    comm.cdb = None
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "tpu": {"data": data, "tensor": tensor},
+           "steps_per_print": 0}
+    if rewind is not None:
+        cfg["rewind"] = rewind
+    if extra:
+        cfg.update(extra)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=2), config=cfg)
+    return engine
+
+
+def batch(seed=0, bad=False):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(8, HIDDEN).astype(np.float32)
+    y = rng.randn(8, HIDDEN).astype(np.float32)
+    if bad:
+        x[0, 0] = np.nan
+    return (x, y)
+
+
+def params_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(la)),
+                                      np.asarray(jax.device_get(lb)))
+
+
+# ------------------------------------------------------------ strict no-op
+class TestStrictNoOp:
+    def test_block_absent_never_imports_module(self):
+        saved = {m: sys.modules.pop(m) for m in list(sys.modules)
+                 if m == "deepspeed_tpu.resilience.rewind"}
+        threads_before = threading.active_count()
+        try:
+            engine = make_engine()
+            engine.train_batch(batch())
+            engine.train_batch(batch())
+            assert engine._rewind is None
+            assert "deepspeed_tpu.resilience.rewind" not in sys.modules
+            # zero extra threads: nothing in the step path spawned one
+            assert threading.active_count() <= threads_before
+        finally:
+            sys.modules.update(saved)
+
+    def test_enabled_false_is_noop(self):
+        saved = {m: sys.modules.pop(m) for m in list(sys.modules)
+                 if m == "deepspeed_tpu.resilience.rewind"}
+        try:
+            engine = make_engine(rewind={"enabled": False})
+            engine.train_batch(batch())
+            assert engine._rewind is None
+            assert "deepspeed_tpu.resilience.rewind" not in sys.modules
+        finally:
+            sys.modules.update(saved)
+
+    def test_unknown_key_rejected_with_hint(self):
+        with pytest.raises(ValueError, match="ram_interval"):
+            make_engine(rewind={"ram_intervall": 3})
+
+
+# ------------------------------------------------------------- tier-0 ring
+class TestRamRing:
+    def test_ring_cadence_and_bound(self):
+        engine = make_engine(rewind={"ram_interval": 2, "keep": 2})
+        from deepspeed_tpu.resilience import rewind as rw
+
+        for _ in range(7):
+            engine.train_batch(batch())
+        snaps = rw.ram_snapshots()
+        assert [s.step for s in snaps] == [4, 6]     # bounded to keep=2
+
+    def test_restore_roundtrip_bitwise(self):
+        engine = make_engine(rewind={"ram_interval": 2, "keep": 1})
+        for _ in range(4):
+            engine.train_batch(batch())
+        from deepspeed_tpu.resilience import rewind as rw
+
+        snap_params = jax.device_get(engine.state.params)   # state @4 = snapshot
+        engine.train_batch(batch(seed=1))
+        assert int(engine.state.step) == 5
+        info = engine._rewind.restore_from_ram()
+        assert info["tier"] == "ram" and info["snapshot_step"] == 4
+        assert int(engine.state.step) == 4
+        assert engine._host_step == 4
+        params_equal(snap_params, engine.state.params)
+        # rewound state trains onward
+        loss = engine.train_batch(batch())
+        assert np.isfinite(float(loss))
+        assert int(engine.state.step) == 5
+        assert rw.ram_snapshots()      # ring survived the restore
+
+    def test_ladder_prefers_fresher_disk(self, tmp_path):
+        """Freshest verified tier wins: a disk tag NEWER than the RAM
+        ring outranks it in the ladder walk."""
+        engine = make_engine(rewind={"ram_interval": 3, "keep": 1})
+        for _ in range(4):
+            engine.train_batch(batch())          # RAM snapshot @3 only
+        engine.save_checkpoint(str(tmp_path))    # disk tag @4 (newer)
+        path, _ = engine.load_checkpoint(str(tmp_path))
+        assert not str(path).startswith("ram://")
+        assert int(engine.state.step) == 4
+        assert engine._last_recovery["tier"] == "disk"
+
+    def test_ladder_prefers_ram_over_equal_or_stale_disk(self, tmp_path):
+        engine = make_engine(rewind={"ram_interval": 1, "keep": 1})
+        for _ in range(2):
+            engine.train_batch(batch())
+        engine.save_checkpoint(str(tmp_path))    # disk @2
+        engine.train_batch(batch())              # RAM snapshot @3 (newer)
+        path, _ = engine.load_checkpoint(str(tmp_path))
+        assert str(path) == "ram://step3"
+        assert int(engine.state.step) == 3
+        assert engine._last_recovery["tier"] == "ram"
+
+
+# ------------------------------------------------- sentinel rides the ladder
+class TestSentinelLadder:
+    def test_sentinel_rewinds_from_ram_without_any_disk_checkpoint(self):
+        from deepspeed_tpu import telemetry
+
+        engine = make_engine(
+            rewind={"ram_interval": 1, "keep": 2},
+            extra={"resilience": {"sentinel": {"enabled": True,
+                                               "patience": 2}},
+                   "telemetry": {"enabled": True, "jsonl": False,
+                                 "prometheus": False, "trace": False}})
+        try:
+            for _ in range(3):
+                engine.train_batch(batch())
+            assert engine._ckpt_save_dir is None     # never touched disk
+            engine.train_batch(batch(bad=True))
+            engine.train_batch(batch(bad=True))      # patience=2 → rewind
+            assert engine._sentinel_rewinds == 1
+            assert int(engine.state.step) == 3       # back to the RAM tier
+            assert engine._rewind.last_recovery["tier"] == "ram"
+            tiers = {tuple(sorted((r.get("labels") or {}).items())): r["value"]
+                     for r in telemetry.get_registry().snapshot()
+                     if r["name"] == "resilience/sentinel_rewinds"}
+            assert tiers.get((("tier", "ram"),)) == 1
+        finally:
+            telemetry.deconfigure()
+
+    def test_bad_steps_never_enter_the_ring(self):
+        engine = make_engine(
+            rewind={"ram_interval": 1, "keep": 8},
+            extra={"resilience": {"sentinel": {"enabled": True,
+                                               "patience": 3}}})
+        from deepspeed_tpu.resilience import rewind as rw
+
+        engine.train_batch(batch())
+        engine.train_batch(batch(bad=True))          # non-finite loss
+        steps = [s.step for s in rw.ram_snapshots()]
+        assert steps == [1]                          # the bad step skipped
+
+    def test_sentinel_without_anything_still_raises(self):
+        engine = make_engine(
+            rewind={"ram_interval": 100},            # ring stays empty
+            extra={"resilience": {"sentinel": {"enabled": True,
+                                               "patience": 1}}})
+        with pytest.raises(BadStepError, match="nothing"):
+            engine.train_batch(batch(bad=True))
+
+
+# ------------------------------------------------------ tier-1 + the ladder
+class TestEmergencyLadder:
+    def test_emergency_tag_beats_stale_latest(self, tmp_path):
+        save = str(tmp_path / "ckpt")
+        engine = make_engine(rewind={"ram_interval": 1, "keep": 1})
+        for _ in range(2):
+            engine.train_batch(batch())
+        engine.save_checkpoint(save)                 # ordinary tag @2 + latest
+        for _ in range(3):
+            engine.train_batch(batch())
+        tag = engine._rewind.emergency_save(save)    # fresh snapshot @5
+        assert tag == "emergency_step5"
+        ok, reason = verify_tag(os.path.join(save, tag))
+        assert ok, reason
+        want = jax.device_get(engine.state.params)
+
+        from deepspeed_tpu.resilience import rewind as rw
+
+        rw.clear_ram_snapshots()                     # "new process"
+        engine2 = make_engine(rewind={"ram_interval": 1})
+        path, _ = engine2.load_checkpoint(save)
+        assert path.endswith("emergency_step5")
+        assert int(engine2.state.step) == 5
+        assert engine2._last_recovery["tier"] == "emergency"
+        assert engine2._last_recovery["steps_lost"] == 0
+        params_equal(want, engine2.state.params)
+        # restored state is trainable (master/opt state round-tripped)
+        assert np.isfinite(float(engine2.train_batch(batch())))
+
+    def test_emergency_tag_ignored_without_block(self, tmp_path):
+        """Strict no-op holds on the LOAD side too: without the rewind
+        block the emergency tag is loudly skipped (never half-understood)
+        and the ladder falls back to the ordinary tag."""
+        save = str(tmp_path / "ckpt")
+        engine = make_engine(rewind={"ram_interval": 1})
+        engine.train_batch(batch())
+        engine.save_checkpoint(save)                 # ordinary @1
+        engine.train_batch(batch())
+        engine._rewind.emergency_save(save)          # emergency @2
+
+        from deepspeed_tpu.resilience import rewind as rw
+
+        rw.clear_ram_snapshots()
+        engine2 = make_engine()                      # no rewind block
+        path, _ = engine2.load_checkpoint(save)
+        assert path is not None
+        assert os.path.basename(path) == "global_step1"
+        assert int(engine2.state.step) == 1
+
+    def test_changed_world_degrades_loudly_to_disk(self, tmp_path, caplog):
+        save = str(tmp_path / "ckpt")
+        engine = make_engine(rewind={"ram_interval": 1}, data=8)
+        for _ in range(2):
+            engine.train_batch(batch())
+        engine.save_checkpoint(save)                 # ordinary @2
+        engine.train_batch(batch())
+        engine._rewind.emergency_save(save)          # emergency @3, dp=8 world
+
+        # "scale down": dp=4 x tp=2 — RAM ring and emergency tag were
+        # captured on a different world; both must be skipped LOUDLY and
+        # the verified disk tier (reshard-on-load) must win
+        engine2 = make_engine(rewind={"ram_interval": 1}, data=4, tensor=2)
+        from deepspeed_tpu.utils.logging import logger as ds_logger
+
+        ds_logger.propagate = True
+        try:
+            with caplog.at_level("WARNING", logger=ds_logger.name):
+                path, _ = engine2.load_checkpoint(save)
+        finally:
+            ds_logger.propagate = False
+        assert path is not None
+        assert os.path.basename(path) == "global_step2"
+        assert int(engine2.state.step) == 2
+        assert engine2._last_recovery["tier"] == "disk"
+        assert "world" in caplog.text and "disk tier" in caplog.text
+
+
+# --------------------------------------------------------- the chaos drills
+class TestKillDrill:
+    def test_inprocess_restart_recovers_from_ram_tier(self, tmp_path):
+        """THE acceptance drill: kill a run mid-step (chaos fail on the
+        6th train_step), recover from the RAM tier with <= ram_interval
+        steps lost and a restart record that names the tier — no disk
+        checkpoint was ever written before the failure."""
+        install_chaos(ChaosInjector(fail_at={"train_step": [6]}))
+        save = str(tmp_path / "ckpt")
+
+        def factory():
+            return make_engine(rewind={"ram_interval": 2, "keep": 2})
+
+        def batches():
+            while True:
+                yield batch()
+
+        agent = DSElasticAgent(factory, save, checkpoint_interval=100,
+                               max_restarts=2, install_signal_handlers=False)
+        out = agent.run(batches, num_steps=8)
+        assert out["status"] == "complete"
+        assert out["final_step"] == 8
+        assert out["restarts"] == 1
+        rec = out["restart_log"][0]
+        assert "ChaosError" in rec["error"]
+        assert rec["tier"] == "ram"
+        assert rec["snapshot_step"] == 4             # snapshots @2, @4
+        assert rec["steps_lost"] == 1                # failed entering step 6
+        assert rec["steps_lost"] <= 2                # <= ram_interval
+        assert rec["restore_s"] is not None
+
+    def test_restart_without_ring_or_disk_trains_fresh(self, tmp_path):
+        """No rewind block, no checkpoint interval reached: the restart
+        has nothing to resume from (the pre-ladder behavior, unchanged)."""
+        install_chaos(ChaosInjector(fail_at={"train_step": [2]}))
+        agent = DSElasticAgent(lambda: make_engine(),
+                               str(tmp_path / "ckpt"), checkpoint_interval=100,
+                               max_restarts=1, install_signal_handlers=False)
+
+        def batches():
+            while True:
+                yield batch()
+
+        out = agent.run(batches, num_steps=3)
+        assert out["status"] == "complete"
+        assert out["restarts"] == 1
+
+
+class TestPreemptDrill:
+    def test_preempt_emergency_save_then_ladder_resume(self, tmp_path):
+        """Chaos `preempt` SIGTERMs the process at train_step #4; the
+        agent stops at the sync boundary, flushes the emergency tag, and
+        a FRESH process resumes from it — preferred over the stale
+        'latest' — with a restart record naming the tier."""
+        save = str(tmp_path / "ckpt")
+
+        def factory():
+            return make_engine(rewind={"ram_interval": 2, "keep": 2})
+
+        def batches():
+            while True:
+                yield batch()
+
+        install_chaos(ChaosInjector(preempt_at={"train_step": [4]}))
+        agent = DSElasticAgent(factory, save, checkpoint_interval=3,
+                               max_restarts=0, install_signal_handlers=True)
+        out = agent.run(batches, num_steps=50)
+        assert out["status"] == "preempted"
+        stopped = out["final_step"]
+        assert stopped == 4
+        tag = f"emergency_step{stopped}"
+        ok, reason = verify_tag(os.path.join(save, tag))
+        assert ok, reason
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        wait_for_pending_saves()        # the step-3 async save's pointer
+        # the stale pointer still names the step-3 ordinary checkpoint
+        with open(os.path.join(save, "latest")) as f:
+            assert f.read().strip() == "global_step3"
+        uninstall_chaos()
+
+        # ---- the replacement process ---------------------------------
+        from deepspeed_tpu.resilience import rewind as rw
+
+        rw.clear_ram_snapshots()
+        agent2 = DSElasticAgent(factory, save, checkpoint_interval=100,
+                                install_signal_handlers=False)
+        out2 = agent2.run(batches, num_steps=stopped + 2)
+        assert out2["status"] == "complete"
+        assert out2["final_step"] == stopped + 2
+        resume = out2["restart_log"][0]
+        assert resume["tier"] == "emergency"
+        assert resume["steps_lost"] == 0
+        assert resume["snapshot_step"] == stopped
+
+    def test_preempt_rate_is_step_oriented(self):
+        """A preempt RATE (ops unset) fires on the step path only — a
+        checkpoint-I/O drill must not grow a SIGTERM blast radius (same
+        contract as the randomized hangs)."""
+        fired = []
+        orig = signal.signal(signal.SIGTERM, lambda *_: fired.append(1))
+        try:
+            inj = ChaosInjector(preempt_rate=1.0)
+            assert inj.targets("train_step")
+            inj.before("latest", "p")            # checkpoint I/O: no signal
+            assert not fired
+            inj.before("train_step", "step=1")
+            assert fired
+            assert ("train_step", "preempt", "step=1") in inj.log
+        finally:
+            signal.signal(signal.SIGTERM, orig)
+
+
+def test_completed_run_leaves_no_ring_behind(tmp_path):
+    """The tier-0 ring's validity window is one supervised run: after the
+    agent completes, a later run in the same process must not inherit the
+    finished run's snapshots as a phantom resume point."""
+    def factory():
+        return make_engine(rewind={"ram_interval": 1, "keep": 2})
+
+    def batches():
+        while True:
+            yield batch()
+
+    agent = DSElasticAgent(factory, str(tmp_path / "a"),
+                           checkpoint_interval=100,
+                           install_signal_handlers=False)
+    out = agent.run(batches, num_steps=3)
+    assert out["status"] == "complete"
+    from deepspeed_tpu.resilience import rewind as rw
+
+    assert rw.ram_snapshots() == []
+    # a brand-new run in the same process starts fresh, not at step 3
+    agent2 = DSElasticAgent(factory, str(tmp_path / "b"),
+                            checkpoint_interval=100,
+                            install_signal_handlers=False)
+    out2 = agent2.run(batches, num_steps=2)
+    assert out2["status"] == "complete" and out2["final_step"] == 2
+
+
+class TestRamTierScope:
+    def test_ram_never_hijacks_a_foreign_dir_or_partial_load(self, tmp_path):
+        """A tagless load pointed at a DIFFERENT checkpoint source — or a
+        weights-only load — must come from that source, never from the
+        in-RAM training state."""
+        pretrained = str(tmp_path / "pretrained")
+        mine = str(tmp_path / "mine")
+        donor = make_engine()
+        donor.train_batch(batch())
+        donor.save_checkpoint(pretrained)            # step-1 "pretrained"
+
+        engine = make_engine(rewind={"ram_interval": 1, "keep": 1})
+        for _ in range(3):
+            engine.train_batch(batch())
+        engine.save_checkpoint(mine)                 # ring stamped to `mine`
+        engine.train_batch(batch())                  # RAM snapshot @4
+
+        # full-state load of the FOREIGN dir: disk wins, not the ring
+        path, _ = engine.load_checkpoint(pretrained)
+        assert not str(path).startswith("ram://")
+        assert int(engine.state.step) == 1
+        # weights-only load never consults the ring either
+        engine2 = make_engine(rewind={"ram_interval": 1})
+        path2, _ = engine2.load_checkpoint(pretrained, load_module_only=True)
+        assert not str(path2).startswith("ram://")
+
+
+class _StubSampler:
+    """Minimal curriculum-sampler stand-in: state_dict carries the numpy
+    admitted array (the shape that json.dumps(default=str) would corrupt)."""
+
+    def __init__(self):
+        self.admitted = np.arange(2048, dtype=np.int64)
+        self.loaded = None
+
+    def state_dict(self):
+        return {"admitted": self.admitted, "pos": 3}
+
+    def load_state_dict(self, sd):
+        self.loaded = {"admitted": np.asarray(sd["admitted"], dtype=np.int64),
+                       "pos": sd["pos"]}
+
+
+class TestEmergencyMetaFidelity:
+    def test_sampler_admitted_array_survives_emergency_roundtrip(self, tmp_path):
+        """The curriculum sampler's int64 draw order rides a sidecar on
+        the emergency tier too — a json round-trip would turn it into a
+        repr string and crash the resume."""
+        save = str(tmp_path / "ckpt")
+        engine = make_engine(rewind={"ram_interval": 1, "keep": 1})
+        engine._data_sampler = _StubSampler()
+        engine.train_batch(batch())
+        engine._rewind.emergency_save(save)
+        assert os.path.isfile(os.path.join(
+            save, "emergency_step1", "data_sampler_admitted.npy"))
+
+        from deepspeed_tpu.resilience import rewind as rw
+
+        rw.clear_ram_snapshots()
+        engine2 = make_engine(rewind={"ram_interval": 1})
+        stub2 = _StubSampler()
+        stub2.admitted = None
+        engine2._data_sampler = stub2
+        path, _ = engine2.load_checkpoint(save)
+        assert os.path.basename(path) == "emergency_step1"
+        assert stub2.loaded is not None
+        np.testing.assert_array_equal(stub2.loaded["admitted"],
+                                      np.arange(2048, dtype=np.int64))
+        assert stub2.loaded["pos"] == 3
+
+    def test_corrupt_newest_disk_tag_does_not_evict_fresher_ram(self, tmp_path):
+        """The ladder's freshness gate counts only VERIFIED disk
+        candidates: a corrupt newest tag must not push the restore onto
+        an older disk checkpoint past a fresher valid RAM snapshot."""
+        save = str(tmp_path / "ckpt")
+        engine = make_engine(rewind={"ram_interval": 1, "keep": 1})
+        for _ in range(2):
+            engine.train_batch(batch())
+        engine.save_checkpoint(save)                 # good disk @2
+        for _ in range(2):
+            engine.train_batch(batch())              # RAM snapshot @4
+        engine.save_checkpoint(save)                 # disk @4 ...
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        wait_for_pending_saves()
+        # ... which we then corrupt (truncate a manifest-hashed file)
+        with open(os.path.join(save, "global_step4", "client_state.json"),
+                  "w") as f:
+            f.write("{")
+        path, _ = engine.load_checkpoint(save)
+        assert str(path) == "ram://step4"            # not global_step2
+        assert int(engine.state.step) == 4
+
+
+class TestPinnedTagAgent:
+    def test_pinned_tag_preemption_writes_the_real_tag(self, tmp_path):
+        """An agent pinned to an explicit tag never writes an emergency
+        tag its own resume contract would refuse to load — the full
+        verified save of THAT tag runs instead."""
+        save = str(tmp_path / "ckpt")
+
+        def factory():
+            return make_engine(rewind={"ram_interval": 1, "keep": 1})
+
+        def batches():
+            while True:
+                yield batch()
+
+        agent = DSElasticAgent(factory, save, checkpoint_interval=100,
+                               tag="pinned", install_signal_handlers=False)
+
+        def cb(step, loss):
+            if step >= 2:
+                agent.preempt()
+
+        out = agent.run(batches, num_steps=50, step_callback=cb)
+        assert out["status"] == "preempted"
+        assert not [d for d in os.listdir(save) if d.startswith("emergency")]
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        wait_for_pending_saves()        # the async save's manifest
+        ok, reason = verify_tag(os.path.join(save, "pinned"))
+        assert ok, reason
+        # ...and the pinned resume works (the RAM ring never substitutes)
+        agent2 = DSElasticAgent(factory, save, checkpoint_interval=100,
+                                tag="pinned", install_signal_handlers=False)
+        out2 = agent2.run(batches, num_steps=out["final_step"] + 2)
+        assert out2["status"] == "complete"
+
+    def test_failure_record_persists_even_without_anything_to_resume(
+            self, tmp_path):
+        """A failure whose restart starts fresh (no checkpoint, no ring)
+        still lands its record in restart_log.jsonl."""
+        from deepspeed_tpu import telemetry
+
+        tel_dir = str(tmp_path / "tel")
+        install_chaos(ChaosInjector(fail_at={"train_step": [2]}))
+
+        def factory():
+            return make_engine(extra={"telemetry": {
+                "enabled": True, "output_dir": tel_dir, "prometheus": False,
+                "trace": False, "flush_interval": 1000000}})
+
+        def batches():
+            while True:
+                yield batch()
+
+        agent = DSElasticAgent(factory, str(tmp_path / "ckpt"),
+                               checkpoint_interval=100, max_restarts=1,
+                               install_signal_handlers=False)
+        try:
+            out = agent.run(batches, num_steps=3)
+        finally:
+            telemetry.deconfigure()
+        assert out["status"] == "complete" and out["restarts"] == 1
+        log_path = os.path.join(tel_dir, "restart_log.jsonl")
+        assert os.path.isfile(log_path)
+        recs = [json.loads(l) for l in open(log_path) if l.strip()]
+        assert any("ChaosError" in r.get("error", "") for r in recs)
+
+
+def test_randomized_rewind_sweep(tmp_path):
+    """Slow sweep (tests/slow_tests.txt): seeded random kill/preempt drill
+    — across seeds, every run either completes with ≤ ram_interval steps
+    lost per recovery or exits preempted with a verified emergency tag;
+    no run ever trains fresh weights after holding a snapshot."""
+    from deepspeed_tpu.resilience import rewind as rw
+
+    for seed in range(4):
+        rng = np.random.RandomState(seed)
+        uninstall_chaos()
+        rw.clear_ram_snapshots()
+        save = str(tmp_path / f"sweep{seed}")
+        fault_step = int(rng.randint(2, 8))
+        preempt = bool(rng.randint(0, 2))
+        inj = ChaosInjector(
+            preempt_at={"train_step": [fault_step]} if preempt else None,
+            fail_at=None if preempt else {"train_step": [fault_step]})
+        install_chaos(inj)
+
+        def factory():
+            return make_engine(rewind={"ram_interval": 2, "keep": 2})
+
+        def batches():
+            while True:
+                yield batch()
+
+        agent = DSElasticAgent(factory, save, checkpoint_interval=4,
+                               max_restarts=2,
+                               install_signal_handlers=preempt)
+        out = agent.run(batches, num_steps=10)
+        if preempt:
+            assert out["status"] == "preempted"
+            tag = f"emergency_step{out['final_step']}"
+            ok, reason = verify_tag(os.path.join(save, tag))
+            assert ok, (seed, reason)
+        else:
+            assert out["status"] == "complete", (seed, out)
+            assert out["final_step"] == 10
+            for rec in out["restart_log"]:
+                assert rec.get("steps_lost") is not None
+                assert rec["steps_lost"] <= 2, (seed, rec)
+
+
+# ------------------------------------------------- exactly-once dataloader
+class Rows:
+    """Indexable dataset of distinguishable rows."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((4,), i, dtype=np.int32)
+
+
+def consumed_ids(batches):
+    out = []
+    for b in batches:
+        out.extend(int(r[0]) for r in np.asarray(b))
+    return out
+
+
+@pytest.mark.parametrize("drop_last,n", [(True, 37), (False, 37), (True, 40)])
+class TestDataloaderResume:
+    def test_exactly_once_across_mid_epoch_rewind(self, drop_last, n):
+        """Zero repeated and zero skipped samples: the replayed window
+        after a rewind consumes IDENTICAL batches (uneven shard: 37 rows
+        / batch 8 leaves a short tail — dropped or yielded per
+        drop_last, but never double-counted)."""
+        mk = lambda: DeepSpeedDataLoader(Rows(n), batch_size=8, seed=7,
+                                         drop_last=drop_last)
+        loader = mk()
+        it = iter(loader)
+        first = [next(it) for _ in range(2)]         # consumed pre-snapshot
+        sd = loader.state_dict()                     # <- the rewind point
+        after_orig = list(it)                        # what the run saw next
+
+        replay_loader = mk()
+        replay_loader.load_state_dict(sd)
+        after_replay = list(iter(replay_loader))
+        assert len(after_replay) == len(after_orig)
+        for a, b in zip(after_orig, after_replay):
+            np.testing.assert_array_equal(a, b)
+        # exactly-once accounting over the whole epoch
+        ids = consumed_ids(first) + consumed_ids(after_replay)
+        assert len(ids) == len(set(ids)), "a sample was consumed twice"
+        expected = n if not drop_last else (n // 8) * 8
+        assert len(ids) == expected, "a sample was skipped"
+
+    def test_geometry_change_refuses_loudly(self, drop_last, n):
+        loader = DeepSpeedDataLoader(Rows(n), batch_size=8, seed=7,
+                                     drop_last=drop_last)
+        sd = loader.state_dict()
+        other = DeepSpeedDataLoader(Rows(n + 8), batch_size=8, seed=7,
+                                    drop_last=drop_last)
+        with pytest.raises(ValueError, match="dataset_size"):
+            other.load_state_dict(sd)
+
+
+class TestDataloaderEdges:
+    def test_live_generator_honors_mid_iteration_rewind(self):
+        """The sentinel path: load_state_dict lands while the agent's
+        generator is LIVE — the very next batch must jump back to the
+        captured position, not silently march on."""
+        loader = DeepSpeedDataLoader(Rows(64), batch_size=8, seed=9)
+        it = iter(loader)
+        seen = [next(it) for _ in range(4)]          # consumed 0..3
+        sd_at_2 = {"epoch": 0, "batch_idx": 2, "batch_size": 8, "seed": 9,
+                   "shuffle": True, "drop_last": True, "dataset_size": 64}
+        loader.load_state_dict(sd_at_2)              # the in-RAM rewind
+        replay = [next(it) for _ in range(2)]        # SAME generator
+        np.testing.assert_array_equal(replay[0], seen[2])
+        np.testing.assert_array_equal(replay[1], seen[3])
+
+    def test_epoch_boundary_capture_resumes_next_epoch(self):
+        """A completed pass advances the epoch (so RepeatingLoader draws
+        a fresh shuffle each pass), and a state captured at the boundary
+        — whether just before or just after the advance — resumes at the
+        next epoch's first batch, matching what the live run consumed."""
+        loader = DeepSpeedDataLoader(Rows(16), batch_size=8, seed=3)
+        list(iter(loader))                           # full epoch consumed
+        sd = loader.state_dict()
+        assert loader.epoch == 1                     # auto-advanced
+        assert sd == {**sd, "epoch": 1, "batch_idx": 0}
+        fresh = DeepSpeedDataLoader(Rows(16), batch_size=8, seed=3)
+        fresh.load_state_dict(sd)
+        assert fresh.epoch == 1 and fresh._batch_idx == 0
+        assert len(list(iter(fresh))) == 2           # a full next epoch
+        # the PRE-advance shape (captured between the last yield and the
+        # generator's final resume) normalizes to the same position
+        stale = {**sd, "epoch": 0, "batch_idx": 2}
+        fresh2 = DeepSpeedDataLoader(Rows(16), batch_size=8, seed=3)
+        fresh2.load_state_dict(stale)
+        assert fresh2.epoch == 1 and fresh2._batch_idx == 0
+
+    def test_repeating_loader_epochs_reshuffle_and_replay_exactly(self):
+        """Cross-epoch exactly-once: consecutive RepeatingLoader passes
+        draw DIFFERENT orders (epoch advances), and a state captured
+        mid-second-epoch replays the second epoch's order."""
+        mk = lambda: DeepSpeedDataLoader(Rows(32), batch_size=8, seed=11)
+        rep = RepeatingLoader(mk())
+        first_pass = [next(rep) for _ in range(4)]
+        second_pass = [next(rep) for _ in range(2)]  # epoch 1 begins
+        assert not np.array_equal(first_pass[0], second_pass[0])
+        sd = rep.state_dict()
+        assert sd["epoch"] == 1 and sd["batch_idx"] == 2
+        rep2 = RepeatingLoader(mk())
+        rep2.load_state_dict(sd)
+        np.testing.assert_array_equal(next(rep), next(rep2))
+
+    def test_sampler_mode_mismatch_refuses(self):
+        loader = DeepSpeedDataLoader(Rows(32), batch_size=8, seed=1)
+        sd = loader.state_dict()
+        sd["sampler_driven"] = True                  # captured WITH a sampler
+        with pytest.raises(ValueError, match="sampler_driven"):
+            loader.load_state_dict(sd)
+
+    def test_repeating_loader_delegates(self):
+        inner = DeepSpeedDataLoader(Rows(32), batch_size=8, seed=1)
+        rep = RepeatingLoader(inner)
+        next(rep), next(rep)
+        sd = rep.state_dict()
+        assert sd["batch_idx"] == 2
+        inner2 = DeepSpeedDataLoader(Rows(32), batch_size=8, seed=1)
+        rep2 = RepeatingLoader(inner2)
+        rep2.load_state_dict(sd)
+        np.testing.assert_array_equal(next(rep), next(rep2))
+
+    def test_engine_checkpoint_carries_loader_position(self, tmp_path):
+        """The tier-2 path round-trips the loader position end to end:
+        save mid-epoch, restore into a fresh engine+loader, and the
+        replayed window consumes the same batches."""
+        engine = make_engine()
+        loader = DeepSpeedDataLoader(Rows(64), batch_size=8, seed=5)
+        engine.dataloader = loader
+        it = iter(loader)
+        next(it), next(it)
+        engine.train_batch(batch())
+        engine.save_checkpoint(str(tmp_path))
+        expected_next = next(it)
+
+        engine2 = make_engine()
+        loader2 = DeepSpeedDataLoader(Rows(64), batch_size=8, seed=5)
+        engine2.dataloader = loader2
+        engine2.load_checkpoint(str(tmp_path))
+        got = next(iter(loader2))
+        np.testing.assert_array_equal(expected_next, got)
+
+
+# ----------------------------------------------------------- observability
+class TestObservability:
+    def test_ds_top_rewind_line(self):
+        from deepspeed_tpu.goodput.top import render_frame
+
+        records = [
+            {"kind": "gauge", "name": "rewind/ram_snapshot_step",
+             "value": 40.0, "step": 43},
+            {"kind": "gauge", "name": "rewind/ram_snapshots_held",
+             "value": 2.0},
+            {"kind": "gauge", "name": "rewind/last_recovery_tier",
+             "value": 1.0},
+            {"kind": "gauge", "name": "rewind/last_recovery_steps_lost",
+             "value": 3.0},
+            {"kind": "counter", "name": "rewind/emergency_saves",
+             "value": 1.0},
+        ]
+        frame = render_frame(records)
+        assert "rewind:" in frame
+        assert "ram tier @step 40 (age 3 step(s)), 2 held" in frame
+        assert "last recovery: ram tier" in frame
+        assert "3 step(s) lost" in frame
+        assert "emergency saves 1" in frame
+
+    def test_ds_metrics_footer_and_ds_report_rewind(self, tmp_path, capsys):
+        from deepspeed_tpu import telemetry
+
+        tel_dir = str(tmp_path / "tel")
+        save = str(tmp_path / "ckpt")
+        engine = make_engine(
+            rewind={"ram_interval": 1, "keep": 1},
+            extra={"telemetry": {"enabled": True, "output_dir": tel_dir,
+                                 "prometheus": False, "trace": False}})
+        try:
+            for _ in range(2):
+                engine.train_batch(batch())
+            engine._rewind.emergency_save(save)
+            telemetry.flush()
+        finally:
+            telemetry.deconfigure()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_metrics"), tel_dir],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "rewind:" in proc.stdout
+        assert "ram tier @step 2" in proc.stdout
+
+        from deepspeed_tpu import env_report
+
+        rc = env_report.main(["rewind", save])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "emergency_step2" in out and "tier-1 emergency" in out
+        assert "ladder picks" in out
+
+    def test_goodput_report_names_tier_per_gap(self):
+        from deepspeed_tpu.goodput.report import render_goodput_report
+
+        report = {
+            "ranks": [0], "sessions": 2,
+            "per_rank": {}, "buckets_s": {"compute": 10.0, "restart": 2.0},
+            "fleet_seconds": 12.0, "goodput_fraction": 10.0 / 12.0,
+            "restarts": [{"rank": 0, "gap_s": 2.0, "after": "a",
+                          "before": "b", "reasons": ["ChaosError: boom"],
+                          "recoveries": [{"tier": "ram", "snapshot_step": 4,
+                                          "steps_lost": 1,
+                                          "restore_s": 0.01}]}],
+            "warnings": [],
+        }
+        text = render_goodput_report(report)
+        assert "recovered from ram tier @step 4, 1 step(s) lost" in text
+
+    def test_schema_pass_knows_the_block(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        base = {"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+        findings, _ = walk_config({**base, "rewind": {"ram_intervall": 3}})
+        assert any("ram_interval" in f.message for f in findings)
+
+        findings, _ = walk_config({
+            **base, "rewind": {},
+            "resilience": {"verify_on_load": False}})
+        assert any("verify_on_load" in f.citation for f in findings)
+
+        findings, _ = walk_config({
+            **base, "rewind": {"ram_interval": 1, "keep": 1},
+            "resilience": {"sentinel": {"enabled": True, "patience": 5}}})
+        assert any("diverging trajectory" in f.message for f in findings)
+
+        findings, _ = walk_config({**base, "rewind": {}})
+        assert any("emergency_save" in f.citation for f in findings)

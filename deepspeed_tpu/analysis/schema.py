@@ -279,6 +279,38 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "delivers the flush when SIGTERM lands — tier-0 RAM "
                 "snapshots and the sentinel's in-RAM rewind still work",
                 "rewind.emergency_save vs elasticity.enabled")
+    rz = cfg.elasticity_config.resize
+    if "elasticity" in pd and rz.enabled:
+        if not ("rewind" in pd and rw.enabled):
+            add("warning",
+                "elasticity.resize without the rewind block: the tier-0 RAM "
+                "ring and tier-1 emergency tags do not exist, so a "
+                "world-size change can only be served by the tier-2 disk "
+                "checkpoint — steps_lost is bounded by the checkpoint "
+                "interval, not rewind.ram_interval; enable the rewind block "
+                "for one-SIGTERM-window resizes",
+                "elasticity.resize vs rewind")
+        elif "emergency" in rz.tiers and not rw.emergency_save:
+            add("info",
+                "elasticity.resize.tiers allows the 'emergency' tier but "
+                "rewind.emergency_save is false: no emergency_step<N> tag "
+                "is ever written, so a cross-process resize (host reclaim) "
+                "falls through to the disk tier — only the in-process RAM "
+                "reshard benefits",
+                "elasticity.resize.tiers vs rewind.emergency_save")
+        # only checkable against a BOUND world (an engine set dp_world_size):
+        # an offline config lint runs on whatever machine the operator has,
+        # and its device count says nothing about the fleet the config
+        # targets (it would also drag jax backend init into a jax-free pass)
+        n_dev = getattr(cfg, "dp_world_size", None)
+        if n_dev and rz.min_world_size > n_dev:
+            add("warning",
+                f"elasticity.resize.min_world_size={rz.min_world_size} "
+                f"exceeds the visible world of {n_dev} device(s): EVERY "
+                "resize (and the current world itself) falls below the "
+                "floor, so any world change becomes a loud refusal — is "
+                "the floor meant for a bigger fleet?",
+                "elasticity.resize.min_world_size")
     gp = cfg.goodput
     if "goodput" in pd and gp.enabled and not (tel.enabled and tel.trace):
         add("warning",

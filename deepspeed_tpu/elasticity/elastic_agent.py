@@ -208,6 +208,12 @@ class DSElasticAgent:
             "snapshot_step": rec.get("snapshot_step", restored_step),
             "restore_s": rec.get("restore_s"),
         })
+        if rec.get("resize"):
+            # a world change served by the ladder (ds_resize): price the
+            # whole event — {kind, from_world, to_world} + reshard_s ride
+            # the restart record into ds_prof goodput / ds_top
+            pending["resize"] = dict(rec["resize"])
+            pending["reshard_s"] = rec.get("reshard_s")
         steps_lost = rec.get("steps_lost")
         if steps_lost is None and pending.get("step") is not None:
             # the failing step minus where the ladder put us back
@@ -363,6 +369,16 @@ class DSElasticAgent:
                     logger.error("elastic agent: hung step detected by the "
                                  f"watchdog ({e}); treating as a restartable "
                                  "failure")
+                rz = sys.modules.get("deepspeed_tpu.elasticity.resize")
+                if rz is not None and isinstance(e, rz.FleetResizeEvent):
+                    # a fleet membership change, not a fault: the restart
+                    # brings the job up on the survivor world and the
+                    # snapshot ladder reshards into it (checked without
+                    # importing resize — the strict no-op contract)
+                    log_dist(f"elastic agent: {e} — restarting on the "
+                             "post-event world; the snapshot ladder "
+                             "reshards the TrainState onto the survivors",
+                             ranks=[0])
                 if jax.process_count() > 1:
                     # a host-LOCAL failure cannot be healed by an in-process
                     # restart on one controller: the surviving hosts keep

@@ -170,6 +170,8 @@ def rewind_section(args):
     from deepspeed_tpu.resilience.manifest import (candidate_tags,
                                                    read_latest, tag_step,
                                                    verify_tag)
+    from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+        is_emergency_tag, tag_world)
 
     if not args:
         print("usage: ds_report rewind <checkpoint save_dir>",
@@ -192,18 +194,23 @@ def rewind_section(args):
     rows = []
     for tag in tags:
         tag_dir = os.path.join(save_dir, tag)
-        emergency = os.path.isfile(os.path.join(tag_dir, "state",
-                                                "rewind_state.npz"))
-        tier = "tier-1 emergency" if emergency else "tier-2 checkpoint"
+        tier = ("tier-1 emergency" if is_emergency_tag(tag_dir)
+                else "tier-2 checkpoint")
         ok, reason = verify_tag(tag_dir)
         parsed = tag_step(tag)
         step = str(parsed) if parsed >= 0 else "?"
+        # the world the tag was saved under (ds_resize: a load on a
+        # different world reshards — emergency tags only with the
+        # elasticity.resize knob, orbax tags natively)
+        n = tag_world(tag_dir)
+        world = str(n) if n else "?"
         mark = ""
         if ok and picked is None:
             picked = tag
             mark = "  <- ladder picks"
         pointer = "  (= 'latest')" if tag == latest else ""
         rows.append(f"  {tag:<28} {tier:<18} step {step:<8} "
+                    f"world {world:<4} "
                     f"{GREEN_OK if ok else RED_NO}"
                     f"{'' if ok else ' (' + reason + ')'}{pointer}{mark}")
     print("\n".join(rows))

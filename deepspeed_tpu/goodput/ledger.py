@@ -186,7 +186,13 @@ def session_ledger(events: List[dict],
     last span end] and EVERY second in it lands in a bucket (inter-step
     gaps become ``idle`` unless a checkpoint/compile/stall span claims
     them). None when the trace holds no spans at all."""
-    spans = [ev for ev in events if is_span(ev)]
+    # background spans (async checkpoint commits) carry no classification
+    # weight AND must not define the session's wall-clock extent: a
+    # commit thread outliving the step loop would stretch the window into
+    # the restart gap — phantom idle seconds here, and a compressed gap
+    # that ds_prof goodput can no longer match restart records against
+    spans = [ev for ev in events
+             if is_span(ev) and not (ev.get("args") or {}).get("background")]
     if not spans:
         return None
     lo = min(interval(ev)[0] for ev in spans)

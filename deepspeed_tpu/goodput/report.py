@@ -167,6 +167,8 @@ def build_job_report(trace_paths: List[str],
 
     per_rank: Dict[int, Dict[str, Any]] = {}
     restarts: List[Dict[str, Any]] = []
+    all_gaps: List[Dict[str, Any]] = []
+    matched_ids: set = set()
     restart_log = list(restart_log or [])
     for rank, ts in by_rank.items():
         anchored = all(t["anchor_epoch_s"] is not None for t in ts)
@@ -205,41 +207,80 @@ def build_job_report(trace_paths: List[str],
                            if isinstance(r.get("ts"), (int, float))
                            and a["end_wall_s"] - 1.0 <= r["ts"]
                            <= b["start_wall_s"] + 1.0]
-                if gap_s > 1.0 and not reasons:
-                    # still charged (a restart without a restart_log —
-                    # launcher-level restarts, a dead rank 0 — is real
-                    # downtime), but LOUDLY: if these are two unrelated
-                    # runs sharing an output dir, the charge is bogus
-                    warnings.append(
-                        f"rank {rank}: {gap_s:.1f}s gap before "
-                        f"{os.path.basename(b['path'])} has NO matching "
-                        "restart_log record — charged to `restart`; if "
-                        "these sessions are unrelated runs sharing an "
-                        "output dir, point ds_prof goodput at one run's "
-                        "sessions only")
+                matched_ids.update(id(r) for r in reasons)
+                entry = {
+                    "rank": rank, "gap_s": gap_s,
+                    "after": a["path"], "before": b["path"],
+                    "reasons": [r.get("error", "?") for r in reasons],
+                    # the rewind ladder's recovery facts, when the
+                    # agent stamped them (PR 10): which tier served
+                    # the restore and what the failure actually cost
+                    # — including a resize event's {kind, from_world,
+                    # to_world} + reshard_s (PR 11, ds_resize)
+                    "recoveries": [
+                        {k: r.get(k) for k in ("tier", "snapshot_step",
+                                               "steps_lost", "restore_s",
+                                               "reshard_s", "resize")}
+                        for r in reasons if r.get("tier")],
+                    "_window": (a["end_wall_s"], b["start_wall_s"]),
+                }
+                all_gaps.append(entry)
                 if reasons or gap_s > 1.0:
                     # a named restart is real at any gap size (fast CPU
                     # restarts measure in ms); an UNNAMED sub-second gap
                     # is just back-to-back engine re-init — charging
                     # ~0 s is harmless, but listing it as a "restart"
                     # would be noise
-                    restarts.append({
-                        "rank": rank, "gap_s": gap_s,
-                        "after": a["path"], "before": b["path"],
-                        "reasons": [r.get("error", "?") for r in reasons],
-                        # the rewind ladder's recovery facts, when the
-                        # agent stamped them (PR 10): which tier served
-                        # the restore and what the failure actually cost
-                        "recoveries": [
-                            {k: r.get(k) for k in ("tier", "snapshot_step",
-                                                   "steps_lost", "restore_s")}
-                            for r in reasons if r.get("tier")]})
+                    restarts.append(entry)
         per_rank[rank] = {
             "sessions": len(ledgers),
             "buckets_us": buckets,
             "wall_s": sum(buckets.values()) / 1e6,
             "ledgers": ledgers,
         }
+
+    # second-chance matching: a record whose ts fell outside every gap's
+    # exact ±1 s window (clock-anchor wobble, a span flushed late under
+    # load) still names real downtime — attach it to the NEAREST gap,
+    # loudly, instead of silently dropping its annotation
+    for r in restart_log:
+        if not isinstance(r.get("ts"), (int, float)) or id(r) in matched_ids:
+            continue
+        best = None
+        for g in all_gaps:
+            lo, hi = g["_window"]
+            d = max(lo - r["ts"], r["ts"] - hi, 0.0)
+            if best is None or d < best[0]:
+                best = (d, g)
+        if best is None or best[0] > 30.0:
+            continue
+        d, g = best
+        g["reasons"].append(r.get("error", "?"))
+        if r.get("tier"):
+            g["recoveries"].append(
+                {k: r.get(k) for k in ("tier", "snapshot_step", "steps_lost",
+                                       "restore_s", "reshard_s", "resize")})
+        if g not in restarts:
+            restarts.append(g)
+        warnings.append(
+            f"restart record {r.get('error', '?')!r} missed every gap's "
+            f"exact window by {d:.1f}s — attached to the nearest gap "
+            f"(rank {g['rank']}, before {os.path.basename(g['before'])})")
+    restarts.sort(key=lambda g: (g["rank"], g["_window"][0]))
+    for g in all_gaps:
+        if g["gap_s"] > 1.0 and not g["reasons"]:
+            # still charged (a restart without a restart_log —
+            # launcher-level restarts, a dead rank 0 — is real
+            # downtime), but LOUDLY: if these are two unrelated
+            # runs sharing an output dir, the charge is bogus
+            warnings.append(
+                f"rank {g['rank']}: {g['gap_s']:.1f}s gap before "
+                f"{os.path.basename(g['before'])} has NO matching "
+                "restart_log record — charged to `restart`; if "
+                "these sessions are unrelated runs sharing an "
+                "output dir, point ds_prof goodput at one run's "
+                "sessions only")
+        g.pop("_window", None)
 
     fleet = sum_buckets([pr["buckets_us"] for pr in per_rank.values()])
     buckets_s = {b: v / 1e6 for b, v in fleet.items()}
@@ -300,6 +341,7 @@ def render_goodput_report(report: Dict[str, Any],
             if r["reasons"]:
                 line += " — " + "; ".join(r["reasons"])
             for rec in r.get("recoveries") or []:
+                rz = rec.get("resize") or {}
                 line += (f" [recovered from {rec.get('tier', '?')} tier"
                          + (f" @step {rec['snapshot_step']}"
                             if rec.get("snapshot_step") is not None else "")
@@ -307,6 +349,12 @@ def render_goodput_report(report: Dict[str, Any],
                             if rec.get("steps_lost") is not None else "")
                          + (f", restore {rec['restore_s']:.3g}s"
                             if rec.get("restore_s") is not None else "")
+                         + (f", {rz.get('kind', 'resize')} "
+                            f"{rz.get('from_world', '?')}->"
+                            f"{rz.get('to_world', '?')} resharded"
+                            + (f" in {rec['reshard_s']:.3g}s"
+                               if rec.get("reshard_s") is not None else "")
+                            if rz else "")
                          + "]")
             out.append(line)
     if report["warnings"]:

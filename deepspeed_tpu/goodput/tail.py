@@ -23,6 +23,31 @@ from typing import Callable, Dict, List, Optional, Tuple
 REWIND_TIERS = {0: "none", 1: "ram", 2: "emergency", 3: "disk"}
 
 
+def labeled_key(name: str, labels: Optional[Dict[str, str]]) -> str:
+    """THE series-key encoding for a labeled counter/series —
+    ``name{k=v,...}`` with labels sorted. Every renderer that builds or
+    parses these keys (ds_top's summarize, the ds_metrics footer,
+    :func:`render_resize_line`) goes through this pair so the encoding
+    can never drift between the CLIs."""
+    if not labels:
+        return name
+    return name + "{" + ",".join(f"{k}={v}"
+                                 for k, v in sorted(labels.items())) + "}"
+
+
+def parse_label(key: str, label: str) -> Optional[str]:
+    """Value of ``label`` inside a :func:`labeled_key`-encoded key, or
+    None when absent."""
+    lo = key.find("{")
+    if lo < 0:
+        return None
+    for part in key[lo + 1:].rstrip("}").split(","):
+        k, _, v = part.partition("=")
+        if k == label:
+            return v
+    return None
+
+
 def render_rewind_line(gauges: Dict[str, float], counters: Dict[str, float],
                        step=None) -> Optional[str]:
     """The ds_rewind status line: per-tier snapshot age + the last
@@ -62,6 +87,34 @@ def render_rewind_line(gauges: Dict[str, float], counters: Dict[str, float],
             seg += f", {int(gauges['rewind/last_recovery_steps_lost'])} step(s) lost"
         if gauges.get("rewind/last_recovery_restore_s") is not None:
             seg += f", restore {gauges['rewind/last_recovery_restore_s']:.3g}s"
+        parts.append(seg)
+    return "  ".join(parts)
+
+
+def render_resize_line(gauges: Dict[str, float],
+                       counters: Dict[str, float]) -> Optional[str]:
+    """The ds_resize status line: resize events this run (by kind) + the
+    last event's geometry and reshard cost — rendered by ``ds_top``
+    frames and the ``ds_metrics`` footer, same contract as
+    :func:`render_rewind_line` (pure stdlib, lives here so the jax-free
+    CLIs can file-load it)."""
+    events = {k: v for k, v in counters.items()
+              if k.startswith("elasticity/resizes")}
+    last_to = gauges.get("elasticity/last_resize_to")
+    if not events and last_to is None:
+        return None
+    parts = ["resize:"]
+    total = int(sum(events.values()))
+    by_kind = []
+    for k, v in sorted(events.items()):
+        by_kind.append(f"{int(v)} {parse_label(k, 'kind') or '?'}")
+    parts.append(f"{total} event(s)" + (f" ({', '.join(by_kind)})"
+                                        if by_kind else ""))
+    if last_to is not None:
+        seg = (f"last {int(gauges.get('elasticity/last_resize_from', 0))}"
+               f"->{int(last_to)} device(s)")
+        if gauges.get("elasticity/last_reshard_s") is not None:
+            seg += f", reshard {gauges['elasticity/last_reshard_s']:.3g}s"
         parts.append(seg)
     return "  ".join(parts)
 

@@ -17,7 +17,9 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
-from deepspeed_tpu.goodput.tail import MetricsFollower, render_rewind_line
+from deepspeed_tpu.goodput.tail import (MetricsFollower, labeled_key,
+                                        render_resize_line,
+                                        render_rewind_line)
 from deepspeed_tpu.goodput.taxonomy import GOODPUT_BUCKETS
 
 
@@ -55,15 +57,10 @@ def summarize(records: List[dict]) -> Dict[str, Any]:
                         comm_skew = (ratio, labels.get("op", "?"),
                                      p50, mx)
         elif kind == "counter":
-            key = name if not labels else name + "{" + ",".join(
-                f"{k}={v}" for k, v in sorted(labels.items())) + "}"
-            counters[key] = rec.get("value", 0.0)
+            counters[labeled_key(name, labels)] = rec.get("value", 0.0)
         if name.startswith("serving/"):
-            short = name[len("serving/"):]
-            if labels:      # e.g. shed{reason=...}: one entry per labelset
-                short += "{" + ",".join(f"{k}={v}" for k, v
-                                        in sorted(labels.items())) + "}"
-            serving[short] = rec
+            # e.g. shed{reason=...}: one entry per labelset
+            serving[labeled_key(name[len("serving/"):], labels)] = rec
     return {"step": step, "ts": ts, "gauges": gauges, "hists": hists,
             "counters": counters, "fractions": fractions,
             "comm_skew": comm_skew, "serving": serving}
@@ -131,6 +128,9 @@ def render_frame(records: List[dict], source: Optional[str] = None,
     rew = render_rewind_line(g, s["counters"], step=s["step"])
     if rew:
         out.append(rew)
+    rz = render_resize_line(g, s["counters"])
+    if rz:
+        out.append(rz)
 
     if s["comm_skew"] is not None:
         ratio, op, p50, mx = s["comm_skew"]

@@ -82,6 +82,20 @@ def _split_metrics(metric_args):
     return series, attr
 
 
+def _world_tag(r):
+    """The world-identity tag on a compare line: a pair measured on
+    different device counts — or an entry whose run crossed an elastic
+    resize mid-run — is fingerprint-changed, never a silent comparison
+    (ds_resize contract; ledger.compare sets the flags)."""
+    if not r.get("world_changed"):
+        return ""
+    wo, wn = r.get("old_world"), r.get("new_world")
+    if wo is not None and wn is not None and wo != wn:
+        return (f"  [world changed {wo} -> {wn} device(s): "
+                "not two views of one experiment]")
+    return "  [world resized mid-run: not two views of one experiment]"
+
+
 def _exposed_line(r):
     if "new_exposed_comm_us" not in r:
         return ""
@@ -114,7 +128,8 @@ def _cmd_diff(args) -> int:
         elif r["t_stat"] is not None:
             noise = (f"  ({r['n_old']}/{r['n_new']} samples: underpowered, "
                      f"threshold verdict)")
-        fp = "  [config fingerprint changed]" if r["fingerprint_changed"] else ""
+        fp = _world_tag(r) or ("  [config fingerprint changed]"
+                               if r["fingerprint_changed"] else "")
         print(f"{mark} {r['series']}: {_fmt_val(r['old_value'])} -> "
               f"{_fmt_val(r['new_value'])} ({r['rel_delta']:+.1%})"
               f"{noise}{fp}{_exposed_line(r)}")
@@ -188,7 +203,7 @@ def _cmd_gate(args) -> int:
                          f"{r['new_goodput']:.3f}"
                          + (" [REGRESSED]" if r.get("goodput_regressed")
                             else ""))
-            print(line + _exposed_line(r))
+            print(line + _world_tag(r) + _exposed_line(r))
         for k in crashed:
             e = newest[k]
             print(f"FAIL {k}: newest run FAILED "

@@ -285,6 +285,25 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     significant = None
     if t is not None and min(len(sa), len(sb)) >= MIN_POWER_SAMPLES:
         significant = abs(t) > t_critical(len(sa), len(sb))
+    # world identity: an entry measured on a different device count — or
+    # one whose run crossed an elastic RESIZE mid-run (world_resized,
+    # stamped by the recorder from the engine's recovery record) — is
+    # NEVER silently compared: per-device throughput, exposed comm and
+    # goodput all scale with the world, so the pair is treated as
+    # fingerprint-changed (plain-threshold verdict, tagged by the CLI).
+    def _world(e):
+        w = e.get("world_size")
+        if w is None:
+            w = (e.get("env") or {}).get("n_dev")
+        try:
+            return int(w) if w is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    wo, wn = _world(old), _world(new)
+    world_changed = bool(
+        (wo is not None and wn is not None and wo != wn)
+        or old.get("world_resized") or new.get("world_resized"))
     out = {
         "series": series_key(new),
         "old_value": vo, "new_value": vn,
@@ -292,7 +311,9 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         "old_rev": old.get("git_rev"), "new_rev": new.get("git_rev"),
         "old_fingerprint": old.get("fingerprint"),
         "new_fingerprint": new.get("fingerprint"),
-        "fingerprint_changed": (
+        "old_world": wo, "new_world": wn,
+        "world_changed": world_changed,
+        "fingerprint_changed": world_changed or (
             bool(old.get("fingerprint")) and bool(new.get("fingerprint"))
             and old.get("fingerprint") != new.get("fingerprint")),
         "t_stat": t, "significant": significant,

@@ -94,6 +94,23 @@ class PerfRecorder:
             "git_rev": _ledger.git_rev(),
             "fingerprint": self.fingerprint(),
         }
+        try:
+            # the MESH device count, not the backend's: an elastic run on
+            # 6 survivors of an 8-device backend measured a 6-wide world
+            import numpy as _np
+
+            entry["world_size"] = int(_np.prod(
+                [int(v) for v in dict(self.engine.mesh.shape).values()]))
+        except Exception:
+            pass
+        resized = (getattr(self.engine, "_last_recovery", None)
+                   or {}).get("resize")
+        if resized:
+            # the run crossed a world resize: its numbers are not two
+            # views of one experiment with ANY baseline — ds_perf
+            # compare/gate treats this as fingerprint-changed, never a
+            # silent comparison
+            entry["world_resized"] = dict(resized)
         if session is not None:
             entry["telemetry_dir"] = session.output_dir
         events = _attribution.tracer_events(session)

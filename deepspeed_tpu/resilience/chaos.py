@@ -40,6 +40,14 @@ without a real TPU fault):
   flag, the run stops at the next sync boundary, and the rewind ladder's
   emergency-save path (``rewind.emergency_save``) is deterministically
   drillable without a real reclaim.
+* ``shrink`` / ``grow`` (``shrink_at``+``shrink_to`` /
+  ``grow_at``+``grow_to`` scripted) — a FLEET-scale membership change on
+  the simulated mesh: preempt a subset of devices (or add some back)
+  instead of SIGTERM-to-self. The survivor set narrows/widens
+  (``elasticity.resize.survivor_devices``) and a ``FleetResizeEvent``
+  lands in the step loop, so the elastic agent restarts the run on the
+  post-event world — the ds_resize shrink/grow drills ("lose 2 of 8
+  devices mid-run, keep training on 6") run on this.
 
 One fault class targets the STATIC analyzer instead of the runtime:
 ``collective_mismatch`` perturbs this rank's ds_doctor-recorded
@@ -96,6 +104,10 @@ class ChaosInjector:
                  kill_at: Optional[Dict[str, Sequence[int]]] = None,
                  preempt_at: Optional[Dict[str, Sequence[int]]] = None,
                  preempt_rate: float = 0.0,
+                 shrink_at: Optional[Dict[str, Sequence[int]]] = None,
+                 shrink_to: int = 0,
+                 grow_at: Optional[Dict[str, Sequence[int]]] = None,
+                 grow_to: int = 0,
                  collective_mismatch: bool = False,
                  collective_mismatch_rank: int = -1):
         self._rng = random.Random(seed)
@@ -115,6 +127,10 @@ class ChaosInjector:
         self.kill_at = {k: set(v) for k, v in (kill_at or {}).items()}
         self.preempt_at = {k: set(v) for k, v in (preempt_at or {}).items()}
         self.preempt_rate = float(preempt_rate)
+        self.shrink_at = {k: set(v) for k, v in (shrink_at or {}).items()}
+        self.shrink_to = int(shrink_to)
+        self.grow_at = {k: set(v) for k, v in (grow_at or {}).items()}
+        self.grow_to = int(grow_to)
         self.collective_mismatch = bool(collective_mismatch)
         self.collective_mismatch_rank = int(collective_mismatch_rank)
         self._counts = defaultdict(int)
@@ -128,6 +144,12 @@ class ChaosInjector:
                   max_delay_s=cfg.max_delay_s, hang_rate=cfg.hang_rate,
                   hang_s=cfg.hang_s, ops=cfg.ops or None,
                   preempt_rate=cfg.preempt_rate,
+                  shrink_at=({"train_step": [cfg.shrink_at_step]}
+                             if cfg.shrink_at_step >= 0 else None),
+                  shrink_to=cfg.shrink_to,
+                  grow_at=({"train_step": [cfg.grow_at_step]}
+                           if cfg.grow_at_step >= 0 else None),
+                  grow_to=cfg.grow_to,
                   collective_mismatch=cfg.collective_mismatch,
                   collective_mismatch_rank=cfg.collective_mismatch_rank)
         inj.source = "config"
@@ -166,7 +188,8 @@ class ChaosInjector:
             return op in self.ops
         if any(op in d for d in (self.fail_at, self.truncate_at,
                                  self.hang_at, self.delay_at, self.kill_at,
-                                 self.preempt_at)):
+                                 self.preempt_at, self.shrink_at,
+                                 self.grow_at)):
             return True
         return self.hang_rate > 0 or self.preempt_rate > 0
 
@@ -224,6 +247,25 @@ class ChaosInjector:
             logger.warning(f"chaos: injected SIGTERM (preempt) on {op} #{n} "
                            f"({path})")
             _os.kill(_os.getpid(), _signal.SIGTERM)
+        # fleet shrink/grow: preempt a SUBSET of devices on the simulated
+        # mesh (not SIGTERM-to-self) — the survivor set changes and a
+        # FleetResizeEvent is raised for the elastic agent to restart on
+        # the post-event world (elasticity/resize.py owns the mechanics)
+        for kind, at, to in (("shrink", self.shrink_at, self.shrink_to),
+                             ("grow", self.grow_at, self.grow_to)):
+            if n in at.get(op, ()):
+                from deepspeed_tpu.elasticity import resize as _resize
+
+                # log/count only when the event actually fires — the
+                # already-at-target no-op (a config-driven drill re-firing
+                # after its own restart) and the to_world<1 misconfig
+                # refusal must not record a phantom injection
+                try:
+                    _resize.apply_fleet_event(kind, to, op=op, path=path)
+                except _resize.FleetResizeEvent:
+                    self.log.append((op, f"{kind} to {to}", path))
+                    self._count(op, kind)
+                    raise
         # randomized hangs are step-oriented (the targets() contract): with
         # ops unset they never hit checkpoint I/O, where a default-hang_s
         # stall would run OUTSIDE any armed watchdog region — an explicit

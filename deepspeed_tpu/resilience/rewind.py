@@ -47,8 +47,10 @@ import numpy as np
 
 from deepspeed_tpu.utils.logging import log_dist, logger
 
+from deepspeed_tpu.runtime.checkpoint_engine.engine import (  # noqa: F401
+    REWIND_STATE_FILE, is_emergency_tag, world_signature)
+
 EMERGENCY_PREFIX = "emergency_step"
-REWIND_STATE_FILE = os.path.join("state", "rewind_state.npz")
 RAM_TIER_PATH = "ram://"
 # numeric codes for the `rewind/last_recovery_tier` gauge (ds_top maps
 # them back; mirrors the serving/state gauge convention)
@@ -99,23 +101,6 @@ def ram_snapshots() -> List[RamSnapshot]:
 def clear_ram_snapshots() -> None:
     """Drop the tier-0 ring (tests / an operator abandoning a run)."""
     _RING.clear()
-
-
-def is_emergency_tag(tag_dir: str) -> bool:
-    """Does this tag directory hold a tier-1 emergency snapshot (npz
-    payload) rather than an orbax state tree?"""
-    return os.path.isfile(os.path.join(tag_dir, REWIND_STATE_FILE))
-
-
-def _world_signature(engine) -> dict:
-    import jax
-
-    return {
-        "dp_world_size": int(engine.dp_world_size),
-        "device_count": int(len(jax.devices())),
-        "mesh_shape": sorted((str(k), int(v))
-                             for k, v in dict(engine.mesh.shape).items()),
-    }
 
 
 def _registry():
@@ -201,7 +186,7 @@ class RewindManager:
         snap = RamSnapshot(
             step=step if step is not None else int(jax.device_get(eng.state.step)),
             flat=flat, meta=capture_host_meta(eng),
-            world=_world_signature(eng),
+            world=world_signature(eng),
             ckpt_dir=os.path.abspath(ckpt_dir) if ckpt_dir else None)
         _RING.append(snap)
         del _RING[:-int(self.cfg.keep)]
@@ -223,7 +208,7 @@ class RewindManager:
         from deepspeed_tpu.runtime.checkpoint_engine.engine import \
             _flatten_state
 
-        world = _world_signature(self.engine)
+        world = world_signature(self.engine)
         if snap.world != world:
             return (f"world changed (snapshot {snap.world} vs engine "
                     f"{world})")
@@ -265,6 +250,25 @@ class RewindManager:
                     f"checkpoint dir {snap.ckpt_dir!r}, not the requested "
                     f"{for_dir!r}; skipping it (disk tiers decide)")
                 continue
+            if snap.world != world_signature(eng) \
+                    and getattr(eng, "_elastic_resize", None) is not None:
+                # elasticity.resize: a changed world is a RESIZE this
+                # tier can serve — the snapshot holds global arrays, so
+                # the survivor-mesh re-lay is a device_put into the new
+                # ShardingPlan (resize.py owns policy + telemetry).
+                # Freshness still gates: a newer verified disk tag wins
+                # (its orbax reshard-on-load handles the world natively).
+                if min_step is not None and snap.step < min_step:
+                    log_dist(f"rewind: disk tier (step {min_step}) is "
+                             f"fresher than the newest RAM snapshot (step "
+                             f"{snap.step}); using disk", ranks=[0])
+                    return None
+                from deepspeed_tpu.elasticity import resize as _resize
+
+                info = _resize.reshard_ram_snapshot(self, snap)
+                if info is None:
+                    continue
+                return info
             why = self._snapshot_mismatch(snap)
             if why:
                 logger.warning(
@@ -377,7 +381,7 @@ class RewindManager:
         eng = self.engine
         with open(os.path.join(tag_dir, "client_state.json")) as f:
             meta = json.load(f)
-        world = _world_signature(eng)
+        world = world_signature(eng)
         saved_world = meta.get("world") or {}
         # JSON round-trips the mesh-shape tuples as lists
         saved_world = {**saved_world,
@@ -385,13 +389,36 @@ class RewindManager:
                                       saved_world.get("mesh_shape", [])]}
         live_world = {**world, "mesh_shape": [list(x) for x in
                                               world["mesh_shape"]]}
+        resharding = False
         if saved_world != live_world:
-            logger.warning(
-                f"rewind: emergency tag {os.path.basename(tag_dir)!r} was "
-                f"captured on a different world ({saved_world} vs "
-                f"{live_world}); degrading loudly to the verified disk "
-                "tier (orbax reshard-on-load owns world changes)")
-            return None, meta
+            rz_cfg = getattr(eng, "_elastic_resize", None)
+            info = None
+            if rz_cfg is not None:
+                from deepspeed_tpu.elasticity import resize as _resize
+
+                info = _resize.annotation_from_worlds(meta.get("world"),
+                                                      world)
+                if info is not None and not _resize.check_resize_allowed(
+                        rz_cfg, info, tier="emergency"):
+                    # excluded tier: demote to the next candidate (a
+                    # min_world_size violation raised instead — no
+                    # older tier could fix a world below the floor)
+                    info = None
+            if info is None:
+                logger.warning(
+                    f"rewind: emergency tag {os.path.basename(tag_dir)!r} "
+                    f"was captured on a different world ({saved_world} vs "
+                    f"{live_world}); degrading loudly to the verified disk "
+                    "tier (orbax reshard-on-load owns world changes; the "
+                    "elasticity.resize knob lets this tier serve it)")
+                return None, meta
+            resharding = True
+            log_dist(
+                f"rewind: resharding emergency tag "
+                f"{os.path.basename(tag_dir)!r} across a "
+                f"{info['kind']} ({info['from_world']} -> "
+                f"{info['to_world']} device(s)) — the payload holds global "
+                "arrays, placement is metadata", ranks=[0])
         state_meta = meta.get("state_meta") or {}
         flat_sh = _flatten_state(eng.state_shardings)
         if set(state_meta) != set(flat_sh):
@@ -399,6 +426,20 @@ class RewindManager:
                 f"rewind: emergency tag {os.path.basename(tag_dir)!r} state "
                 "keys do not match this engine's TrainState; skipping")
             return None, meta
+        if resharding:
+            import jax as _jax
+
+            live_shapes = {k: tuple(v.shape) for k, v in _flatten_state(
+                _jax.eval_shape(lambda: eng.state)).items()}
+            saved_shapes = {k: tuple(sm["shape"])
+                            for k, sm in state_meta.items()}
+            if live_shapes != saved_shapes:
+                logger.warning(
+                    f"rewind: emergency tag {os.path.basename(tag_dir)!r} "
+                    "cannot be resharded (GLOBAL state shapes changed — "
+                    "model/optimizer mismatch, not a world change); "
+                    "skipping")
+                return None, meta
         with np.load(os.path.join(tag_dir, REWIND_STATE_FILE)) as z:
             flat_np = {}
             for key, sm in state_meta.items():
@@ -425,7 +466,8 @@ def write_emergency_tag(engine, save_dir: str, tag: str, snap: RamSnapshot,
     pointer every restart reads."""
     from deepspeed_tpu.resilience.fsio import atomic_write_bytes
     from deepspeed_tpu.resilience.manifest import write_manifest
-    from deepspeed_tpu.runtime.checkpoint_engine.engine import _retry_policy
+    from deepspeed_tpu.runtime.checkpoint_engine.engine import (_retry_policy,
+                                                                model_layout)
 
     tag_dir = os.path.join(os.path.abspath(save_dir), tag)
     os.makedirs(os.path.join(tag_dir, "state"), exist_ok=True)
@@ -466,6 +508,7 @@ def write_emergency_tag(engine, save_dir: str, tag: str, snap: RamSnapshot,
         "zero_stage": engine.zero_stage,
         "dp_world_size": engine.dp_world_size,
         "world": snap.world,
+        "model_layout": model_layout(engine),
         "client_state": {},
         "rewind": {
             "tier": "emergency",

@@ -38,6 +38,142 @@ def _ckpt_dir(save_dir: str, tag: str) -> str:
     return os.path.join(os.path.abspath(save_dir), str(tag))
 
 
+class CheckpointLayoutError(ValueError):
+    """A checkpoint's recorded model layout (head grouping) does not match
+    the live engine's. Param shapes are head-count invariant, so without
+    this guard a checkpoint trained under one attention grouping loads
+    silently and produces different outputs under another. NEVER demoted
+    to the next candidate by the restore ladder — every candidate of the
+    same run shares the layout, so walking back would just repeat the
+    mismatch against an older step."""
+
+
+# THE emergency-tag detection rule (tier-1 payload file), defined here —
+# not in resilience/rewind — because the restore ladder, ds_resize plan
+# and ds_report must classify tags WITHOUT importing the rewind module
+# (the strict no-op contract keeps it unloaded when the block is absent);
+# rewind re-exports these as its own names.
+REWIND_STATE_FILE = os.path.join("state", "rewind_state.npz")
+
+
+def is_emergency_tag(tag_dir: str) -> bool:
+    """Does this tag directory hold a tier-1 emergency snapshot (npz
+    payload) rather than an orbax state tree?"""
+    return os.path.isfile(os.path.join(tag_dir, REWIND_STATE_FILE))
+
+
+def world_signature(engine) -> dict:
+    """The facts that define a TrainState's placement world: dp degree,
+    backend device count, and the engine mesh's full named shape. Stamped
+    into every snapshot tier (RAM / emergency / ordinary client_state) so
+    a restore knows whether it is a same-world reload or a RESIZE."""
+    import jax as _jax
+
+    return {
+        "dp_world_size": int(engine.dp_world_size),
+        "device_count": int(len(_jax.devices())),
+        "mesh_shape": sorted((str(k), int(v))
+                             for k, v in dict(engine.mesh.shape).items()),
+    }
+
+
+def world_device_count(world: Optional[dict]) -> Optional[int]:
+    """Mesh device count of a (possibly JSON-round-tripped) world
+    signature — the ``from_world``/``to_world`` number a resize event is
+    priced in; None when the signature is absent/unparsable."""
+    if not isinstance(world, dict):
+        return None
+    try:
+        shape = world.get("mesh_shape") or []
+        if not shape:
+            return None         # a world with no mesh axes is unparsable
+        n = 1
+        for _, size in shape:
+            n *= int(size)
+        return n if n > 0 else None
+    except (TypeError, ValueError):
+        return None
+
+
+def tag_world(tag_dir: str) -> Optional[int]:
+    """Mesh device count a tag was SAVED under, read from its
+    ``client_state.json`` world signature — the one read ``ds_resize
+    plan`` and ``ds_report rewind`` share; None when the sidecar or the
+    signature is absent/unparsable."""
+    try:
+        with open(os.path.join(tag_dir, "client_state.json")) as f:
+            meta = json.load(f)
+        return world_device_count(meta.get("world"))
+    except (OSError, ValueError, TypeError):
+        return None
+
+
+def annotation_from_worlds(saved_world: Optional[dict],
+                           live_world: Optional[dict]) -> Optional[dict]:
+    """``{kind, from_world, to_world}`` for a world change between two
+    signatures, or None when they describe the same mesh (or either is
+    unreadable). THE classification rule every tier prices a resize by —
+    the RAM/emergency reshard paths and the disk tier's native
+    reshard-on-load must never disagree about what a world change is."""
+    from_n = world_device_count(saved_world)
+    to_n = world_device_count(live_world)
+    if not from_n or not to_n:
+        return None
+    norm = lambda w: {**w, "mesh_shape": [list(x) for x in
+                                          (w.get("mesh_shape") or [])]}
+    if norm(saved_world) == norm(live_world):
+        return None
+    kind = ("shrink" if to_n < from_n
+            else "grow" if to_n > from_n else "relayout")
+    return {"kind": kind, "from_world": from_n, "to_world": to_n}
+
+
+# checkpoint-recorded model-layout facts, validated on load. The head-
+# grouping fields are the dangerous ones (shape-invariant, silent); the
+# size fields ride along for a readable error and cost nothing.
+_LAYOUT_FIELDS = ("n_head", "n_kv_head", "num_attention_heads",
+                  "num_key_value_heads", "head_dim", "n_embd",
+                  "hidden_size", "n_layer")
+
+
+def model_layout(engine) -> Optional[dict]:
+    """Head-layout facts of the engine's model config (``n_head`` and
+    siblings), or None when the model carries no config object (bare
+    callable losses)."""
+    cfg = getattr(getattr(engine, "module", None), "config", None)
+    if cfg is None:
+        return None
+    out = {}
+    for f in _LAYOUT_FIELDS:
+        v = getattr(cfg, f, None)
+        if isinstance(v, int) and not isinstance(v, bool):
+            out[f] = v
+    return out or None
+
+
+def check_model_layout(engine, meta: dict, source: str) -> None:
+    """Raise :class:`CheckpointLayoutError` when the checkpoint's recorded
+    layout disagrees with the live model's on any shared field — naming
+    BOTH layouts. Checkpoints predating the record (no ``model_layout``)
+    and engines without a config object pass silently."""
+    saved = (meta or {}).get("model_layout")
+    live = model_layout(engine)
+    if not saved or not live:
+        return
+    diff = {f: (saved[f], live[f]) for f in saved
+            if f in live and saved[f] != live[f]}
+    if diff:
+        raise CheckpointLayoutError(
+            f"checkpoint {source} was saved under a different model layout: "
+            + "; ".join(f"{f} was {a} at save but is {b} now"
+                        for f, (a, b) in sorted(diff.items()))
+            + f" (saved layout {saved} vs live {live}). Param shapes are "
+            "head-count invariant, so loading would silently reinterpret "
+            "the attention grouping — refuse instead. Load with a model "
+            "config matching the checkpoint, or re-export the weights "
+            "under the new layout.")
+
+
 def _retry_policy(engine) -> RetryPolicy:
     """The engine's configured retry policy for checkpoint filesystem I/O
     (resilience.retry block); default policy when the engine predates it."""
@@ -247,6 +383,12 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             "client_state": client_state or {},
             "zero_stage": engine.zero_stage,
             "dp_world_size": engine.dp_world_size,
+            # the placement world + head layout this state was saved
+            # under: the resize path prices world changes from the
+            # former; the load guard refuses silent attention-grouping
+            # reinterpretation from the latter
+            "world": world_signature(engine),
+            "model_layout": model_layout(engine),
             # curriculum data sampler (reference ds_sampler state in
             # client_sd): rng + draw order + position → mid-epoch resume
             "data_sampler": sampler_sd,
@@ -374,11 +516,33 @@ def apply_restored_meta(engine, meta: dict):
                 try:
                     loader.load_state_dict(loader_sd)
                 except ValueError as e:
-                    # a changed dataset/batch geometry: resuming the old
-                    # position would mis-account samples — start the
-                    # loader fresh and say so
-                    logger.warning(f"dataloader position NOT restored ({e}); "
-                                   "the loader starts from its beginning")
+                    restored = False
+                    if getattr(engine, "_elastic_resize", None) is not None:
+                        # elasticity.resize: a changed BATCH geometry is a
+                        # world resize, not corruption — repartition the
+                        # exactly-once position at sample granularity
+                        # across the new world (other mismatches still
+                        # refuse inside the loader)
+                        try:
+                            loader.load_state_dict(loader_sd,
+                                                   repartition=True)
+                            restored = True
+                            log_dist(
+                                "dataloader position REPARTITIONED across "
+                                f"the new batch geometry (captured "
+                                f"batch_size="
+                                f"{loader_sd.get('batch_size')}, resumed at "
+                                f"sample {loader_sd.get('sample_idx', '?')})",
+                                ranks=[0])
+                        except (TypeError, ValueError) as e2:
+                            e = e2
+                    if not restored:
+                        # a changed dataset/batch geometry: resuming the
+                        # old position would mis-account samples — start
+                        # the loader fresh and say so
+                        logger.warning(
+                            f"dataloader position NOT restored ({e}); "
+                            "the loader starts from its beginning")
             else:
                 logger.warning(
                     "checkpoint carries a dataloader position but this "
@@ -506,8 +670,7 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 logger.warning(f"skipping checkpoint {cand!r}: {reason}")
                 skipped.append(cand)
                 continue
-        is_emergency = os.path.isfile(
-            os.path.join(path, "state", "rewind_state.npz"))
+        is_emergency = is_emergency_tag(path)
         if is_emergency and rewind_mgr is None:
             # the strict no-op contract keeps the rewind module unloaded
             # without its block — an emergency tag is then explicitly
@@ -544,6 +707,12 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 sampler_sd["admitted"] = np.load(
                     os.path.join(path, sampler_sd.pop("admitted_file")))
         except Exception as e:
+            from deepspeed_tpu.elasticity.config import ElasticityError
+            if isinstance(e, ElasticityError):
+                # a resize POLICY violation (min_world_size) is a loud
+                # refusal, never a demotion: every candidate would land
+                # on the same forbidden world
+                raise
             # half-written orbax dirs, unparseable JSON, truncated sidecars:
             # everything restore-side demotes to the next-newest candidate
             logger.warning(f"skipping checkpoint {cand!r}: restore failed ({e})")
@@ -568,6 +737,34 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                        f"(tried {candidates}); nothing loaded")
         return None, {}
 
+    # head-layout guard BEFORE any state is applied; deliberately outside
+    # the demotion loop — every candidate of this run shares the layout,
+    # so walking back would repeat the mismatch against an older step
+    check_model_layout(engine, meta, source=os.path.basename(str(cand)))
+
+    # world change = a RESIZE served by this tier (the disk tier reshards
+    # natively via orbax; the RAM/emergency tiers resharded above when
+    # elasticity.resize armed them) — priced into the recovery record
+    resize_info = None
+    saved_world = (meta or {}).get("world")
+    if saved_world is not None:
+        resize_info = annotation_from_worlds(saved_world,
+                                             world_signature(engine))
+    rz_cfg = getattr(engine, "_elastic_resize", None)
+    if resize_info is not None and rz_cfg is not None:
+        from deepspeed_tpu.elasticity import resize as _resize
+
+        # min_world_size raises LOUDLY inside; a tiers exclusion reaching
+        # THIS tier also raises — it is the bottom of the ladder, there
+        # is no deeper tier left to demote to
+        if not _resize.check_resize_allowed(rz_cfg, resize_info, tier=tier):
+            raise _resize.ResizeError(
+                f"resize {resize_info['kind']} {resize_info['from_world']}"
+                f" -> {resize_info['to_world']} device(s) would be served "
+                f"by the {tier!r} tier, which elasticity.resize.tiers="
+                f"{list(rz_cfg.tiers)} excludes — and no deeper tier can "
+                "serve it")
+
     if load_module_only or not load_optimizer_states:
         state = engine.state._replace(params=restored.params,
                                       master=restored.master if not load_module_only else engine.state.master)
@@ -586,6 +783,16 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         "steps_lost": rew_meta.get("steps_lost_at_save"),
         "restore_s": round(_time.perf_counter() - t_restore, 4),
     }
+    if resize_info is not None:
+        engine._last_recovery["resize"] = resize_info
+        engine._last_recovery["reshard_s"] = \
+            engine._last_recovery["restore_s"]
+        if rz_cfg is not None:
+            from deepspeed_tpu.elasticity import resize as _resize
+
+            _resize.note_resize_event(
+                resize_info, tier=tier,
+                reshard_s=engine._last_recovery["reshard_s"])
     if rewind_mgr is not None:
         rewind_mgr.note_recovery(engine._last_recovery)
     if skipped:

@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Optional, Union
 
-from pydantic import Field, field_validator
+from pydantic import Field, field_validator, model_validator
 
 from deepspeed_tpu.runtime.config_utils import (DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys)
 from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
@@ -82,6 +82,20 @@ ADVISORY_NOOP_KEYS = {
         "composes with every ZeRO stage (state sharding is planned from the "
         "state pytree, not from a known-optimizer table) — there is no "
         "untested-optimizer gate to bypass.",
+    "zero_force_ds_cpu_optimizer":
+        "there is no DeepSpeedCPUAdam to force: ZeRO-Offload keeps the "
+        "optimizer math on the chip and streams state through pinned host "
+        "memory (or host-steps it via the aio layer under NVMe offload) — "
+        "the optimizer implementation is the same either way, so the "
+        "reference's torch.optim-vs-CPUAdam guard (runtime/config.py:816, "
+        "default true in ZeRO-offload/DeepSpeed-Chat configs) has nothing "
+        "to select between.",
+    "timers":
+        "the reference's top-level timers block (timers.throughput.enabled, "
+        "config.py get_timers_config) gates its synchronized step timing; "
+        "here throughput timing is always on host-side (ThroughputTimer) "
+        "and the synchronized/full breakdown is the wall_clock_breakdown "
+        "knob + the telemetry block — set those instead.",
 }
 
 # Reference keys REFUSED with a pointer (not silently accepted): accepting
@@ -325,6 +339,46 @@ class EigenvalueConfig(DeepSpeedConfigModel):
     layer_num: int = Field(0, ge=0)
 
 
+class ElasticityResizeConfig(DeepSpeedConfigModel):
+    """ds_resize — elastic resize WITHOUT a cold restart
+    (elasticity/resize.py + ``bin/ds_resize``). With the block enabled, a
+    world-size change at restore time is served by the freshest verified
+    snapshot tier instead of refused: the tier-0 host-RAM ring and tier-1
+    ``emergency_step<N>`` tags re-lay the full TrainState from N to M
+    devices (a survivor-mesh ``device_put`` into the new ShardingPlan —
+    snapshots hold GLOBAL host arrays, so placement is metadata), the
+    tier-2 disk checkpoint keeps its native orbax reshard-on-load, the
+    resumable dataloader position is REPARTITIONED across the new batch
+    geometry at sample granularity (exactly-once: zero repeated, zero
+    skipped samples — except a drop_last tail of the resize epoch, which
+    is skipped with a loud warning), and the whole event is priced into
+    the goodput
+    restart record as ``{kind: shrink|grow, from_world, to_world, tier,
+    steps_lost, reshard_s}`` (rendered by ``ds_prof goodput`` / ``ds_top``
+    / ``ds_report``). Losing a host then costs one in-process restart
+    with ``steps_lost <= rewind.ram_interval`` instead of a cold bring-up
+    from a stale checkpoint. STRICT no-op when the knob is absent/false:
+    the resize module is never imported and every tier keeps its PR-10
+    refuse-loudly behavior (asserted in tests/unit/test_resize.py). See
+    docs/CONFIG.md 'elasticity' section for the per-tier RPO/cost table."""
+    enabled: bool = Field(False, description="serve world-size changes from the snapshot ladder (RAM/emergency tiers reshard instead of refusing); false keeps the PR-10 degrade-loudly-to-disk behavior")
+    min_world_size: int = Field(1, ge=1, description="refuse (loudly) to resize onto fewer devices than this — the floor below which the job should fail over to a full redeploy instead of limping")
+    tiers: list = Field(["ram", "emergency", "disk"], description="snapshot tiers allowed to serve a RESIZE, freshest-first ladder order preserved; e.g. ['disk'] forces every world change through the verified checkpoint")
+
+    @field_validator("tiers")
+    @classmethod
+    def _tiers_known(cls, v):
+        known = ("ram", "emergency", "disk")
+        bad = [t for t in v if t not in known]
+        if bad:
+            raise ValueError(f"elasticity.resize.tiers: unknown tier(s) "
+                             f"{bad}; known: {known}")
+        if not v:
+            raise ValueError("elasticity.resize.tiers must name at least one "
+                             "tier (else no resize could ever be served)")
+        return v
+
+
 class ElasticityConfig(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -335,6 +389,13 @@ class ElasticityConfig(DeepSpeedConfigModel):
     version: float = 0.2
     ignore_non_elastic_batch_info: bool = False
     prefer_larger_batch_size: bool = True
+    # reference v0.2 keys (elasticity/config.py ElasticityConfig): world
+    # sizes must be multiples of num_gpus_per_node × model_parallel_size —
+    # accepted here too so reference configs port unchanged
+    model_parallel_size: int = Field(1, ge=1)
+    num_gpus_per_node: int = Field(1, ge=1)
+    # TPU extension: live reshard-on-resize (ds_resize)
+    resize: ElasticityResizeConfig = {}
 
 
 class ResilienceRetryConfig(DeepSpeedConfigModel):
@@ -374,9 +435,27 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
     hang_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-op probability of an injected interruptible HANG (watchdog detection drills)")
     hang_s: float = Field(3600.0, ge=0.0, description="duration of an injected hang (s); the watchdog is expected to fire well before it ends")
     preempt_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-step probability of an injected SIGTERM to self (the Cloud TPU preemption warning) — drills the elastic agent's preemption watch and the rewind emergency-save path")
+    shrink_at_step: int = Field(-1, ge=-1, description="fleet-scale shrink drill (ds_resize): at this train step, preempt devices on the simulated mesh down to shrink_to survivors and raise FleetResizeEvent so the elastic agent restarts resharded on the survivor world; -1 = off")
+    shrink_to: int = Field(0, ge=0, description="post-shrink survivor device count for shrink_at_step (clamped to [1, backend devices])")
+    grow_at_step: int = Field(-1, ge=-1, description="fleet-scale grow drill (ds_resize): at this train step, widen the simulated survivor set to grow_to devices and raise FleetResizeEvent; -1 = off")
+    grow_to: int = Field(0, ge=0, description="post-grow device count for grow_at_step (clamped to the backend's real device count)")
     ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/emergency_save/train_step/decode_step/collective); empty = all")
     collective_mismatch: bool = Field(False, description="perturb this rank's ds_doctor-recorded collective sequence (swap/mutate/phantom, seed-deterministic) so the static deadlock detector has a reproducible divergent rank to catch")
     collective_mismatch_rank: int = Field(-1, ge=-1, description="process whose recorded sequence is perturbed (-1 = every recording process)")
+
+    @model_validator(mode="after")
+    def _fleet_drill_targets_set(self):
+        # an armed shrink/grow drill whose target was left at the 0 default
+        # would collapse the fleet to 1 device — a typo, not a drill
+        if self.shrink_at_step >= 0 and self.shrink_to < 1:
+            raise ValueError(
+                "resilience.chaos: shrink_at_step is set but shrink_to is "
+                f"{self.shrink_to} — name the survivor count (>= 1)")
+        if self.grow_at_step >= 0 and self.grow_to < 1:
+            raise ValueError(
+                "resilience.chaos: grow_at_step is set but grow_to is "
+                f"{self.grow_to} — name the post-grow device count (>= 1)")
+        return self
 
 
 class TelemetryConfig(DeepSpeedConfigModel):

@@ -16,6 +16,8 @@ import numpy as np
 
 import jax
 
+from deepspeed_tpu.utils.logging import logger
+
 
 class RepeatingLoader:
     """Wrap an iterator to restart on StopIteration (reference RepeatingLoader)."""
@@ -40,9 +42,13 @@ class RepeatingLoader:
             return self.loader.state_dict()
         return None
 
-    def load_state_dict(self, sd):
+    def load_state_dict(self, sd, repartition=False):
         if hasattr(self.loader, "load_state_dict"):
-            self.loader.load_state_dict(sd)
+            try:
+                self.loader.load_state_dict(sd, repartition=repartition)
+            except TypeError:
+                # wrapped loader predates the repartition kwarg
+                self.loader.load_state_dict(sd)
             # the live iterator holds the OLD position; rebuild it so the
             # next __next__ continues from the restored one
             self.data_iter = iter(self.loader)
@@ -76,12 +82,19 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.epoch = 0
         self.data_sampler = data_sampler
-        # resumable position: batches CONSUMED in the current pass (the
-        # counter advances before each yield, so a snapshot taken after
-        # processing batch b records b+1 — the replayed window after a
-        # rewind continues at b+1, never re-drawing or skipping a sample)
+        # resumable position, at SAMPLE granularity: samples CONSUMED in
+        # the current pass (advanced before each yield, so a snapshot taken
+        # after processing batch b records b·batch_size — the replayed
+        # window after a rewind continues there, never re-drawing or
+        # skipping a sample). The epoch ORDER depends only on (seed,
+        # epoch), not on the batch size, which is what makes an elastic
+        # RESIZE repartitionable: a position captured under one global
+        # batch converts exactly to sample units and resumes under another
+        # (load_state_dict(..., repartition=True)). `_batch_idx` is the
+        # derived batches-consumed counter the pre-resize state carried.
         self._batch_idx = 0
-        self._resume_batch_idx: Optional[int] = None
+        self._sample_idx = 0
+        self._resume_sample_idx: Optional[int] = None
         if data_sampler is not None:
             self.len = len(data_sampler) // self.batch_size
         else:
@@ -91,7 +104,8 @@ class DeepSpeedDataLoader:
     def set_epoch(self, epoch: int):
         self.epoch = epoch
         self._batch_idx = 0
-        self._resume_batch_idx = None
+        self._sample_idx = 0
+        self._resume_sample_idx = None
 
     def __len__(self):
         return self.len
@@ -107,6 +121,7 @@ class DeepSpeedDataLoader:
         return {
             "epoch": self.epoch,
             "batch_idx": self._batch_idx,
+            "sample_idx": self._sample_idx,
             "batch_size": self.batch_size,
             "seed": self.seed,
             "shuffle": self.shuffle,
@@ -115,11 +130,21 @@ class DeepSpeedDataLoader:
             "sampler_driven": self.data_sampler is not None,
         }
 
-    def load_state_dict(self, sd: dict):
+    def load_state_dict(self, sd: dict, repartition: bool = False):
         """Resume iteration from a captured position. Raises ValueError
         when the batch geometry or dataset changed — silently resuming a
         position computed over a different index universe would repeat or
-        skip samples, the exact bug this state exists to prevent."""
+        skip samples, the exact bug this state exists to prevent.
+
+        ``repartition=True`` (the elastic-resize path) forgives ONE kind
+        of change — the batch size: the epoch order is a pure function of
+        (seed, epoch), so the captured position converts exactly to
+        sample units and iteration continues mid-epoch at the first
+        unconsumed sample under the NEW batch geometry — exactly-once
+        accounting across a world resize. Everything that would change
+        the order itself (seed, shuffle, dataset, sampler mode,
+        drop_last) still refuses loudly."""
+        cap_bs = int(sd.get("batch_size", self.batch_size))
         for key, mine in (("batch_size", self.batch_size),
                           ("seed", self.seed), ("shuffle", self.shuffle),
                           ("drop_last", self.drop_last),
@@ -127,19 +152,45 @@ class DeepSpeedDataLoader:
                           ("sampler_driven", self.data_sampler is not None)):
             theirs = sd.get(key, mine)
             if theirs != mine:
+                if key == "batch_size" and repartition:
+                    continue        # sample-unit resume absorbs it below
                 raise ValueError(
                     f"dataloader state mismatch: {key} was {theirs!r} at "
                     f"capture but is {mine!r} now — the sample order would "
-                    "not reproduce")
+                    "not reproduce"
+                    + (" (only batch_size is repartitionable)"
+                       if repartition else ""))
         if self.data_sampler is not None:
             return      # the sampler's own state carries the position
         epoch = int(sd.get("epoch", 0))
-        idx = int(sd.get("batch_idx", 0))
-        if idx >= self.len:         # captured exactly at an epoch boundary
-            epoch, idx = epoch + 1, 0
+        # sample-unit position; pre-resize states carried batches only
+        s = int(sd.get("sample_idx", int(sd.get("batch_idx", 0)) * cap_bs))
+        n = len(self.dataset)
+        # samples a full pass consumed under the CAPTURE geometry — a
+        # position at/past it was captured exactly at an epoch boundary
+        usable_cap = (n // cap_bs) * cap_bs if self.drop_last else n
+        if s >= usable_cap:
+            epoch, s = epoch + 1, 0
         self.epoch = epoch
-        self._batch_idx = idx
-        self._resume_batch_idx = idx
+        self._sample_idx = s
+        self._batch_idx = -(-s // self.batch_size)
+        self._resume_sample_idx = s
+        if repartition and cap_bs != self.batch_size and self.drop_last:
+            # drop_last truncates each epoch at a FULL batch of the live
+            # geometry: a repartition can therefore orphan up to
+            # new_batch_size-1 tail samples the capture geometry would
+            # still have trained this epoch — exactly-once holds for
+            # every sample both geometries consume, but the orphaned
+            # tail is a real (loud) skip, not silent
+            end_new = s + ((n - s) // self.batch_size) * self.batch_size
+            if end_new < usable_cap:
+                logger.warning(
+                    f"dataloader repartition: drop_last leaves "
+                    f"{usable_cap - end_new} tail sample(s) of epoch "
+                    f"{epoch} unconsumed under the new batch_size="
+                    f"{self.batch_size} (the captured batch_size={cap_bs} "
+                    "geometry would have trained them) — skipped this "
+                    "epoch, never repeated")
 
     def _epoch_order(self):
         order = np.arange(len(self.dataset))
@@ -159,34 +210,36 @@ class DeepSpeedDataLoader:
                     idx = idx[pid::nproc]
                 yield self.collate_fn([self.dataset[int(i)] for i in idx])
             return
-        b = self._resume_batch_idx if self._resume_batch_idx is not None else 0
-        self._resume_batch_idx = None
+        s = self._resume_sample_idx if self._resume_sample_idx is not None else 0
+        self._resume_sample_idx = None
         epoch = self.epoch
         order = self._epoch_order()
-        while b < self.len:
-            if self._resume_batch_idx is not None:
+        while s < len(order):
+            if self._resume_sample_idx is not None:
                 # a mid-iteration rewind (the sentinel / an in-RAM restore
                 # called load_state_dict while this generator is LIVE):
                 # jump back so the re-trodden steps consume the SAME
                 # batches instead of silently marching on
-                b = self._resume_batch_idx
-                self._resume_batch_idx = None
+                s = self._resume_sample_idx
+                self._resume_sample_idx = None
                 if self.epoch != epoch:
                     epoch = self.epoch
                     order = self._epoch_order()
                 continue
-            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            idx = order[s:s + self.batch_size]
             if len(idx) < self.batch_size and self.drop_last:
                 break
+            s += len(idx)
             if nproc > 1:
                 idx = idx[pid::nproc]
-            self._batch_idx = b + 1
+            self._sample_idx = s
+            self._batch_idx = -(-s // self.batch_size)
             yield self.collate_fn([self.dataset[int(i)] for i in idx])
-            b += 1
         # a COMPLETED pass advances the epoch, so a RepeatingLoader's
         # re-iteration draws the next epoch's order — which is also what
-        # makes a state captured exactly at the boundary (batch_idx ==
-        # len) unambiguous: the next batch anyone sees is epoch+1's
-        # first, exactly where load_state_dict resumes it
+        # makes a state captured exactly at the boundary (sample_idx past
+        # the last full batch) unambiguous: the next batch anyone sees is
+        # epoch+1's first, exactly where load_state_dict resumes it
         self.epoch = epoch + 1
         self._batch_idx = 0
+        self._sample_idx = 0

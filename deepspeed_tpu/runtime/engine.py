@@ -448,6 +448,14 @@ class DeepSpeedEngine:
             from deepspeed_tpu.resilience.rewind import RewindManager
 
             self._rewind = RewindManager(self, self._config.rewind)
+        # ---- elastic resize (ds_resize) ----------------------------------
+        # elasticity.resize: arm the snapshot ladder's survivor-mesh
+        # reshard path. Holding the pydantic block is enough — the resize
+        # module itself is imported only at a restore that actually
+        # crosses a world change (STRICT no-op otherwise: no import, no
+        # thread, no device copy — asserted in tests/unit/test_resize.py).
+        ecfg = self._config.elasticity_config
+        self._elastic_resize = ecfg.resize if ecfg.resize.enabled else None
         from deepspeed_tpu.resilience import chaos as _chaos_mod
 
         if res_cfg.chaos.enabled:
@@ -1511,12 +1519,18 @@ class DeepSpeedEngine:
                 # AFTER the sentinel: a step the sentinel flagged (or a
                 # rewound-to step) must not enter the tier-0 ring
                 self._rewind.maybe_snapshot(self._host_step, metrics)
-            if self.eigenvalue is not None:
-                self._maybe_update_eigenvalue(batch)
             # the timer stop syncs on the loss, so the enclosing span's
             # duration covers the device step, not just its dispatch
             self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics.loss)
             self.tput_timer.stop(global_step=True, sync_obj=metrics.loss)
+        if self.eigenvalue is not None:
+            # OUTSIDE the TRAIN_BATCH_TIMER/tput window AND the
+            # train_batch span: the power-iteration estimate used to
+            # inflate gas-boundary step times and deflate reported
+            # throughput — it is its own measured phase now
+            with _telemetry.get_tracer().span(
+                    "eigenvalue", step=getattr(self, "_host_step", 0)):
+                self._maybe_update_eigenvalue(batch)
         if self.flops_profiler_cfg.enabled and \
                 getattr(self, "_host_step", 0) == self.flops_profiler_cfg.profile_step:
             self._run_flops_profiler(batch, gas)

@@ -94,6 +94,27 @@ def test_advisory_noop_keys_accepted_and_tracked():
         assert len(why) > 40, f"{key} rationale too thin"
 
 
+def test_reference_zero_offload_chat_config_keys_are_advisory():
+    """The reference's DeepSpeed-Chat / ZeRO-offload config surface parses
+    unchanged: `zero_force_ds_cpu_optimizer` (default-true in the
+    reference's offload recipes — strict validation used to hard-reject
+    it) and the top-level `timers` block are advisory no-ops with a
+    written rationale, never a rejection."""
+    from deepspeed_tpu.runtime.config import ADVISORY_NOOP_KEYS, DeepSpeedConfig
+
+    assert "zero_force_ds_cpu_optimizer" in ADVISORY_NOOP_KEYS
+    assert "timers" in ADVISORY_NOOP_KEYS
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_force_ds_cpu_optimizer": True,
+        "timers": {"throughput": {"enabled": True, "synchronized": True}},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+    })
+    assert {"zero_force_ds_cpu_optimizer",
+            "timers"} <= set(cfg.advisory_keys_set)
+
+
 def test_unknown_top_level_key_rejected_with_hint():
     from deepspeed_tpu.runtime.config import DeepSpeedConfig
 

@@ -367,6 +367,62 @@ class TestJobReport:
         assert "restart" in text and "WatchdogTimeout" in text
         assert "goodput:" in text
 
+    def test_background_span_does_not_stretch_session_or_gap(self, tmp_path):
+        """A background async-checkpoint commit span that outlives the
+        step loop must not define the session's extent: pre-fix it
+        stretched session 1 into the restart gap, compressed the charged
+        downtime to ~0 and pushed the restart record outside the match
+        window — silently dropping the resize annotation THE drill
+        asserts on."""
+        from deepspeed_tpu.goodput.report import (build_job_report,
+                                                  render_goodput_report)
+
+        t0 = 1_700_000_000.0
+        spans = _steps(2)
+        # commit thread finishes 4.8 s into the 5 s restart gap
+        spans.append(_span("save_checkpoint", 150_000, 4_850_000,
+                           cat="checkpoint", background=True))
+        s1 = tmp_path / "trace.session1.json"
+        s2 = tmp_path / "trace.json"
+        s1.write_text(json.dumps(_session_trace(0, t0, spans)))
+        s2.write_text(json.dumps(_session_trace(
+            0, t0 + 0.2 + 5.0, _steps(2, first_step=2))))
+        rlog = [{"restart": 1, "error": "FleetResizeEvent: fleet shrink",
+                 "ts": t0 + 0.25, "tier": "ram", "snapshot_step": 2,
+                 "steps_lost": 1, "restore_s": 0.01, "reshard_s": 0.01,
+                 "resize": {"kind": "shrink", "from_world": 8,
+                            "to_world": 6}}]
+        report = build_job_report([str(s1), str(s2)], restart_log=rlog)
+        assert report["buckets_s"]["restart"] == pytest.approx(5.0, rel=0.01)
+        assert report["restarts"][0]["reasons"] == \
+            ["FleetResizeEvent: fleet shrink"]
+        text = render_goodput_report(report)
+        assert "shrink 8->6 resharded" in text
+
+    def test_unmatched_record_attaches_to_nearest_gap(self, tmp_path):
+        """A restart record whose ts misses every gap's exact window
+        (anchor wobble, a late flush) still annotates the nearest gap —
+        loudly — instead of vanishing from the report."""
+        from deepspeed_tpu.goodput.report import (build_job_report,
+                                                  render_goodput_report)
+
+        t0 = 1_700_000_000.0
+        s1 = tmp_path / "trace.session1.json"
+        s2 = tmp_path / "trace.json"
+        s1.write_text(json.dumps(_session_trace(0, t0, _steps(2))))
+        s2.write_text(json.dumps(_session_trace(
+            0, t0 + 0.2 + 5.0, _steps(20, first_step=2))))
+        # stamped 2.5 s AFTER session 2 began (a slow restore): outside
+        # the gap's +1 s window, inside the 30 s nearest-gap slack
+        rlog = [{"restart": 1, "error": "resume from disk tier",
+                 "ts": t0 + 5.2 + 2.5, "tier": "disk", "snapshot_step": 2,
+                 "steps_lost": 0, "restore_s": 2.4}]
+        report = build_job_report([str(s1), str(s2)], restart_log=rlog)
+        assert report["restarts"][0]["reasons"] == ["resume from disk tier"]
+        assert report["restarts"][0]["recoveries"][0]["tier"] == "disk"
+        assert any("nearest gap" in w for w in report["warnings"])
+        assert "disk tier" in render_goodput_report(report)
+
     def test_missing_anchor_degrades_loudly(self, tmp_path):
         from deepspeed_tpu.goodput.report import build_job_report
 

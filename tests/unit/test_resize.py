@@ -1,0 +1,883 @@
+"""ds_resize tests — elastic resize without restart.
+
+All CPU-only and deterministic on the faked 8-device mesh. The drill
+matrix the acceptance criteria name:
+
+* THE drill (ROADMAP Item 4 exit criterion): a chaos fleet shrink kills
+  2 of 8 devices mid-run; the job resumes resharded on 6 survivors with
+  ``steps_lost <= ram_interval``, losses bitwise-continuing from the
+  restored step (vs a clean 6-device oracle), and the whole event priced
+  in the ``ds_prof goodput`` fleet-seconds table as a restart annotated
+  ``{kind: shrink, from_world: 8, to_world: 6, tier, steps_lost,
+  reshard_s}``;
+* shrink 8→4, grow 4→8, resize served by the disk tier only, loud
+  refusal on an indivisible dp degree, resize policy (``min_world_size``
+  raises, an excluded tier demotes);
+* exactly-once dataloader accounting across a batch-geometry
+  repartition;
+* strict no-op when the knob is absent: the resize module is never
+  imported and every tier keeps its refuse-loudly behavior.
+"""
+
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.elasticity import DSElasticAgent
+from deepspeed_tpu.models.simple import SimpleModel
+from deepspeed_tpu.resilience import (ChaosInjector, install_chaos,
+                                      uninstall_chaos)
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+pytestmark = pytest.mark.resize
+
+HIDDEN = 16
+TBS = 24                # divides 8, 6, 4 — the drill worlds
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+RESIZE_MOD = "deepspeed_tpu.elasticity.resize"
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Fresh chaos, fresh tier-0 ring, full fleet, untouched handlers."""
+    orig = {s: signal.getsignal(s) for s in (signal.SIGTERM, signal.SIGINT)}
+    yield
+    uninstall_chaos()
+    rw = sys.modules.get("deepspeed_tpu.resilience.rewind")
+    if rw is not None:
+        rw.clear_ram_snapshots()
+    rz = sys.modules.get(RESIZE_MOD)
+    if rz is not None:
+        rz.clear_fleet_events()
+    for s, h in orig.items():
+        signal.signal(s, h)
+
+
+def plain_engine(rewind=None, elasticity=None, extra=None, model=None):
+    """An engine over the FULL backend mesh — never touches resize.py."""
+    comm.cdb = None
+    cfg = {"train_batch_size": TBS,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 0}
+    if rewind is not None:
+        cfg["rewind"] = rewind
+    if elasticity is not None:
+        cfg["elasticity"] = elasticity
+    if extra:
+        cfg.update(extra)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model or SimpleModel(hidden_dim=HIDDEN, nlayers=2), config=cfg)
+    return engine
+
+
+def survivor_engine(rewind=None, resize=True, extra=None):
+    """An engine whose dp mesh spans the simulated fleet's survivors —
+    what an elastic drill factory builds after a membership change."""
+    from deepspeed_tpu.elasticity import resize as rz
+
+    comm.cdb = None
+    cfg = {"train_batch_size": TBS,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 0}
+    if rewind is not None:
+        cfg["rewind"] = rewind
+    if resize:
+        cfg["elasticity"] = {
+            "resize": {"enabled": True} if resize is True else resize}
+    if extra:
+        cfg.update(extra)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=2), config=cfg,
+        mpu=types.SimpleNamespace(mesh=rz.survivor_mesh()))
+    return engine
+
+
+def batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(TBS, HIDDEN).astype(np.float32),
+            rng.randn(TBS, HIDDEN).astype(np.float32))
+
+
+def batch_seq():
+    """Deterministic per-position batch stream: attempt N's k-th yield
+    equals attempt M's k-th yield, so a drilled run and its oracle see
+    the same data at the same step index."""
+    return (batch(seed=i) for i in itertools.count())
+
+
+def params_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(la)),
+                                      np.asarray(jax.device_get(lb)))
+
+
+# ------------------------------------------------------------ strict no-op
+class TestStrictNoOp:
+    def test_knob_absent_never_imports_module(self, tmp_path):
+        saved = {m: sys.modules.pop(m) for m in list(sys.modules)
+                 if m == RESIZE_MOD}
+        threads_before = threading.active_count()
+        try:
+            engine = plain_engine(rewind={"ram_interval": 1})
+            engine.train_batch(batch())
+            engine.train_batch(batch())
+            # no new threads on the step path (the checkpoint round-trip
+            # below legitimately spawns orbax commit threads)
+            assert threading.active_count() <= threads_before
+            engine.save_checkpoint(str(tmp_path))
+            engine.train_batch(batch())
+            engine.load_checkpoint(str(tmp_path))     # same-world ladder walk
+            assert engine._elastic_resize is None
+            assert RESIZE_MOD not in sys.modules
+        finally:
+            sys.modules.update(saved)
+
+    def test_changed_world_without_knob_degrades_without_import(self, tmp_path):
+        """The PR-10 refuse-loudly behavior is UNCHANGED when the knob is
+        absent — and the degrade path itself never imports resize.py."""
+        save = str(tmp_path / "ckpt")
+        engine = plain_engine(rewind={"ram_interval": 1})
+        for _ in range(2):
+            engine.train_batch(batch())
+        engine.save_checkpoint(save)                 # ordinary @2, dp=8 world
+        engine.train_batch(batch())
+        engine._rewind.emergency_save(save)          # emergency @3, dp=8 world
+
+        saved = {m: sys.modules.pop(m) for m in list(sys.modules)
+                 if m == RESIZE_MOD}
+        try:
+            # "scale down" without the knob: dp=4 × tp=2 — RAM ring and
+            # emergency tag must be skipped, the disk tier must win
+            engine2 = plain_engine(rewind={"ram_interval": 1},
+                                   extra={"tpu": {"data": 4, "tensor": 2}})
+            path, _ = engine2.load_checkpoint(save)
+            assert os.path.basename(path) == "global_step2"
+            assert engine2._last_recovery["tier"] == "disk"
+            assert RESIZE_MOD not in sys.modules
+        finally:
+            sys.modules.update(saved)
+
+    def test_enabled_false_is_noop(self):
+        saved = {m: sys.modules.pop(m) for m in list(sys.modules)
+                 if m == RESIZE_MOD}
+        try:
+            engine = plain_engine(
+                elasticity={"resize": {"enabled": False}})
+            engine.train_batch(batch())
+            assert engine._elastic_resize is None
+            assert RESIZE_MOD not in sys.modules
+        finally:
+            sys.modules.update(saved)
+
+    def test_unknown_key_rejected_with_hint(self):
+        with pytest.raises(ValueError, match="min_world_size"):
+            plain_engine(elasticity={"resize": {"min_world_sizee": 4}})
+
+    def test_unknown_tier_rejected(self):
+        with pytest.raises(ValueError, match="unknown tier"):
+            plain_engine(elasticity={"resize": {"enabled": True,
+                                                "tiers": ["ram", "nvme"]}})
+
+    def test_armed_drill_without_target_rejected(self):
+        """shrink_at_step with shrink_to left at its 0 default would
+        collapse the fleet to 1 device — refused at config validation."""
+        with pytest.raises(ValueError, match="shrink_to"):
+            plain_engine(extra={"resilience": {
+                "chaos": {"enabled": True, "shrink_at_step": 3}}})
+        with pytest.raises(ValueError, match="grow_to"):
+            plain_engine(extra={"resilience": {
+                "chaos": {"enabled": True, "grow_at_step": 3}}})
+
+
+# --------------------------------------------------------- the chaos drills
+@pytest.mark.chaos
+class TestShrinkDrill:
+    def test_THE_drill_shrink_8_to_6_goodput_priced(self, tmp_path):
+        """ROADMAP Item 4 exit criterion, end to end: chaos kills 2 of 8
+        devices mid-run; the survivors keep training resharded with
+        steps_lost <= ram_interval, losses bitwise-matching a clean
+        6-device continuation from the restored step, and `ds_prof
+        goodput` prices the event as an annotated restart."""
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.elasticity import resize as rz
+        from deepspeed_tpu.resilience import rewind as rw
+
+        save = str(tmp_path / "ckpt")
+        tel = str(tmp_path / "tel")
+
+        # ---- oracle: replicate the pre-failure phase (same config seed
+        # => same init), reshard the @4 snapshot onto 6 devices, record
+        # the clean continuation losses the drilled run must reproduce
+        eng8 = survivor_engine(rewind={"ram_interval": 2, "keep": 2})
+        seq = batch_seq()
+        for _ in range(4):
+            eng8.train_batch(next(seq))              # ring snapshots @2, @4
+        snap_params = jax.device_get(eng8.state.params)
+        rz.set_fleet_target(6)
+        eng6 = survivor_engine(rewind={"ram_interval": 2, "keep": 2})
+        path, _ = eng6.load_checkpoint(save)         # empty dir: RAM tier
+        assert str(path) == "ram://step4"
+        rec = eng6._last_recovery
+        assert rec["tier"] == "ram"
+        assert rec["resize"] == {"kind": "shrink", "from_world": 8,
+                                 "to_world": 6}
+        assert rec["reshard_s"] is not None
+        # the reshard is bitwise-exact on the state: placement is metadata
+        params_equal(snap_params, eng6.state.params)
+        oracle_seq = batch_seq()
+        oracle_losses = [float(eng6.train_batch(next(oracle_seq)))
+                         for _ in range(6)]
+        rz.clear_fleet_events()
+        rw.clear_ram_snapshots()
+        comm.cdb = None
+
+        # ---- THE drill, under the elastic agent with telemetry on
+        def factory():
+            return survivor_engine(
+                rewind={"ram_interval": 2, "keep": 2},
+                extra={"telemetry": {"enabled": True, "output_dir": tel,
+                                     "prometheus": False, "trace": True,
+                                     "flush_interval": 1}})
+
+        install_chaos(ChaosInjector(shrink_at={"train_step": [6]},
+                                    shrink_to=6))
+        losses = []
+        agent = DSElasticAgent(factory, save, checkpoint_interval=100,
+                               max_restarts=2, install_signal_handlers=False)
+        try:
+            out = agent.run(batch_seq, num_steps=10,
+                            step_callback=lambda s, l: losses.append(
+                                (s, float(l))))
+        finally:
+            telemetry.flush()
+            telemetry.deconfigure()
+        assert out["status"] == "complete"
+        assert out["final_step"] == 10
+        assert out["restarts"] == 1
+        # resumed resharded: the live engine's dp mesh spans 6 survivors
+        assert dict(agent.engine.mesh.shape)["data"] == 6
+        drill = out["restart_log"][0]
+        assert "FleetResizeEvent" in drill["error"]
+        assert drill["tier"] == "ram"
+        assert drill["resize"] == {"kind": "shrink", "from_world": 8,
+                                   "to_world": 6}
+        assert drill["steps_lost"] is not None
+        assert drill["steps_lost"] <= 2              # <= ram_interval
+        assert drill["reshard_s"] is not None
+        # losses bitwise-continue from the restored step: the re-trodden
+        # window (post-restore callbacks) equals the clean 6-dev oracle
+        post = [l for _, l in losses[-6:]]
+        assert post == oracle_losses
+
+        # ---- the whole event is PRICED: ds_prof goodput's fleet-seconds
+        # table annotates the restart with the resize facts
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_prof"),
+             "goodput", tel], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "restart" in proc.stdout
+        assert "shrink 8->6 resharded" in proc.stdout
+        assert "recovered from ram tier" in proc.stdout
+        # ...and ds_metrics' footer renders the live resize line
+        proc2 = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_metrics"), tel],
+            capture_output=True, text=True)
+        assert proc2.returncode == 0, proc2.stderr
+        assert "resize:" in proc2.stdout
+        assert "6 device(s)" in proc2.stdout
+
+    def test_shrink_8_to_4(self, tmp_path):
+        from deepspeed_tpu.elasticity import resize as rz
+
+        save = str(tmp_path / "ckpt")
+        eng8 = survivor_engine(rewind={"ram_interval": 2, "keep": 2})
+        for _ in range(4):
+            eng8.train_batch(batch())
+        want = jax.device_get(eng8.state.params)
+        rz.set_fleet_target(4)
+        eng4 = survivor_engine(rewind={"ram_interval": 2, "keep": 2})
+        path, _ = eng4.load_checkpoint(save)
+        assert str(path) == "ram://step4"
+        assert dict(eng4.mesh.shape)["data"] == 4
+        assert eng4._last_recovery["resize"] == {
+            "kind": "shrink", "from_world": 8, "to_world": 4}
+        params_equal(want, eng4.state.params)
+        assert np.isfinite(float(eng4.train_batch(batch())))
+
+    def test_grow_4_to_8(self, tmp_path):
+        from deepspeed_tpu.elasticity import resize as rz
+
+        rz.set_fleet_target(4)                       # start on a sub-mesh
+
+        def factory():
+            return survivor_engine(rewind={"ram_interval": 1, "keep": 2})
+
+        install_chaos(ChaosInjector(grow_at={"train_step": [3]}, grow_to=8))
+        agent = DSElasticAgent(factory, str(tmp_path / "ckpt"),
+                               checkpoint_interval=100, max_restarts=2,
+                               install_signal_handlers=False)
+        out = agent.run(batch_seq, num_steps=5)
+        assert out["status"] == "complete"
+        assert out["final_step"] == 5
+        assert dict(agent.engine.mesh.shape)["data"] == 8
+        rec = out["restart_log"][0]
+        assert rec["resize"] == {"kind": "grow", "from_world": 4,
+                                 "to_world": 8}
+        assert rec["tier"] == "ram"
+        assert rec["steps_lost"] <= 1
+
+
+# ----------------------------------------------------- disk/emergency tiers
+class TestTierMatrix:
+    def test_disk_only_resize(self, tmp_path):
+        """With no rewind block (no RAM ring, no emergency tags), a world
+        change is served by the tier-2 checkpoint's native orbax
+        reshard-on-load — and still priced."""
+        from deepspeed_tpu.elasticity import resize as rz
+
+        save = str(tmp_path / "ckpt")
+        eng8 = plain_engine(elasticity={"resize": {"enabled": True}})
+        for _ in range(2):
+            eng8.train_batch(batch())
+        eng8.save_checkpoint(save)
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        wait_for_pending_saves()
+        want = jax.device_get(eng8.state.params)
+
+        rz.set_fleet_target(6)
+        eng6 = survivor_engine(rewind=None, resize=True)
+        path, _ = eng6.load_checkpoint(save)
+        assert os.path.basename(path) == "global_step2"
+        rec = eng6._last_recovery
+        assert rec["tier"] == "disk"
+        assert rec["resize"] == {"kind": "shrink", "from_world": 8,
+                                 "to_world": 6}
+        assert rec["reshard_s"] is not None
+        params_equal(want, eng6.state.params)
+        assert np.isfinite(float(eng6.train_batch(batch())))
+
+    def test_emergency_tier_resize_and_world_column(self, tmp_path, capsys):
+        from deepspeed_tpu.elasticity import resize as rz
+        from deepspeed_tpu.resilience import rewind as rw
+
+        save = str(tmp_path / "ckpt")
+        eng8 = plain_engine(rewind={"ram_interval": 1, "keep": 1})
+        for _ in range(3):
+            eng8.train_batch(batch())
+        tag = eng8._rewind.emergency_save(save)
+        assert tag == "emergency_step3"
+        want = jax.device_get(eng8.state.params)
+        rw.clear_ram_snapshots()                     # "new process"
+
+        # ds_report rewind shows the world the tag was saved under
+        from deepspeed_tpu import env_report
+
+        rc = env_report.main(["rewind", save])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "world 8" in out
+
+        rz.set_fleet_target(6)
+        eng6 = survivor_engine(rewind={"ram_interval": 1}, resize=True)
+        path, _ = eng6.load_checkpoint(save)
+        assert path.endswith("emergency_step3")
+        rec = eng6._last_recovery
+        assert rec["tier"] == "emergency"
+        assert rec["resize"] == {"kind": "shrink", "from_world": 8,
+                                 "to_world": 6}
+        assert rec["steps_lost"] == 0                # fresh emergency capture
+        params_equal(want, eng6.state.params)
+        assert np.isfinite(float(eng6.train_batch(batch())))
+
+    def test_min_world_size_refuses_loudly(self, tmp_path):
+        from deepspeed_tpu.elasticity import resize as rz
+        from deepspeed_tpu.resilience import rewind as rw
+
+        save = str(tmp_path / "ckpt")
+        eng8 = plain_engine(rewind={"ram_interval": 1, "keep": 1})
+        eng8.train_batch(batch())
+        eng8._rewind.emergency_save(save)
+        rw.clear_ram_snapshots()
+
+        rz.set_fleet_target(6)
+        eng6 = survivor_engine(
+            rewind={"ram_interval": 1},
+            resize={"enabled": True, "min_world_size": 7})
+        with pytest.raises(rz.ResizeError, match="min_world_size"):
+            eng6.load_checkpoint(save)
+
+    def test_excluded_tier_demotes_to_disk(self, tmp_path):
+        """`tiers: ['disk']` forces every world change through the
+        verified checkpoint: fresher RAM/emergency candidates are walked
+        past (loudly), never crashed on."""
+        from deepspeed_tpu.elasticity import resize as rz
+
+        save = str(tmp_path / "ckpt")
+        eng8 = plain_engine(rewind={"ram_interval": 1, "keep": 1})
+        for _ in range(2):
+            eng8.train_batch(batch())
+        eng8.save_checkpoint(save)                   # ordinary @2
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        wait_for_pending_saves()
+        eng8.train_batch(batch())
+        eng8._rewind.emergency_save(save)            # emergency @3 (fresher)
+
+        rz.set_fleet_target(6)
+        eng6 = survivor_engine(rewind={"ram_interval": 1},
+                               resize={"enabled": True, "tiers": ["disk"]})
+        path, _ = eng6.load_checkpoint(save)
+        assert os.path.basename(path) == "global_step2"   # NOT the ram ring,
+        assert eng6._last_recovery["tier"] == "disk"      # NOT emergency @3
+        assert eng6._last_recovery["resize"]["kind"] == "shrink"
+
+    def test_excluding_the_last_tier_raises(self, tmp_path):
+        from deepspeed_tpu.elasticity import resize as rz
+
+        save = str(tmp_path / "ckpt")
+        eng8 = plain_engine(elasticity={"resize": {"enabled": True}})
+        eng8.train_batch(batch())
+        eng8.save_checkpoint(save)
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        wait_for_pending_saves()
+        rz.set_fleet_target(6)
+        eng6 = survivor_engine(
+            rewind=None,
+            resize={"enabled": True, "tiers": ["ram", "emergency"]})
+        with pytest.raises(rz.ResizeError, match="no deeper tier"):
+            eng6.load_checkpoint(save)
+
+    def test_indivisible_dp_degree_refuses_loudly(self):
+        from deepspeed_tpu.elasticity import resize as rz
+
+        rz.set_fleet_target(5)
+        # 24 does not divide over 5 devices: engine init refuses with the
+        # batch-math error, exactly like a hand-written config would
+        with pytest.raises(ValueError,
+                           match="train_batch_size|divisible|batch"):
+            survivor_engine(rewind=None, resize=True)
+        # ...and a fixed model-parallel axis that does not divide the
+        # survivors is the mesh-level flavor of the same refusal
+        with pytest.raises(rz.ResizeError, match="not divisible"):
+            rz.survivor_mesh({"tensor": 2})
+
+
+# ------------------------------------------------- exactly-once repartition
+class Rows:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((4,), i, dtype=np.int32)
+
+
+def consumed_ids(batches):
+    out = []
+    for b in batches:
+        out.extend(int(r[0]) for r in np.asarray(b))
+    return out
+
+
+class TestDataloaderRepartition:
+    def test_exactly_once_across_batch_geometry_change(self):
+        """A position captured under batch_size 8 resumes under
+        batch_size 6 at the first unconsumed SAMPLE: zero repeated, zero
+        skipped, and the flattened sample order is identical to the
+        original loader's continuation (the epoch order is a pure
+        function of (seed, epoch))."""
+        loader8 = DeepSpeedDataLoader(Rows(48), batch_size=8, seed=7)
+        it8 = iter(loader8)
+        first = [next(it8) for _ in range(3)]        # 24 samples consumed
+        sd = loader8.state_dict()
+        assert sd["sample_idx"] == 24
+        after_orig = consumed_ids(it8)               # the 8-wide continuation
+
+        loader6 = DeepSpeedDataLoader(Rows(48), batch_size=6, seed=7)
+        loader6.load_state_dict(sd, repartition=True)
+        after_replay = consumed_ids(iter(loader6))   # the 6-wide continuation
+        assert after_replay == after_orig            # same samples, same order
+        ids = consumed_ids(first) + after_replay
+        assert len(ids) == len(set(ids)) == 48       # exactly-once
+
+    def test_misaligned_tail_is_never_double_counted(self):
+        """A resume point that does not align to the new batch size still
+        accounts every sample at most once (drop_last may shorten the
+        tail under the NEW geometry — dropped, never repeated)."""
+        loader8 = DeepSpeedDataLoader(Rows(40), batch_size=8, seed=3)
+        it8 = iter(loader8)
+        first = [next(it8) for _ in range(2)]        # 16 samples
+        sd = loader8.state_dict()
+        loader6 = DeepSpeedDataLoader(Rows(40), batch_size=6, seed=3)
+        loader6.load_state_dict(sd, repartition=True)
+        replay = consumed_ids(iter(loader6))
+        ids = consumed_ids(first) + replay
+        assert len(ids) == len(set(ids))             # zero repeats
+        assert len(replay) == 24                     # 40-16=24 → 4 full 6s
+
+    def test_repartition_forgives_only_batch_size(self):
+        loader = DeepSpeedDataLoader(Rows(48), batch_size=8, seed=7)
+        sd = loader.state_dict()
+        other = DeepSpeedDataLoader(Rows(48), batch_size=6, seed=8)
+        with pytest.raises(ValueError, match="seed"):
+            other.load_state_dict(sd, repartition=True)
+        shuffled = DeepSpeedDataLoader(Rows(48), batch_size=6, seed=7,
+                                       shuffle=False)
+        with pytest.raises(ValueError, match="shuffle"):
+            shuffled.load_state_dict(sd, repartition=True)
+
+    def test_engine_meta_apply_repartitions_with_the_knob(self):
+        """apply_restored_meta routes a batch-geometry ValueError into a
+        repartition when elasticity.resize armed the engine — and keeps
+        the loud start-from-the-beginning fallback without it."""
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            apply_restored_meta
+
+        cap = DeepSpeedDataLoader(Rows(48), batch_size=8, seed=7)
+        it = iter(cap)
+        next(it), next(it), next(it)
+        sd = cap.state_dict()
+
+        engine = plain_engine(elasticity={"resize": {"enabled": True}})
+        loader = DeepSpeedDataLoader(Rows(48), batch_size=6, seed=7)
+        engine.dataloader = loader
+        apply_restored_meta(engine, {"data_loader": sd})
+        assert loader._sample_idx == 24              # repartitioned
+
+        engine2 = plain_engine()
+        loader2 = DeepSpeedDataLoader(Rows(48), batch_size=6, seed=7)
+        engine2.dataloader = loader2
+        apply_restored_meta(engine2, {"data_loader": sd})
+        assert loader2._sample_idx == 0              # loud fresh start
+
+
+# ------------------------------------------------------- model-layout guard
+class TestModelLayoutGuard:
+    def test_head_count_change_refuses_naming_both_layouts(self, tmp_path):
+        """gpt2's param shapes are head-count invariant: without the
+        recorded layout a 4→2 head change loads silently under a
+        different attention grouping. The guard names both layouts."""
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            CheckpointLayoutError
+
+        def gpt2_engine(n_head):
+            comm.cdb = None
+            cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32,
+                             n_layer=1, n_head=n_head)
+            engine, *_ = deepspeed_tpu.initialize(
+                model=GPT2Model(cfg),
+                config={"train_batch_size": 8,
+                        "optimizer": {"type": "Adam",
+                                      "params": {"lr": 1e-3}},
+                        "steps_per_print": 0})
+            return engine
+
+        save = str(tmp_path / "ckpt")
+        gpt2_engine(n_head=4).save_checkpoint(save)
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        wait_for_pending_saves()
+        meta = json.load(open(os.path.join(save, "global_step0",
+                                           "client_state.json")))
+        assert meta["model_layout"]["n_head"] == 4   # recorded at save
+
+        with pytest.raises(CheckpointLayoutError) as ei:
+            gpt2_engine(n_head=2).load_checkpoint(save)
+        msg = str(ei.value)
+        assert "n_head was 4 at save but is 2 now" in msg
+
+    def test_same_layout_loads_clean(self, tmp_path):
+        from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2Model,
+                                               synthetic_lm_batch)
+
+        comm.cdb = None
+        cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32,
+                         n_layer=1, n_head=4)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=GPT2Model(cfg),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "steps_per_print": 0})
+        engine.train_batch(synthetic_lm_batch(8, 16, cfg.vocab_size))
+        engine.save_checkpoint(str(tmp_path))
+        path, _ = engine.load_checkpoint(str(tmp_path))
+        assert path is not None
+
+
+# ---------------------------------------------------- perf world identity
+class TestPerfWorldIdentity:
+    def _entry(self, **kw):
+        e = {"metric": "gpt2-x pretrain MFU (bs=2/chip)", "value": 0.5,
+             "unit": "MFU"}
+        e.update(kw)
+        return e
+
+    def test_compare_flags_world_change(self):
+        from deepspeed_tpu.perf import ledger as led
+
+        r = led.compare(self._entry(world_size=8),
+                        self._entry(world_size=6))
+        assert r["world_changed"] and r["fingerprint_changed"]
+        assert r["old_world"] == 8 and r["new_world"] == 6
+        same = led.compare(self._entry(world_size=8),
+                           self._entry(world_size=8))
+        assert not same["world_changed"]
+
+    def test_compare_flags_mid_run_resize(self):
+        from deepspeed_tpu.perf import ledger as led
+
+        r = led.compare(self._entry(),
+                        self._entry(world_resized={"kind": "shrink",
+                                                   "from_world": 8,
+                                                   "to_world": 6}))
+        assert r["world_changed"] and r["fingerprint_changed"]
+
+    def test_gate_tags_world_change_never_silent(self, tmp_path, capsys):
+        from deepspeed_tpu.perf import cli as perf_cli
+
+        base = str(tmp_path / "base.jsonl")
+        cand = str(tmp_path / "cand.jsonl")
+        with open(base, "w") as f:
+            f.write(json.dumps(self._entry(world_size=8, headline=True))
+                    + "\n")
+        with open(cand, "w") as f:
+            f.write(json.dumps(self._entry(world_size=6)) + "\n")
+        rc = perf_cli.main(["gate", "--baseline", base, "--candidate", cand])
+        out = capsys.readouterr().out
+        assert rc == 0                               # same value: no regression
+        assert "[world changed 8 -> 6" in out        # ...but NEVER silent
+
+
+# ----------------------------------------------------------- observability
+class TestObservability:
+    def test_render_resize_line(self):
+        from deepspeed_tpu.goodput.tail import render_resize_line
+
+        assert render_resize_line({}, {}) is None
+        line = render_resize_line(
+            {"elasticity/last_resize_from": 8.0,
+             "elasticity/last_resize_to": 6.0,
+             "elasticity/last_reshard_s": 0.004},
+            {"elasticity/resizes{kind=shrink}": 2.0,
+             "elasticity/resizes{kind=grow}": 1.0})
+        assert "resize:" in line
+        assert "3 event(s)" in line
+        assert "1 grow" in line and "2 shrink" in line
+        assert "last 8->6 device(s)" in line
+        assert "reshard 0.004s" in line
+
+    def test_ds_top_frame_has_resize_line(self):
+        from deepspeed_tpu.goodput.top import render_frame
+
+        records = [
+            {"kind": "counter", "name": "elasticity/resizes",
+             "labels": {"kind": "shrink"}, "value": 1.0},
+            {"kind": "gauge", "name": "elasticity/last_resize_from",
+             "value": 8.0},
+            {"kind": "gauge", "name": "elasticity/last_resize_to",
+             "value": 6.0, "step": 7},
+        ]
+        frame = render_frame(records)
+        assert "resize:" in frame
+        assert "last 8->6 device(s)" in frame
+
+    def test_goodput_report_prices_the_resize(self):
+        from deepspeed_tpu.goodput.report import render_goodput_report
+
+        report = {
+            "ranks": [0], "sessions": 2, "per_rank": {},
+            "buckets_s": {"compute": 10.0, "restart": 2.0},
+            "fleet_seconds": 12.0, "goodput_fraction": 10.0 / 12.0,
+            "restarts": [{"rank": 0, "gap_s": 2.0, "after": "a",
+                          "before": "b",
+                          "reasons": ["FleetResizeEvent: fleet shrink"],
+                          "recoveries": [{"tier": "ram", "snapshot_step": 4,
+                                          "steps_lost": 1,
+                                          "restore_s": 0.01,
+                                          "reshard_s": 0.01,
+                                          "resize": {"kind": "shrink",
+                                                     "from_world": 8,
+                                                     "to_world": 6}}]}],
+            "warnings": [],
+        }
+        text = render_goodput_report(report)
+        assert "recovered from ram tier @step 4, 1 step(s) lost" in text
+        assert "shrink 8->6 resharded in 0.01s" in text
+
+    def test_ds_resize_plan_cli(self, tmp_path):
+        save = str(tmp_path / "ckpt")
+        engine = plain_engine()
+        engine.train_batch(batch())
+        engine.save_checkpoint(save)
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+            wait_for_pending_saves
+
+        wait_for_pending_saves()
+        ds_resize = os.path.join(REPO, "bin", "ds_resize")
+        proc = subprocess.run(
+            [sys.executable, ds_resize, "plan", save, "--to", "4",
+             "--train-batch-size", str(TBS), "--json"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        plan = json.loads(proc.stdout)
+        assert plan["picked"]["tag"] == "global_step1"
+        assert plan["picked"]["from_world"] == 8
+        assert plan["picked"]["kind"] == "shrink"
+        assert plan["batch_feasible"] is True
+        # an indivisible target is a loud refusal, exit 2
+        proc2 = subprocess.run(
+            [sys.executable, ds_resize, "plan", save, "--to", "5",
+             "--train-batch-size", str(TBS)],
+            capture_output=True, text=True)
+        assert proc2.returncode == 2
+        assert "REFUSED" in proc2.stdout
+
+    def test_ds_resize_history_cli(self, tmp_path):
+        log = tmp_path / "restart_log.jsonl"
+        log.write_text(json.dumps({
+            "restart": 1, "error": "FleetResizeEvent: fleet shrink",
+            "tier": "ram", "steps_lost": 1, "reshard_s": 0.004,
+            "resize": {"kind": "shrink", "from_world": 8,
+                       "to_world": 6}}) + "\n"
+            + json.dumps({"restart": 2, "error": "ChaosError: boom"}) + "\n")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_resize"),
+             "history", str(tmp_path)], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "shrink  8 -> 6 device(s)" in proc.stdout
+        assert "served by ram tier" in proc.stdout
+        assert "ChaosError" not in proc.stdout       # non-resize records skipped
+
+    def test_schema_pass_knows_the_knobs(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        base = {"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+        # did-you-mean on a typo'd resize key
+        findings, _ = walk_config({
+            **base, "elasticity": {"resize": {"min_world_sizee": 4}}})
+        assert any("min_world_size" in f.message for f in findings)
+        # resize without the rewind block: only the disk tier can serve
+        findings, _ = walk_config({
+            **base, "elasticity": {"resize": {"enabled": True}}})
+        assert any("elasticity.resize vs rewind" in f.citation
+                   for f in findings)
+        # min_world_size above the BOUND world (engine passes world_size)
+        findings, _ = walk_config(
+            {**base, "rewind": {},
+             "elasticity": {"resize": {"enabled": True,
+                                       "min_world_size": 64}}},
+            world_size=8)
+        assert any("min_world_size" in f.citation for f in findings)
+        # ...but an offline lint (no bound world) must NOT judge the floor
+        # against whatever machine the operator happens to run it on
+        findings, _ = walk_config(
+            {**base, "rewind": {},
+             "elasticity": {"resize": {"enabled": True,
+                                       "min_world_size": 64}}})
+        assert not any("min_world_size" in f.citation for f in findings)
+        # the emergency tier allowed but never written
+        findings, _ = walk_config(
+            {**base, "rewind": {"emergency_save": False},
+             "elasticity": {"resize": {"enabled": True}}})
+        assert any("rewind.emergency_save" in f.citation for f in findings)
+
+
+# -------------------------------------------------- eigenvalue timer window
+def test_eigenvalue_runs_outside_the_step_timing_window(tmp_path):
+    """The gas-boundary power-iteration estimate must not inflate
+    TRAIN_BATCH_TIMER/tput step times: it runs AFTER both timers stop and
+    outside the train_batch span, as its own 'eigenvalue' span."""
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.runtime.engine import TRAIN_BATCH_TIMER
+
+    engine = plain_engine(extra={"wall_clock_breakdown": True,
+                                 "telemetry": {
+                                     "enabled": True, "jsonl": False,
+                                     "prometheus": False, "trace": True,
+                                     "output_dir": str(tmp_path)}})
+    try:
+        timer_states = []
+
+        def spy(b):
+            timer_states.append(
+                (engine.timers(TRAIN_BATCH_TIMER).started_,
+                 engine.tput_timer.started))
+
+        engine._maybe_update_eigenvalue = spy
+        engine.eigenvalue = object()                 # arm the hook only
+        engine.train_batch(batch())
+        assert timer_states == [(False, False)]      # both timers stopped
+        trace = telemetry.get_tracer().to_chrome_trace()
+        spans = {e["name"]: e for e in trace["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert "eigenvalue" in spans and "train_batch" in spans
+        tb = spans["train_batch"]
+        # the eigenvalue span begins only after the train_batch span ends
+        assert spans["eigenvalue"]["ts"] >= tb["ts"] + tb["dur"]
+    finally:
+        telemetry.deconfigure()
+
+
+# ------------------------------------------------------- randomized sweep
+def test_randomized_resize_sweep(tmp_path):
+    """Slow sweep (tests/slow_tests.txt): seeded random shrink/grow
+    drills — across seeds, every run completes resharded on the
+    post-event world with <= ram_interval steps lost and a fully priced
+    restart record."""
+    from deepspeed_tpu.elasticity import resize as rz
+    from deepspeed_tpu.resilience import rewind as rw
+
+    for seed in range(4):
+        rng = np.random.RandomState(seed)
+        uninstall_chaos()
+        rw.clear_ram_snapshots()
+        rz.clear_fleet_events()
+        grow = bool(rng.randint(0, 2))
+        start, target = (4, 8) if grow else (8, int(rng.choice([4, 6])))
+        fault_step = int(rng.randint(3, 6))
+        rz.set_fleet_target(start)
+
+        def factory():
+            return survivor_engine(rewind={"ram_interval": 2, "keep": 2})
+
+        install_chaos(ChaosInjector(
+            grow_at={"train_step": [fault_step]} if grow else None,
+            grow_to=target if grow else 0,
+            shrink_at=None if grow else {"train_step": [fault_step]},
+            shrink_to=0 if grow else target))
+        agent = DSElasticAgent(factory, str(tmp_path / f"sweep{seed}"),
+                               checkpoint_interval=100, max_restarts=2,
+                               install_signal_handlers=False)
+        out = agent.run(batch_seq, num_steps=8)
+        assert out["status"] == "complete", (seed, out)
+        assert out["final_step"] == 8
+        assert dict(agent.engine.mesh.shape)["data"] == target, seed
+        rec = out["restart_log"][0]
+        assert rec["resize"] == {"kind": "grow" if grow else "shrink",
+                                 "from_world": start,
+                                 "to_world": target}, (seed, rec)
+        assert rec["steps_lost"] is not None and rec["steps_lost"] <= 2, \
+            (seed, rec)

@@ -740,10 +740,17 @@ class TestDataloaderEdges:
         assert len(list(iter(fresh))) == 2           # a full next epoch
         # the PRE-advance shape (captured between the last yield and the
         # generator's final resume) normalizes to the same position
-        stale = {**sd, "epoch": 0, "batch_idx": 2}
+        stale = {**sd, "epoch": 0, "batch_idx": 2, "sample_idx": 16}
         fresh2 = DeepSpeedDataLoader(Rows(16), batch_size=8, seed=3)
         fresh2.load_state_dict(stale)
         assert fresh2.epoch == 1 and fresh2._batch_idx == 0
+        # a LEGACY state (pre-resize schema, no sample_idx) falls back to
+        # batch units and normalizes the same way
+        legacy = {k: v for k, v in sd.items() if k != "sample_idx"}
+        legacy.update(epoch=0, batch_idx=2)
+        fresh3 = DeepSpeedDataLoader(Rows(16), batch_size=8, seed=3)
+        fresh3.load_state_dict(legacy)
+        assert fresh3.epoch == 1 and fresh3._batch_idx == 0
 
     def test_repeating_loader_epochs_reshuffle_and_replay_exactly(self):
         """Cross-epoch exactly-once: consecutive RepeatingLoader passes

@@ -53,10 +53,15 @@ def engine_init_analysis(engine, param_shapes) -> AnalysisReport:
                                   world_size=engine.dp_world_size)
         report.extend(findings, "schema")
     if _wants(acfg, "sharding"):
+        from deepspeed_tpu.analysis.jit_lint import lint_unspecified_jit
+
         report.extend(
             lint_sharding_plan(engine.plan, param_shapes,
                                min_elements=acfg.min_replicated_elements),
             "sharding")
+        # the unspecified-jit lint: no engine program may enter jax.jit
+        # outside sharded_jit (AST over the package, memoized per process)
+        report.extend(lint_unspecified_jit(), "sharding")
     return _finish(report, acfg.fail_on,
                    log=lambda m: log_dist(m, ranks=[0]))
 
@@ -239,11 +244,23 @@ def run_doctor(config: Any,
                 + (f" ({first})" if first and "schema" not in passes else ""))
 
     if "sharding" in passes:
+        from deepspeed_tpu.analysis.jit_lint import (lint_program_table,
+                                                     lint_unspecified_jit)
+
+        # the unspecified-jit lint needs no model: AST over the package +
+        # the runtime program table (whatever compiled this process)
+        report.extend(lint_unspecified_jit(), "sharding")
+        report.extend(lint_program_table(), "sharding")
         if cfg is not None and model is not None:
             report.extend(_sharding_for_family(cfg, model), "sharding")
+        elif model is not None and cfg is None:
+            skipped("sharding", _schema_why())
         else:
-            skipped("sharding", _schema_why() if cfg is None else
-                    "needs --model (a family fixture to plan sharding for)")
+            # the jit lints ran above; the family sharding-PLAN sub-pass
+            # (replicated-leaf lint against the mesh) still needs a fixture
+            skipped("sharding",
+                    "the sharding-plan lint needs --model (a family fixture "
+                    "to plan sharding for); the unspecified-jit lint ran")
 
     if "graph" in passes:
         if cfg is not None and (model or graph):

@@ -235,8 +235,10 @@ def init_distributed(dist_backend: str = "xccl",
         # backend (engine construction passes mesh_config; driver scripts
         # must not need to reach into module internals)
         if mesh_config is not None:
-            candidate = build_mesh(mesh_config=mesh_config)
-            if dict(candidate.shape) != dict(cdb.mesh.shape):
+            from deepspeed_tpu.sharding.mesh import ensure_global_mesh
+
+            candidate = ensure_global_mesh(mesh_config=mesh_config)
+            if candidate is not cdb.mesh:
                 cdb = XCCLBackend(candidate)
         return cdb
 
@@ -283,7 +285,15 @@ def init_distributed(dist_backend: str = "xccl",
             logger.warning(f"jax.distributed.initialize skipped: {e}")
 
     if mesh is None:
-        mesh = build_mesh(mesh_config=mesh_config)
+        # THE mesh: built once per topology and cached process-globally, so
+        # every engine's programs compile against one device order
+        from deepspeed_tpu.sharding.mesh import ensure_global_mesh
+
+        mesh = ensure_global_mesh(mesh_config=mesh_config)
+    else:
+        from deepspeed_tpu.sharding.mesh import adopt_global_mesh
+
+        adopt_global_mesh(mesh)
     cdb = XCCLBackend(mesh)
     if verbose:
         log_dist(f"xccl backend ready: mesh={dict(mesh.shape)} on {get_accelerator().device_kind()}", ranks=[0])
@@ -321,6 +331,9 @@ def get_mesh() -> Mesh:
 
 def set_mesh(mesh: Mesh) -> None:
     global cdb
+    from deepspeed_tpu.sharding.mesh import adopt_global_mesh
+
+    adopt_global_mesh(mesh)
     cdb = XCCLBackend(mesh)
 
 
@@ -554,7 +567,8 @@ def _in_trace(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
-def _eager_shard_map(fn, group, x, extra_leading_out: bool = False):
+def _eager_shard_map(fn, group, x, extra_leading_out: bool = False,
+                     name: str = "collective"):
     """Run a one-collective shard_map over the mesh for eager API usage.
 
     Convention (documented in the module docstring): the input's leading dim
@@ -567,9 +581,18 @@ def _eager_shard_map(fn, group, x, extra_leading_out: bool = False):
     spec = P(axes)
     in_spec = P(axes, *([None] * (x.ndim - 1)))
     out_first = axes if extra_leading_out else None
+    out_spec = P(out_first, *([None] * (x.ndim - 1)))
     shard_fn = _shard_map(fn, mesh=mesh, in_specs=in_spec,
-                          out_specs=P(out_first, *([None] * (x.ndim - 1))))
-    return jax.jit(shard_fn)(x)
+                          out_specs=out_spec)
+    from deepspeed_tpu.sharding import sharded_jit
+
+    # label by the COLLECTIVE name, not the closure's (__name__ is '_k' for
+    # every wrapper — one shared label would overwrite the program table)
+    return sharded_jit(
+        shard_fn, label=f"comm/eager_{name}",
+        in_shardings=(NamedSharding(mesh, in_spec),),
+        out_shardings=NamedSharding(mesh, out_spec),
+        donate_argnums=(), mesh=mesh)(x)
 
 
 _REDUCERS_TRACED = {
@@ -611,7 +634,7 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, async_op: bool = Fals
             r = _REDUCERS_TRACED[op](x, axes)
         return r[None]
 
-    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True, name="all_reduce")
 
 
 @timed_op
@@ -629,7 +652,7 @@ def all_gather(tensor, group=None, axis: int = 0, tiled: bool = False, log_name=
         return lax.all_gather(tensor, axes, axis=axis, tiled=tiled)
     def _k(x):
         return lax.all_gather(jnp.squeeze(x, 0), axes, axis=0, tiled=False)[None]
-    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True, name="all_gather")
 
 
 def all_gather_into_tensor(output_unused, tensor, group=None):
@@ -647,7 +670,7 @@ def reduce_scatter(tensor, group=None, op=ReduceOp.SUM, scatter_dimension: int =
         return lax.psum_scatter(tensor, axes, scatter_dimension=scatter_dimension, tiled=tiled)
     def _k(x):
         return lax.psum_scatter(jnp.squeeze(x, 0), axes, scatter_dimension=0, tiled=True)[None]
-    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True, name="reduce_scatter")
 
 
 def reduce_scatter_tensor(output_unused, tensor, op=ReduceOp.SUM, group=None):
@@ -664,7 +687,7 @@ def all_to_all_single(tensor, group=None, split_axis: int = 0, concat_axis: int 
     def _k(x):
         return lax.all_to_all(jnp.squeeze(x, 0), axes, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)[None]
-    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True, name="all_to_all")
 
 
 all_to_all = all_to_all_single
@@ -683,7 +706,7 @@ def broadcast(tensor, src: int = 0, group=None, async_op: bool = False, log_name
         idx = lax.axis_index(axes if len(axes) > 1 else axes[0])
         contrib = jnp.where(idx == src, x, jnp.zeros_like(x))
         return lax.psum(contrib, axes)[None]
-    return _eager_shard_map(_k, group, tensor, extra_leading_out=True)
+    return _eager_shard_map(_k, group, tensor, extra_leading_out=True, name="broadcast")
 
 
 def ppermute(tensor, perm, group=None):

@@ -249,8 +249,76 @@ def goodput_section(args):
     return 0
 
 
+def mesh_section(args):
+    """``ds_report mesh [--config ds_config.json] [--model family]`` — the
+    unified mesh (axis names × sizes), the registry's per-pytree specs for
+    a family fixture, and the per-program in/out spec table of every
+    program compiled in this process (sharded_jit's table). Replaces the
+    per-subsystem guesswork: ONE view of what runs where."""
+    import json
+
+    from deepspeed_tpu.sharding import (ensure_global_mesh, global_mesh,
+                                        mesh_axes_string,
+                                        render_program_table)
+
+    config_path = model = None
+    it = iter(args)
+    for a in it:
+        if a == "--config":
+            config_path = next(it, None)
+        elif a == "--model":
+            model = next(it, None)
+        elif a in ("-h", "--help"):
+            print("usage: ds_report mesh [--config ds_config.json] "
+                  "[--model gpt2|llama|moe|bert]")
+            return 0
+    mesh = global_mesh()
+    if config_path is not None:
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        with open(config_path) as f:
+            cfg = DeepSpeedConfig(json.load(f))
+        mesh = ensure_global_mesh(mesh_config=cfg.mesh_config)
+    elif mesh is None:
+        mesh = ensure_global_mesh()
+    line = "-" * 72
+    print(line)
+    print(f"unified mesh: {mesh_axes_string(mesh)}")
+    for a in mesh.axis_names:
+        print(f"  {a:<8} {int(mesh.shape[a])}")
+    if model is not None:
+        import jax
+
+        from deepspeed_tpu.models.registry import resolve_family
+        from deepspeed_tpu.runtime.zero.partition import plan_sharding
+
+        try:
+            model_cls, _, presets = resolve_family(model)
+            preset = sorted(presets)[0]
+            m = model_cls(presets[preset])
+            shapes = jax.eval_shape(m.init_params, jax.random.PRNGKey(0))
+            tp_specs = m.param_partition_specs() if hasattr(
+                m, "param_partition_specs") else None
+            zc = cfg.zero_config if config_path else None
+            plan = plan_sharding(shapes, mesh, zero_config=zc,
+                                 tp_specs=tp_specs)
+            print(line)
+            print(f"registry specs ({model} fixture, preset {preset}):")
+            print(plan.registry.describe())
+        except Exception as e:
+            print(f"(registry preview unavailable for {model!r}: {e})",
+                  file=sys.stderr)
+    print(line)
+    print("compiled programs (this process):")
+    print(render_program_table(mesh))
+    return 0
+
+
 def main(args=None):
     args = list(sys.argv[1:] if args is None else args)
+    if args and args[0] == "mesh":
+        # `ds_report mesh` — the unified mesh + per-program spec table
+        return mesh_section(args[1:])
     if args and args[0] == "doctor":
         # `ds_report doctor --config X` — run the ds_doctor config/schema
         # pass against a ds_config and print its findings

@@ -56,17 +56,20 @@ def _sample(logits, rng, temperature: float, top_k: int, top_p: float, greedy: b
 
 def build_generate_fn(module, max_new_tokens: int, do_sample: bool,
                       temperature: float, top_k: int, top_p: float,
-                      eos_token_id: Optional[int], param_transform=None):
+                      eos_token_id: Optional[int], param_transform=None,
+                      cache_shardings=None):
     """The jittable prefill + scan-decode generation program, shared by
     InferenceEngine.generate and DeepSpeedHybridEngine.generate.
     ``param_transform`` preprocesses the param tree inside the trace (e.g.
     the training engine's host-offload stream-in). Composed from
     ``build_generate_parts`` (ONE source of the generation logic, so the
     fused fast path and the observed split path cannot diverge), with the
-    transform hoisted so it runs once in the single program."""
+    transform hoisted so it runs once in the single program.
+    ``cache_shardings`` pins the in-program KV cache to the registry's
+    placement (defaults to the module's own cache specs)."""
     prefill, decode = build_generate_parts(
         module, max_new_tokens, do_sample, temperature, top_k, top_p,
-        eos_token_id, param_transform=None)
+        eos_token_id, param_transform=None, cache_shardings=cache_shardings)
 
     def gen(params, ids, rng):
         if param_transform is not None:
@@ -75,6 +78,18 @@ def build_generate_fn(module, max_new_tokens: int, do_sample: bool,
         return decode(params, ids, logits, cache, rng)
 
     return gen
+
+
+def _resolve_cache_shardings(module, cache_shardings):
+    """THE KV-cache placement resolution, shared by the fused generate,
+    the split prefill/decode pair and the serving tick programs: an
+    explicit registry-derived ``cache_shardings`` wins, else the module's
+    own cache specs. One function so the consumers cannot diverge."""
+    if cache_shardings is not None:
+        return cache_shardings
+    if hasattr(module, "cache_partition_specs"):
+        return module.cache_partition_specs()
+    return None
 
 
 def _decode_scan_step(module, params, do_sample: bool, temperature: float,
@@ -100,7 +115,8 @@ def _decode_scan_step(module, params, do_sample: bool, temperature: float,
 
 def build_generate_parts(module, max_new_tokens: int, do_sample: bool,
                          temperature: float, top_k: int, top_p: float,
-                         eos_token_id: Optional[int], param_transform=None):
+                         eos_token_id: Optional[int], param_transform=None,
+                         cache_shardings=None):
     """Generation split at the prefill/decode boundary so the host can
     observe TTFT (time to first token) and the decode tail separately —
     the two numbers that define serving latency. Used directly when
@@ -115,9 +131,9 @@ def build_generate_parts(module, max_new_tokens: int, do_sample: bool,
             params = param_transform(params)
         B, T = ids.shape
         cache = module.init_cache(B, T + max_new_tokens)
-        if hasattr(module, "cache_partition_specs"):
-            cache = jax.lax.with_sharding_constraint(
-                cache, module.cache_partition_specs())
+        cc = _resolve_cache_shardings(module, cache_shardings)
+        if cc is not None:
+            cache = jax.lax.with_sharding_constraint(cache, cc)
         logits, cache = module.prefill(params, ids, cache)
         return logits, cache
 
@@ -138,7 +154,7 @@ def build_generate_parts(module, max_new_tokens: int, do_sample: bool,
 def build_serving_programs(module, max_total_len: int, chunk_tokens: int,
                            do_sample: bool, temperature: float, top_k: int,
                            top_p: float, eos_token_id: Optional[int],
-                           param_transform=None):
+                           param_transform=None, cache_shardings=None):
     """``(prefill, decode_chunk)`` for the serving front-end's tick loop
     (serving/frontend.py): the cache is sized once at ``max_total_len`` and
     decode advances ``chunk_tokens`` per call, returning the full carry so
@@ -154,9 +170,9 @@ def build_serving_programs(module, max_total_len: int, chunk_tokens: int,
             params = param_transform(params)
         B, _ = ids.shape
         cache = module.init_cache(B, max_total_len)
-        if hasattr(module, "cache_partition_specs"):
-            cache = jax.lax.with_sharding_constraint(
-                cache, module.cache_partition_specs())
+        cc = _resolve_cache_shardings(module, cache_shardings)
+        if cc is not None:
+            cache = jax.lax.with_sharding_constraint(cache, cc)
         logits, cache = module.prefill(params, ids, cache)
         done = jnp.zeros((B,), jnp.bool_)
         return logits, cache, done
@@ -216,8 +232,11 @@ class InferenceEngine:
                 if n % (tp * ep):
                     raise ValueError(f"tp_size {tp} x moe.ep_size {ep} does "
                                      f"not divide device count {n}")
-                mesh = build_mesh(axis_dims={"pipe": 1, "data": n // (tp * ep),
-                                             "expert": ep, "seq": 1, "tensor": tp})
+                from deepspeed_tpu.sharding import ensure_global_mesh
+
+                mesh = ensure_global_mesh(
+                    axis_dims={"pipe": 1, "data": n // (tp * ep),
+                               "expert": ep, "seq": 1, "tensor": tp})
                 dist.init_distributed(mesh=mesh, verbose=False)
         self.mesh = mesh
         self.mp_world_size = mesh.shape.get("tensor", 1)
@@ -238,12 +257,22 @@ class InferenceEngine:
                                        base_specs=specs)
 
         to_dtype = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
-        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                                 is_leaf=lambda x: isinstance(x, P))
+        from deepspeed_tpu.sharding import (INHERIT, ShardingRegistry,
+                                            sharded_jit)
+
+        # the spec registry — the ONE source the serving front-end, the
+        # split prefill/decode pair and the fused generate read placements
+        # from (params here; the KV cache lazily via cache_shardings)
+        self.sharding = ShardingRegistry(mesh)
+        self.sharding.register("params", specs)
+        shardings = self.sharding.shardings("params")
         with mesh:
             if params is not None:
-                self.params = jax.jit(
-                    lambda p: jax.tree.map(to_dtype, p), out_shardings=shardings)(params)
+                self.params = sharded_jit(
+                    lambda p: jax.tree.map(to_dtype, p),
+                    label="inference/cast_params", donate_argnums=(),
+                    mesh=mesh, in_shardings=INHERIT,
+                    out_shardings=shardings)(params)
             elif self._config.checkpoint:
                 # serve a TRAINING checkpoint at any tp: orbax restores the
                 # params subtree straight into the serving shardings (the
@@ -263,8 +292,10 @@ class InferenceEngine:
                     self._config.checkpoint, abstract,
                     tag=self._config.checkpoint_config.get("tag"))
             else:
-                self.params = jax.jit(
+                self.params = sharded_jit(
                     lambda: jax.tree.map(to_dtype, model.init_params(jax.random.PRNGKey(0))),
+                    label="inference/init_params", donate_argnums=(),
+                    mesh=mesh, in_shardings=(),
                     out_shardings=shardings)()
         self._param_specs = specs
         self._dequant = None
@@ -304,6 +335,15 @@ class InferenceEngine:
         log_dist(f"InferenceEngine ready: dtype={jnp.dtype(self.dtype).name}, "
                  f"tp={self.mp_world_size}{ep_tag}", ranks=[0])
 
+    def _params_in_shardings(self):
+        """Registry param shardings, or explicit INHERIT for the quantized
+        tree (its structure no longer matches the spec tree)."""
+        from deepspeed_tpu.sharding import INHERIT
+
+        if self._dequant is not None:
+            return INHERIT
+        return self.sharding.shardings("params")
+
     # ----------------------------------------------------------------- forward
     def forward(self, input_ids, *args, **kwargs):
         """HF-style forward. Extra positional arrays pass through to the
@@ -312,9 +352,21 @@ class InferenceEngine:
         model_implementations/diffusers/unet.py wrapper role)."""
         key = ("fwd", len(args))
         if key not in self._compiled:
+            from deepspeed_tpu.sharding import INHERIT, sharded_jit
+
             dq = self._dequant or (lambda p: p)
-            self._compiled[key] = jax.jit(
-                lambda p, *xs: self.module.apply(dq(p), *xs))
+            # inputs are arbitrary client arrays (diffusion latents, ids of
+            # any batch size) — explicitly INHERIT their placement; params
+            # are pinned to the registry's specs (unless weight-quantized:
+            # the quantized tree's structure differs from the spec tree, so
+            # its committed placement is inherited instead)
+            self._compiled[key] = sharded_jit(
+                lambda p, *xs: self.module.apply(dq(p), *xs),
+                label=f"inference/forward[args={len(args)}]",
+                donate_argnums=(), mesh=self.mesh,
+                in_shardings=(self._params_in_shardings(),)
+                + (INHERIT,) * (len(args) + 1),
+                out_shardings=INHERIT)
 
         def to_dev(a):
             # jax arrays (the natural denoising-loop state) pass through
@@ -360,13 +412,28 @@ class InferenceEngine:
             # fast path: ONE compiled program (prefill + scan decode), no
             # host round-trip between first token and decode
             # B and T are NOT in the key: jit re-specializes per input shape,
-            # and gen derives them from ids inside the trace.
-            key = ("gen", max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
+            # and gen derives them from ids inside the trace. The ids spec IS
+            # keyed: a dp-divisible and a non-divisible batch compile with
+            # different (explicit) in/out placements.
+            from deepspeed_tpu.sharding import sharded_jit
+
+            ids_sh = self.sharding.ids_sharding(batch_size=B)
+            key = ("gen", max_new_tokens, do_sample, temperature, top_k,
+                   top_p, eos_token_id, ids_sh.spec)
             if key not in self._compiled:
-                self._compiled[key] = jax.jit(build_generate_fn(
-                    self.module, max_new_tokens, do_sample, temperature, top_k,
-                    top_p, eos_token_id, param_transform=self._dequant))
+                repl = self.sharding.replicated()
+                self._compiled[key] = sharded_jit(
+                    build_generate_fn(
+                        self.module, max_new_tokens, do_sample, temperature,
+                        top_k, top_p, eos_token_id,
+                        param_transform=self._dequant,
+                        cache_shardings=self.sharding.cache_shardings(self.module)),
+                    label=f"inference/generate[new={max_new_tokens}]",
+                    donate_argnums=(), mesh=self.mesh,
+                    in_shardings=(self._params_in_shardings(), ids_sh, repl),
+                    out_shardings=ids_sh)
             with self.mesh:
+                ids = jax.device_put(ids, ids_sh)
                 return self._compiled[key](self.params, ids, rng)
         return self._generate_observed(ids, rng, max_new_tokens, do_sample,
                                        temperature, top_k, top_p, eos_token_id)
@@ -377,13 +444,36 @@ class InferenceEngine:
         the boundary: TTFT and per-token decode latency become observable.
         The extra sync costs one dispatch gap per request — the price of
         measuring, only paid when telemetry or profile_model_time asks."""
-        key = ("gen2", max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
+        from deepspeed_tpu.sharding import INHERIT, sharded_jit
+
+        ids_sh = self.sharding.ids_sharding(batch_size=int(ids.shape[0]))
+        key = ("gen2", max_new_tokens, do_sample, temperature, top_k, top_p,
+               eos_token_id, ids_sh.spec)
         if key not in self._compiled:
+            cache_sh = self.sharding.cache_shardings(self.module)
             pf, df = build_generate_parts(
                 self.module, max_new_tokens, do_sample, temperature, top_k,
-                top_p, eos_token_id, param_transform=self._dequant)
-            self._compiled[key] = (jax.jit(pf), jax.jit(df))
+                top_p, eos_token_id, param_transform=self._dequant,
+                cache_shardings=cache_sh)
+            params_in = self._params_in_shardings()
+            repl = self.sharding.replicated()
+            self._compiled[key] = (
+                sharded_jit(pf, label=f"inference/prefill[new={max_new_tokens}]",
+                            donate_argnums=(), mesh=self.mesh,
+                            in_shardings=(params_in, ids_sh),
+                            out_shardings=(INHERIT,
+                                           cache_sh if cache_sh is not None
+                                           else INHERIT)),
+                sharded_jit(df, label=f"inference/decode[new={max_new_tokens}]",
+                            # the cache is dead after the decode consumes it —
+                            # donating it avoids a second live KV buffer
+                            donate_argnums=(3,), mesh=self.mesh,
+                            in_shardings=(params_in, ids_sh, INHERIT,
+                                          cache_sh if cache_sh is not None
+                                          else INHERIT, repl),
+                            out_shardings=ids_sh))
         pf, df = self._compiled[key]
+        ids = jax.device_put(ids, ids_sh)
         tracer = _telemetry.get_tracer()
         t0 = time.perf_counter()
         with self.mesh:

@@ -18,8 +18,15 @@ first-class here:
   Causal masking works on global positions; blocks entirely in the future
   contribute nothing.
 
-Both are plain traced code inside shard_map manual over 'seq' — AD transposes
-the ppermute/all_to_all into the reverse-direction gradient comms.
+Both are plain traced code inside a FULLY-MANUAL shard_map — AD transposes
+the ppermute/all_to_all into the reverse-direction gradient comms. Fully
+manual (every mesh axis, with in_specs naming the batch/seq/head layout the
+surrounding GSPMD program already uses) rather than manual-over-'seq'-only:
+attention is embarrassingly parallel over batch AND heads, so no cross-dp or
+cross-tp collective is needed inside — and the partial-manual mode the old
+wrapper asked for hard-aborts the SPMD partitioner on the jax 0.4.x this
+repo pins (``Check failed: target.IsManualSubgroup()``, rc=134 — one of the
+failure classes behind the red MULTICHIP gate).
 """
 
 from __future__ import annotations
@@ -33,10 +40,27 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import SEQ_AXIS
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS,
+                                             MICS_AXIS, SEQ_AXIS, TENSOR_AXIS)
 from deepspeed_tpu.utils import shard_map_compat
 
 NEG_INF = -1e30
+
+
+def _qkv_spec(mesh, seq_axis: str, n_heads: int,
+              head_groups: int = 1) -> P:
+    """The (B, T, H, D) layout of the fully-manual attention shard_map:
+    batch over the dp axes, tokens over ``seq_axis``, heads over 'tensor'
+    when the local head count stays divisible (by ``head_groups`` extra
+    ways for Ulysses' in-manual head scatter), head_dim whole. Mirrors the
+    placement the surrounding GSPMD program already uses, so the manual
+    boundary reshards nothing."""
+    batch_axes = tuple(a for a in (DATA_AXIS, MICS_AXIS, EXPERT_AXIS)
+                       if mesh.shape.get(a, 1) > 1)
+    tp = mesh.shape.get(TENSOR_AXIS, 1)
+    heads = TENSOR_AXIS if (tp > 1 and n_heads % (tp * head_groups) == 0) \
+        else None
+    return P(batch_axes if batch_axes else None, seq_axis, heads, None)
 
 
 # ------------------------------------------------------------------- ulysses
@@ -60,10 +84,10 @@ def ulysses_attention(attn_fn: Callable, q, k, v, mesh, seq_axis: str = SEQ_AXIS
         o = attn_fn(scatter_heads(q), scatter_heads(k), scatter_heads(v))
         return gather_heads(o)
 
+    spec = _qkv_spec(mesh, seq_axis, q.shape[2], head_groups=S)
     sm = shard_map_compat(inner, mesh=mesh,
-                          in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
-                          out_specs=P(None, seq_axis),
-                          axis_names={seq_axis}, check_vma=False)
+                          in_specs=(spec, spec, spec), out_specs=spec,
+                          check_vma=False)
     return sm(q, k, v)
 
 
@@ -150,8 +174,8 @@ def ring_attention(q, k, v, mesh, causal: bool = True, scale: Optional[float] = 
         l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
         return (acc / l_safe.transpose(0, 2, 1)[..., None].astype(acc.dtype))
 
+    spec = _qkv_spec(mesh, seq_axis, q.shape[2])
     sm = shard_map_compat(inner, mesh=mesh,
-                          in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
-                          out_specs=P(None, seq_axis),
-                          axis_names={seq_axis}, check_vma=False)
+                          in_specs=(spec, spec, spec), out_specs=spec,
+                          check_vma=False)
     return sm(q, k, v)

@@ -93,6 +93,10 @@ def _world_tag(r):
     if wo is not None and wn is not None and wo != wn:
         return (f"  [world changed {wo} -> {wn} device(s): "
                 "not two views of one experiment]")
+    mo, mn = r.get("old_mesh_axes"), r.get("new_mesh_axes")
+    if mo is not None and mn is not None and mo != mn:
+        return (f"  [mesh changed {mo} -> {mn}: same device count, "
+                "different layout — not two views of one experiment]")
     return "  [world resized mid-run: not two views of one experiment]"
 
 

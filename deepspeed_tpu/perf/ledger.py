@@ -301,8 +301,13 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
             return None
 
     wo, wn = _world(old), _world(new)
+    # same device count laid out differently (dp=8 vs dp=4×tp=2) is a
+    # different experiment too: the mesh_axes string the recorder stamps
+    # participates in the world identity
+    mo, mn = old.get("mesh_axes"), new.get("mesh_axes")
     world_changed = bool(
         (wo is not None and wn is not None and wo != wn)
+        or (mo is not None and mn is not None and mo != mn)
         or old.get("world_resized") or new.get("world_resized"))
     out = {
         "series": series_key(new),
@@ -312,6 +317,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         "old_fingerprint": old.get("fingerprint"),
         "new_fingerprint": new.get("fingerprint"),
         "old_world": wo, "new_world": wn,
+        "old_mesh_axes": mo, "new_mesh_axes": mn,
         "world_changed": world_changed,
         "fingerprint_changed": world_changed or (
             bool(old.get("fingerprint")) and bool(new.get("fingerprint"))

@@ -101,6 +101,13 @@ class PerfRecorder:
 
             entry["world_size"] = int(_np.prod(
                 [int(v) for v in dict(self.engine.mesh.shape).values()]))
+            # the mesh identity string ("data=4×tensor=2") next to the bare
+            # world size: a ledger line is only comparable to another laid
+            # out the same way, and 8 chips as dp=8 vs dp=4×tp=2 are two
+            # different experiments
+            from deepspeed_tpu.sharding.mesh import mesh_axes_string
+
+            entry["mesh_axes"] = mesh_axes_string(self.engine.mesh)
         except Exception:
             pass
         resized = (getattr(self.engine, "_last_recovery", None)

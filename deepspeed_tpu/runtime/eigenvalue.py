@@ -133,11 +133,16 @@ class Eigenvalue:
                      f"(no {self.layer_name!r} subtree).", ranks=[0])
             return {}
         if self._compiled is None:
-            self._compiled = jax.jit(lambda p, b, k: post_process(
-                block_eigenvalues(
-                    lambda q: loss_fn(q, b), p, k,
-                    layer_name=self.layer_name, max_iter=self.max_iter,
-                    tol=self.tol, stability=self.stability)))
+            from deepspeed_tpu.sharding import INHERIT, sharded_jit
+
+            self._compiled = sharded_jit(
+                lambda p, b, k: post_process(
+                    block_eigenvalues(
+                        lambda q: loss_fn(q, b), p, k,
+                        layer_name=self.layer_name, max_iter=self.max_iter,
+                        tol=self.tol, stability=self.stability)),
+                label="engine/eigenvalue", donate_argnums=(),
+                in_shardings=INHERIT, out_shardings=INHERIT)
         evs = jax.device_get(self._compiled(params, batch, rng))
         if self.layer_num and len(evs) != self.layer_num:
             raise ValueError(f"eigenvalue.layer_num={self.layer_num} but "

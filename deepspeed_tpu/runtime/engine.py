@@ -32,7 +32,8 @@ from deepspeed_tpu import comm as dist
 from deepspeed_tpu import telemetry as _telemetry
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.ops.optimizers import build_optimizer
-from deepspeed_tpu.parallel.topology import DATA_AXIS, EXPERT_AXIS, ParallelGrid, build_mesh
+from deepspeed_tpu.parallel.topology import DATA_AXIS, EXPERT_AXIS, ParallelGrid
+from deepspeed_tpu.sharding import INHERIT, sharded_jit
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.fp16.loss_scaler import (CreateLossScaler, DynamicLossScaler,
                                                     LossScaleState, grads_finite)
@@ -248,6 +249,9 @@ class DeepSpeedEngine:
             tp_specs = model.param_partition_specs()
         self.plan: ShardingPlan = plan_sharding(
             param_shapes, mesh, zero_config=self._config.zero_config, tp_specs=tp_specs)
+        # the spec registry the plan is a view over — the ONE source every
+        # sharded_jit call site reads its in/out shardings from
+        self.sharding = self.plan.registry
         log_dist(partition_report(self.plan, param_shapes), ranks=[0])
 
         # ---- static analysis (ds_doctor) ---------------------------------
@@ -702,8 +706,9 @@ class DeepSpeedEngine:
             opt_sh = jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), opt_sh)
 
         with mesh:
-            params, master, opt_state = jax.jit(
-                build,
+            params, master, opt_state = sharded_jit(
+                build, label="engine/init_state",
+                in_shardings=(), donate_argnums=(), mesh=mesh,
                 out_shardings=(param_sh,
                                master_sh if self._keep_master else None,
                                opt_sh))()
@@ -1184,15 +1189,38 @@ class DeepSpeedEngine:
 
         return step_fn
 
-    def _get_compiled_train_batch(self, gas: int):
-        if gas not in self._compiled_train_batch:
+    def _batch_struct_key(self, batch):
+        """Structure key for per-batch-layout program caching: treedef +
+        per-leaf rank (shardings depend on rank, jit respecializes on
+        shapes itself)."""
+        if batch is None:
+            return None
+        flat, treedef = jax.tree_util.tree_flatten(batch)
+        return (treedef, tuple(len(getattr(x, "shape", np.asarray(x).shape))
+                               for x in flat))
+
+    def _batch_in_shardings(self, batch):
+        """THE batch in_shardings policy for every compiled step variant:
+        registry-derived per-leaf placements (the same ones _shard_batch
+        commits) — so even an uncommitted host batch cannot make XLA
+        invent a layout — or the explicit INHERIT when no batch is in
+        hand (AOT lowering/test paths)."""
+        return (self.sharding.batch_shardings(batch)
+                if batch is not None else INHERIT)
+
+    def _get_compiled_train_batch(self, gas: int, batch=None):
+        key = (gas, self._batch_struct_key(batch))
+        if key not in self._compiled_train_batch:
             fn = self._build_train_batch_fn(gas)
-            batch_sh = None  # inferred; batch constrained by caller device_put
-            self._compiled_train_batch[gas] = jax.jit(
-                fn, donate_argnums=(0,),
-                in_shardings=(self.state_shardings, None),
-                out_shardings=(self.state_shardings, None))
-        return self._compiled_train_batch[gas]
+            # metrics are scalars — replicated, stated as such
+            batch_sh = self._batch_in_shardings(batch)
+            self._compiled_train_batch[key] = sharded_jit(
+                fn, label=f"engine/train_batch[gas={gas}]",
+                donate_argnums=(0,), mesh=self.mesh,
+                in_shardings=(self.state_shardings, batch_sh),
+                out_shardings=(self.state_shardings,
+                               self.sharding.replicated()))
+        return self._compiled_train_batch[key]
 
     # ------------------------------------------------- 1-bit optimizer path
     def _build_train_batch_fn_onebit(self, gas: int, phase: str):
@@ -1277,13 +1305,17 @@ class DeepSpeedEngine:
 
         return step_fn
 
-    def _get_compiled_onebit(self, gas: int, phase: str):
-        key = (gas, phase)
+    def _get_compiled_onebit(self, gas: int, phase: str, batch=None):
+        key = (gas, phase, self._batch_struct_key(batch))
         if key not in self._compiled_train_batch:
-            self._compiled_train_batch[key] = jax.jit(
-                self._build_train_batch_fn_onebit(gas, phase), donate_argnums=(0,),
-                in_shardings=(self.state_shardings, None),
-                out_shardings=(self.state_shardings, None))
+            batch_sh = self._batch_in_shardings(batch)
+            self._compiled_train_batch[key] = sharded_jit(
+                self._build_train_batch_fn_onebit(gas, phase),
+                label=f"engine/train_batch_onebit[gas={gas},{phase}]",
+                donate_argnums=(0,), mesh=self.mesh,
+                in_shardings=(self.state_shardings, batch_sh),
+                out_shardings=(self.state_shardings,
+                               self.sharding.replicated()))
         return self._compiled_train_batch[key]
 
     # --------------------------------------------------- NVMe-offload stepping
@@ -1293,13 +1325,14 @@ class DeepSpeedEngine:
         return "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
                         for p in path)
 
-    def _get_compiled_loss_grads(self, gas: int):
+    def _get_compiled_loss_grads(self, gas: int, batch=None):
         """(loss, mean grads, global grad norm) over the accumulation window —
         no optimizer. The norm is computed IN-JIT over the global sharded
         grads, so every host reads the same scalar (multi-host safe)."""
         if getattr(self, "_compiled_loss_grads", None) is None:
             self._compiled_loss_grads = {}
-        if gas not in self._compiled_loss_grads:
+        key = (gas, self._batch_struct_key(batch))
+        if key not in self._compiled_loss_grads:
             def fn(state: TrainState, batch):
                 loss, grads = self._accumulated_loss_grads(
                     state, batch, gas, jnp.float32(1.0))
@@ -1310,9 +1343,14 @@ class DeepSpeedEngine:
             # pin the grads to the plan's grad placement: the NVMe swap-file
             # keys encode shard index ranges, so init and step must agree on
             # the decomposition
-            self._compiled_loss_grads[gas] = jax.jit(
-                fn, out_shardings=(None, self._nvme_grad_shardings(), None))
-        return self._compiled_loss_grads[gas]
+            batch_sh = self._batch_in_shardings(batch)
+            repl = self.sharding.replicated()
+            self._compiled_loss_grads[key] = sharded_jit(
+                fn, label=f"engine/loss_grads[gas={gas}]",
+                donate_argnums=(), mesh=self.mesh,
+                in_shardings=(self.state_shardings, batch_sh),
+                out_shardings=(repl, self._nvme_grad_shardings(), repl))
+        return self._compiled_loss_grads[key]
 
     @staticmethod
     def _host_shard_items(leaf, name: str):
@@ -1343,7 +1381,8 @@ class DeepSpeedEngine:
         global params reassemble from per-device slabs — no host ever
         materializes the full tree."""
         with self.mesh:
-            loss, grads, gnorm = self._get_compiled_loss_grads(gas)(self.state, batch)
+            loss, grads, gnorm = self._get_compiled_loss_grads(
+                gas, batch)(self.state, batch)
         grad_norm = float(gnorm)
         named_grads = {}
         shard_index = {}     # leaf name -> {index tag -> key}
@@ -1498,7 +1537,8 @@ class DeepSpeedEngine:
             elif self._onebit:
                 phase = self.optimizer.phase_for_step(getattr(self, "_host_step", 0))
                 with self.mesh:
-                    self.state, metrics = self._get_compiled_onebit(gas, phase)(self.state, batch)
+                    self.state, metrics = self._get_compiled_onebit(
+                        gas, phase, batch)(self.state, batch)
             elif self._overlap is not None and self._overlap.schedule == "serial":
                 # the measured un-overlapped ZeRO-3 schedule: a blocking,
                 # span-timed all-gather phase, then the compute program —
@@ -1508,7 +1548,8 @@ class DeepSpeedEngine:
                     self.state, batch, gas)
             else:
                 with self.mesh:
-                    self.state, metrics = self._get_compiled_train_batch(gas)(self.state, batch)
+                    self.state, metrics = self._get_compiled_train_batch(
+                        gas, batch)(self.state, batch)
             self._last_metrics = metrics
             self.micro_steps += gas
             self.global_samples += self.train_batch_size()
@@ -1582,9 +1623,8 @@ class DeepSpeedEngine:
 
         def put(x):
             ndim = np.asarray(x).ndim
-            entries = tuple(self.plan.batch_spec)[:ndim]
-            spec = P(*(entries + (None,) * (ndim - len(entries))))
-            sh = NamedSharding(self.mesh, spec)
+            # ONE source for batch placement: the registry (clamped per rank)
+            sh = self.sharding.batch_sharding(ndim)
             if hasattr(x, "sharding") and x.sharding == sh:
                 return x
             x = np.asarray(x)
@@ -1603,7 +1643,13 @@ class DeepSpeedEngine:
                                       "path (grads must stay worker-local)")
         with _telemetry.get_tracer().span("fwd", step=getattr(self, "_host_step", 0)):
             self.timers(FORWARD_GLOBAL_TIMER).start()
+            batch = self._shard_batch(batch)
+            if (self._compiled_fwd_bwd is not None and
+                    getattr(self, "_fwd_bwd_struct", None)
+                    != self._batch_struct_key(batch)):
+                self._compiled_fwd_bwd = None   # batch layout changed: rebuild
             if self._compiled_fwd_bwd is None:
+                self._fwd_bwd_struct = self._batch_struct_key(batch)
                 def fwd_bwd(state: TrainState, batch):
                     scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
                     rng = jax.random.fold_in(jax.random.fold_in(state.rng, state.step),
@@ -1614,8 +1660,13 @@ class DeepSpeedEngine:
                     grads = jax.lax.with_sharding_constraint(grads, self.plan.grad_specs)
                     return loss, grads
 
-                self._compiled_fwd_bwd = jax.jit(fwd_bwd)
-            batch = self._shard_batch(batch)
+                self._compiled_fwd_bwd = sharded_jit(
+                    fwd_bwd, label="engine/fwd_bwd",
+                    donate_argnums=(), mesh=self.mesh,
+                    in_shardings=(self.state_shardings,
+                                  self.sharding.batch_shardings(batch)),
+                    out_shardings=(self.sharding.replicated(),
+                                   self.plan.grad_shardings()))
             with self.mesh:
                 loss, grads = self._compiled_fwd_bwd(self.state, batch)
             self._pending_grads = grads
@@ -1636,9 +1687,12 @@ class DeepSpeedEngine:
                 self._grad_buffer = grads
             else:
                 if self._compiled_accum is None:
-                    self._compiled_accum = jax.jit(
+                    grad_sh = self.plan.grad_shardings()
+                    self._compiled_accum = sharded_jit(
                         lambda a, g: jax.tree.map(lambda x, y: x + y.astype(x.dtype), a, g),
-                        donate_argnums=(0,))
+                        label="engine/grad_accum", donate_argnums=(0,),
+                        mesh=self.mesh, in_shardings=(grad_sh, grad_sh),
+                        out_shardings=grad_sh)
                 with self.mesh:
                     self._grad_buffer = self._compiled_accum(self._grad_buffer, grads)
             self._micro_loss = loss
@@ -1674,9 +1728,14 @@ class DeepSpeedEngine:
                     grads = jax.tree.map(lambda g: g / gas, grads)
                     return self._apply_grads(state, grads, loss)
 
-                self._compiled_apply = jax.jit(apply_fn, donate_argnums=(0, 1),
-                                               in_shardings=(self.state_shardings, None, None),
-                                               out_shardings=(self.state_shardings, None))
+                self._compiled_apply = sharded_jit(
+                    apply_fn, label="engine/apply_grads",
+                    donate_argnums=(0, 1), mesh=self.mesh,
+                    in_shardings=(self.state_shardings,
+                                  self.plan.grad_shardings(),
+                                  self.sharding.replicated()),
+                    out_shardings=(self.state_shardings,
+                                   self.sharding.replicated()))
             loss = self._micro_loss if self._micro_loss is not None else jnp.float32(0.0)
             with self.mesh:
                 self.state, metrics = self._compiled_apply(self.state, self._grad_buffer, loss)
@@ -1692,15 +1751,25 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         """Loss without grads (for eval loops)."""
+        batch = self._shard_batch(batch)
+        if (self._compiled_eval is not None and
+                getattr(self, "_eval_struct", None)
+                != self._batch_struct_key(batch)):
+            self._compiled_eval = None          # batch layout changed: rebuild
         if self._compiled_eval is None:
+            self._eval_struct = self._batch_struct_key(batch)
             def ev(state, batch):
                 p = self._compute_params(state.params, step=state.step)
                 out = self._loss_fn(p, batch, state.rng) if self._loss_accepts_rng() \
                     else self._loss_fn(p, batch)
                 return out[0] if isinstance(out, tuple) else out
 
-            self._compiled_eval = jax.jit(ev)
-        batch = self._shard_batch(batch)
+            self._compiled_eval = sharded_jit(
+                ev, label="engine/eval_batch", donate_argnums=(),
+                mesh=self.mesh,
+                in_shardings=(self.state_shardings,
+                              self.sharding.batch_shardings(batch)),
+                out_shardings=self.sharding.replicated())
         with self.mesh:
             return self._compiled_eval(self.state, batch)
 
@@ -1784,17 +1853,15 @@ class DeepSpeedEngine:
         if self._nvme_optimizer is not None or self._onebit:
             return None
         gas = int(gas or self._config.gradient_accumulation_steps)
-        jitted = self._get_compiled_train_batch(gas)
 
         def abstract(x):
             arr = x if hasattr(x, "shape") else np.asarray(x)
-            ndim = len(arr.shape)
-            entries = tuple(self.plan.batch_spec)[:ndim]
-            spec = P(*(entries + (None,) * (ndim - len(entries))))
-            return jax.ShapeDtypeStruct(arr.shape, arr.dtype,
-                                        sharding=NamedSharding(self.mesh, spec))
+            return jax.ShapeDtypeStruct(
+                arr.shape, arr.dtype,
+                sharding=self.sharding.batch_sharding(len(arr.shape)))
 
         shapes = jax.tree.map(abstract, batch)
+        jitted = self._get_compiled_train_batch(gas, shapes)
         try:
             with self.mesh:
                 mem = jitted.lower(self.state, shapes).compile().memory_analysis()
@@ -2032,9 +2099,12 @@ class DeepSpeedEngine:
     def module_state_dict(self):
         """Gathered (unsharded) params on host — reference module_state_dict."""
         with self.mesh:
-            gathered = jax.jit(lambda p: p,
-                               out_shardings=jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
-                                                          self.state.params))(self.state.params)
+            gathered = sharded_jit(
+                lambda p: p, label="engine/consolidate_params",
+                donate_argnums=(), mesh=self.mesh,
+                in_shardings=(self.state_shardings.params,),
+                out_shardings=jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
+                                           self.state.params))(self.state.params)
         return jax.tree.map(np.asarray, gathered)
 
     # ------------------------------------------------------------ dataloader

@@ -82,14 +82,17 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         if T + max_new_tokens > self._max_out_tokens:
             raise ValueError(f"sequence {T + max_new_tokens} exceeds hybrid_engine."
                              f"max_out_tokens={self._max_out_tokens}")
-        key = (max_new_tokens, do_sample, temperature, top_k, top_p, eos_token_id)
+        ids_sh = self.sharding.ids_sharding(batch_size=B)
+        key = (max_new_tokens, do_sample, temperature, top_k, top_p,
+               eos_token_id, ids_sh.spec)
         first_call = key not in self._gen_compiled
         if first_call:
             from deepspeed_tpu.inference.engine import build_generate_fn
+            from deepspeed_tpu.sharding import sharded_jit
 
             inner = build_generate_fn(
                 module, max_new_tokens, do_sample, temperature, top_k, top_p,
-                eos_token_id)
+                eos_token_id, cache_shardings=self.sharding.cache_shardings(module))
 
             # _compute_params inside the trace: streams host-offloaded params
             # into HBM and applies the armed compression transform at the
@@ -98,10 +101,32 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             def gen(params, ids, rng, step):
                 return inner(self._compute_params(params, step=step), ids, rng)
 
-            self._gen_compiled[key] = jax.jit(gen)
+            # THE structural fix for the seed-era multichip deadlock
+            # (MULTICHIP_r05.json rc=134, ADVICE.md high): this program used
+            # to enter jax.jit with NO in_shardings, so XLA invented its own
+            # device-group order for the generation collectives — which
+            # raced the train step's dp-subgroup collectives on the shared
+            # 8-device mesh. Now it inherits the TRAIN mesh's specs: params
+            # exactly as the train state holds them, token ids over the dp
+            # batch axes, rng/step replicated, output back on the batch axes.
+            repl = self.sharding.replicated()
+            self._gen_compiled[key] = sharded_jit(
+                gen, label=f"hybrid/generate[new={max_new_tokens}]",
+                donate_argnums=(), mesh=self.mesh,
+                in_shardings=(self.state_shardings.params, ids_sh, repl, repl),
+                out_shardings=ids_sh)
         rng = jax.random.PRNGKey(self._host_rng_seed() if seed is None else seed)
         t0 = time.perf_counter()
         with self.mesh:
+            # program-boundary barrier: the previous train step donated the
+            # state buffers and its collectives may still be in flight on
+            # some devices; dispatching a program with a DIFFERENT collective
+            # structure before every device drained the old one is exactly
+            # the cross-program rendezvous interleaving that wedged the
+            # 8-device CPU mesh. Draining first costs one sync per
+            # generate/train alternation and removes the race class.
+            jax.block_until_ready(jax.tree.leaves(self.state.params))
+            ids = jax.device_put(ids, ids_sh)
             out = self._gen_compiled[key](self.state.params, ids, rng,
                                           self.state.step)
         out.block_until_ready()

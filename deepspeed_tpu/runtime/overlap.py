@@ -468,9 +468,14 @@ class OverlapEngine:
 
         eng = self.engine
         if self._gather_compiled is None:
+            from deepspeed_tpu.sharding import sharded_jit
+
             self._gather_bytes = self._gather_phase_bytes()
-            self._gather_compiled = jax.jit(
-                lambda p: p, out_shardings=self._gathered_shardings())
+            self._gather_compiled = sharded_jit(
+                lambda p: p, label="overlap/zero3_gather",
+                donate_argnums=(), mesh=eng.mesh,
+                in_shardings=(eng.state_shardings.params,),
+                out_shardings=self._gathered_shardings())
         group = "+".join(eng.plan.dp_axes) or "world"
         t0 = time.perf_counter()
         inj = _chaos.active_injector()
@@ -484,7 +489,11 @@ class OverlapEngine:
         _comm.record_phase_span("zero3_gather",
                                 time.perf_counter() - t0, group,
                                 nbytes=self._gather_bytes)
-        if gas not in self._serial_compute:
+        # key includes the batch's pytree layout: the explicit batch
+        # in_shardings pin a structure, so a layout change must rebuild
+        # (same contract as engine._get_compiled_train_batch)
+        skey = (gas, eng._batch_struct_key(batch))
+        if skey not in self._serial_compute:
             def compute_fn(state, params_g, batch):
                 scale = (state.scaler.scale if state.scaler is not None
                          else jnp.float32(1.0))
@@ -492,13 +501,18 @@ class OverlapEngine:
                     state, batch, gas, scale, fwd_params=params_g)
                 return eng._apply_grads(state, grads, loss)
 
-            self._serial_compute[gas] = jax.jit(
-                compute_fn, donate_argnums=(0, 1),
+            from deepspeed_tpu.sharding import sharded_jit
+
+            self._serial_compute[skey] = sharded_jit(
+                compute_fn, label=f"overlap/serial_compute[gas={gas}]",
+                donate_argnums=(0, 1), mesh=eng.mesh,
                 in_shardings=(eng.state_shardings,
-                              self._gathered_shardings(), None),
-                out_shardings=(eng.state_shardings, None))
+                              self._gathered_shardings(),
+                              eng.sharding.batch_shardings(batch)),
+                out_shardings=(eng.state_shardings,
+                               eng.sharding.replicated()))
         with eng.mesh:
-            return self._serial_compute[gas](state, params_g, batch)
+            return self._serial_compute[skey](state, params_g, batch)
 
     # -------------------------------------------------------- async snapshot
     def save_checkpoint_async(self, save_dir, tag=None, client_state=None,
@@ -535,11 +549,16 @@ class AsyncSnapshotter:
 
     def _device_copy(self, state):
         if self._copy is None:
+            from deepspeed_tpu.sharding import INHERIT, sharded_jit
+
             # jnp.copy per leaf: a real on-device copy op — jit output
             # buffers never alias undonated inputs, so the snapshot owns
             # its memory and the step's donation cannot invalidate it
-            self._copy = jax.jit(
-                lambda s: jax.tree.map(jnp.copy, s))
+            self._copy = sharded_jit(
+                lambda s: jax.tree.map(jnp.copy, s),
+                label="overlap/snapshot_copy", donate_argnums=(),
+                mesh=self.engine.mesh,
+                in_shardings=INHERIT, out_shardings=INHERIT)
         with self.engine.mesh:
             return self._copy(state)
 

@@ -15,7 +15,32 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 from jax import lax
+
+
+def shift_stages(x: Any):
+    """GSPMD-native forward transfer: on arrays whose LEADING dim is the
+    stacked stage dim (sharded over 'pipe'), ``out[s] = in[s-1]`` with
+    stage 0 receiving zeros — the roll on a pipe-sharded dim lowers to the
+    collective-permute ``send_forward`` used to spell inside shard_map.
+    Works with no manual mode at all, so it composes with GSPMD-auto
+    ZeRO/TP inside the stage compute on every jax version."""
+    def one(t):
+        r = jnp.roll(t, 1, axis=0)
+        return r.at[0].set(jnp.zeros_like(r[0]))
+
+    return jax.tree.map(one, x)
+
+
+def shift_stages_back(x: Any):
+    """Gradient-direction transfer: ``out[s] = in[s+1]``, last stage
+    receives zeros (its cotangent comes from the loss head, not a peer)."""
+    def one(t):
+        r = jnp.roll(t, -1, axis=0)
+        return r.at[t.shape[0] - 1].set(jnp.zeros_like(r[0]))
+
+    return jax.tree.map(one, x)
 
 
 def send_forward(x: Any, pipe_axis: str = "pipe"):

@@ -46,6 +46,17 @@ import numpy as np
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
+def _host_jit(label, fn):
+    """The NVMe layerwise path runs single-device programs (one layer in
+    HBM at a time); placements are explicitly inherited — stated through
+    sharded_jit so the program table and the unspecified-jit lint see
+    them like every other engine program."""
+    from deepspeed_tpu.sharding import INHERIT, sharded_jit
+
+    return sharded_jit(fn, label=label, donate_argnums=(),
+                       in_shardings=INHERIT, out_shardings=INHERIT)
+
+
 class ZeroInfinityEngine:
     """Layerwise NVMe-resident trainer (params + Adam state on disk)."""
 
@@ -115,7 +126,7 @@ class ZeroInfinityEngine:
             # the fp32 tree fits next to nothing else at init time: ONE
             # compile, then slice on host (13 separate leaf-extractor
             # compiles cost minutes through a remote-compile tunnel)
-            tree = jax.jit(model.init_params)(key)
+            tree = _host_jit("infinity/init_params", model.init_params)(key)
             self.shared = {n: jnp.asarray(np.asarray(v))
                            for n, v in tree.items() if n != "blocks"}
             for leaf_name, leaf in tree["blocks"].items():
@@ -125,12 +136,14 @@ class ZeroInfinityEngine:
             del tree
         else:
             # >HBM model: leaf-at-a-time (XLA DCEs the other leaves)
-            shared_fn = jax.jit(
+            shared_fn = _host_jit(
+                "infinity/init_shared",
                 lambda k: {n: v for n, v in model.init_params(k).items()
                            if n != "blocks"})
             self.shared = {n: jnp.asarray(v) for n, v in shared_fn(key).items()}
             for leaf_name in self._blk_shapes:
-                leaf_fn = jax.jit(
+                leaf_fn = _host_jit(
+                    f"infinity/init_leaf[{leaf_name}]",
                     lambda k, _n=leaf_name: model.init_params(k)["blocks"][_n])
                 full = np.asarray(leaf_fn(key), dtype=np.float32)
                 for l in range(L):
@@ -163,7 +176,7 @@ class ZeroInfinityEngine:
 
     def _jit(self, name, fn):
         if name not in self._compiled:
-            self._compiled[name] = jax.jit(fn)
+            self._compiled[name] = _host_jit(f"infinity/{name}", fn)
         return self._compiled[name]
 
     # ------------------------------------------------------------ train step

@@ -92,7 +92,12 @@ class Init(contextlib.AbstractContextManager):
                     if jnp.issubdtype(x.dtype, jnp.floating) else x,
                     init_fn(*a, **k))
         with self.mesh:
-            out = jax.jit(fn, out_shardings=shardings)(*args, **kwargs)
+            from deepspeed_tpu.sharding import INHERIT, sharded_jit
+
+            out = sharded_jit(fn, label="zero/init_materialize",
+                              donate_argnums=(), mesh=self.mesh,
+                              in_shardings=INHERIT,
+                              out_shardings=shardings)(*args, **kwargs)
         n = sum(int(x.size) for x in jax.tree.leaves(out))
         log_dist(f"zero.Init: materialized {n/1e6:.1f}M params sharded over "
                  f"{plan.dp_axes}", ranks=[0])

@@ -94,22 +94,57 @@ def _shard_over_dp(shape: Tuple[int, ...], base_spec: Optional[P], dp_axes: Sequ
     return P(*entries)
 
 
-@dataclasses.dataclass
 class ShardingPlan:
-    """Per-pytree NamedShardings for every piece of training state."""
+    """Per-pytree NamedShardings for every piece of training state — a VIEW
+    over the :class:`~deepspeed_tpu.sharding.registry.ShardingRegistry`.
 
-    mesh: Mesh
-    param_specs: Any       # compute params (what the forward pass reads)
-    master_specs: Any      # fp32 master copy (stage>=1: dp-sharded)
-    grad_specs: Any        # gradients (stage>=2: dp-sharded)
-    batch_spec: P          # input batch
-    zero_stage: int
-    dp_axes: Tuple[str, ...]
+    The plan used to OWN the spec trees; now the registry does (one source
+    for params / master / grads / batch / optimizer state / KV cache), and
+    the plan keeps its historical attribute surface (``param_specs``,
+    ``master_shardings()``, …) as reads of the registry, so ZeRO consumers
+    and the overlap engine did not have to move."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, param_specs: Any = None,
+                 master_specs: Any = None, grad_specs: Any = None,
+                 batch_spec: Optional[P] = None, zero_stage: int = 0,
+                 dp_axes: Tuple[str, ...] = (), registry=None):
+        from deepspeed_tpu.sharding.registry import ShardingRegistry
+
+        if registry is None:
+            assert mesh is not None, "ShardingPlan needs a mesh or a registry"
+            registry = ShardingRegistry(mesh)
+            registry.register("params", param_specs)
+            registry.register("master", master_specs)
+            registry.register("grads", grad_specs)
+            registry.register("batch", batch_spec)
+        self.registry = registry
+        self.zero_stage = int(zero_stage)
+        self.dp_axes = tuple(dp_axes)
+        self._master_shapes = None
+
+    # ------------------------------------------------------- registry views
+    @property
+    def mesh(self) -> Mesh:
+        return self.registry.mesh
+
+    @property
+    def param_specs(self) -> Any:
+        return self.registry.spec("params")
+
+    @property
+    def master_specs(self) -> Any:
+        return self.registry.spec("master")
+
+    @property
+    def grad_specs(self) -> Any:
+        return self.registry.spec("grads")
+
+    @property
+    def batch_spec(self) -> P:
+        return self.registry.spec("batch")
 
     def named(self, spec: P, memory_kind: Optional[str] = None) -> NamedSharding:
-        if memory_kind:
-            return NamedSharding(self.mesh, spec, memory_kind=memory_kind)
-        return NamedSharding(self.mesh, spec)
+        return self.registry.named(spec, memory_kind)
 
     def param_shardings(self):
         return jax.tree.map(self.named, self.param_specs,
@@ -125,8 +160,6 @@ class ShardingPlan:
 
     def batch_sharding(self) -> NamedSharding:
         return self.named(self.batch_spec)
-
-    _master_shapes: Any = None
 
     def map_opt_state_specs(self, opt_state_shapes: Any, master_shapes: Any):
         """Build specs for the optimizer state given abstract shapes.
@@ -189,7 +222,11 @@ class ShardingPlan:
 
         flat = jax.tree_util.tree_flatten_with_path(opt_state_shapes)
         leaves = [leaf_spec(path, leaf) for path, leaf in flat[0]]
-        return jax.tree_util.tree_unflatten(flat[1], leaves)
+        specs = jax.tree_util.tree_unflatten(flat[1], leaves)
+        # the optimizer state is an engine pytree like any other: its specs
+        # live in the registry too (ds_report mesh renders them from there)
+        self.registry.register("opt_state", specs)
+        return specs
 
 
 def plan_sharding(param_shapes: Any,
@@ -308,9 +345,14 @@ def plan_sharding(param_shapes: Any,
         else:
             batch_spec = P(batch_axes if batch_axes else None)
 
-    plan = ShardingPlan(mesh=mesh, param_specs=param_specs, master_specs=master_specs,
-                        grad_specs=grad_specs, batch_spec=batch_spec, zero_stage=stage,
-                        dp_axes=dp_axes)
+    from deepspeed_tpu.sharding.registry import ShardingRegistry
+
+    registry = ShardingRegistry(mesh)
+    registry.register("params", param_specs)
+    registry.register("master", master_specs)
+    registry.register("grads", grad_specs)
+    registry.register("batch", batch_spec)
+    plan = ShardingPlan(registry=registry, zero_stage=stage, dp_axes=dp_axes)
     plan._master_shapes = param_shapes
     return plan
 
@@ -336,14 +378,20 @@ def partition_report(plan: ShardingPlan, param_shapes: Any) -> str:
         why = ("world size 1 — nothing to shard across"
                if dp_world <= 1 else
                "the configured shard axes have size 1 on this mesh")
+        from deepspeed_tpu.sharding.mesh import mesh_axes_string
+
         return (f"ZeRO stage {plan.zero_stage}: {n_total/1e6:.1f}M params, "
-                f"dp sharding inactive ({why}); params/optimizer state "
-                "stay whole on each chip (expected on this topology, not "
-                "a sharding bug — the ZeRO placement activates when a "
+                f"dp sharding inactive ({why}) "
+                f"[mesh {mesh_axes_string(plan.mesh)}]; params/optimizer "
+                "state stay whole on each chip (expected on this topology, "
+                "not a sharding bug — the ZeRO placement activates when a "
                 "data-parallel mesh axis has size > 1)")
+    from deepspeed_tpu.sharding.mesh import mesh_axes_string
+
     pct = 100.0 * n_sharded / max(1, n_total)
     msg = (f"ZeRO stage {plan.zero_stage}: {n_total/1e6:.1f}M params, "
-           f"{pct:.1f}% dp-sharded over axes {plan.dp_axes}")
+           f"{pct:.1f}% dp-sharded over axes {plan.dp_axes} "
+           f"[mesh {mesh_axes_string(plan.mesh)}]")
     if plan.dp_axes == (MICS_AXIS,):
         n_groups = plan.mesh.shape.get(DATA_AXIS, 1)
         msg += (f" (MiCS: {n_groups} replica groups × "

@@ -392,19 +392,41 @@ class ServingFrontEnd:
     def _get_programs(self, req: Request) -> tuple:
         key = self._program_key(req)
         if key not in self._programs:
-            import jax
-
             from deepspeed_tpu.inference.engine import build_serving_programs
+            from deepspeed_tpu.sharding import INHERIT, sharded_jit
 
+            eng = self.engine
+            cache_sh = eng.sharding.cache_shardings(eng.module)
             pf, dc = build_serving_programs(
-                self.engine.module,
-                max_total_len=int(self.engine._config.max_out_tokens),
+                eng.module,
+                max_total_len=int(eng._config.max_out_tokens),
                 chunk_tokens=int(self.cfg.decode_tick_tokens),
                 do_sample=req.do_sample, temperature=req.temperature,
                 top_k=req.top_k, top_p=req.top_p,
                 eos_token_id=req.eos_token_id,
-                param_transform=self.engine._dequant)
-            self._programs[key] = (jax.jit(pf), jax.jit(dc))
+                param_transform=eng._dequant,
+                cache_shardings=cache_sh)
+            params_in = eng._params_in_shardings()
+            cache_io = cache_sh if cache_sh is not None else INHERIT
+            # serving batches are ragged (whatever requests are in flight),
+            # so ids/logits/done explicitly INHERIT; the KV cache — the one
+            # big buffer that cycles program-to-program across ticks — is
+            # pinned to the registry's placement both ways
+            self._programs[key] = (
+                sharded_jit(pf, label="serving/prefill", donate_argnums=(),
+                            mesh=eng.mesh,
+                            in_shardings=(params_in, INHERIT),
+                            out_shardings=(INHERIT, cache_io, INHERIT)),
+                sharded_jit(dc, label="serving/decode_chunk",
+                            # NO donation: a tick that dies on its deadline
+                            # leaves the request's last-good cache intact for
+                            # the partial-flush path — donating it here would
+                            # trade that guarantee for one buffer of HBM
+                            donate_argnums=(), mesh=eng.mesh,
+                            in_shardings=(params_in, INHERIT, cache_io,
+                                          INHERIT, INHERIT),
+                            out_shardings=(INHERIT, cache_io, INHERIT,
+                                           INHERIT, INHERIT)))
         return self._programs[key]
 
     def _tick(self, req: Request, fn, warm_key: tuple):

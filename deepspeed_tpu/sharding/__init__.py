@@ -1,0 +1,42 @@
+"""GSPMD-native sharding core — ONE mesh, ONE spec source, NO implicit jit.
+
+The unification layer ROADMAP Open Item 1 calls for: before this package,
+five subsystems each threaded their own sharding (the ZeRO planner, the
+inference AutoTP path, the MoE dispatch, the pipeline executor, and the
+ring-SP attention), and several engine programs entered ``jax.jit`` with no
+``in_shardings`` at all — which is how the RLHF hybrid ``generate()`` let
+XLA invent a device-group order that raced the train step's collectives on
+the 8-device dp×tp mesh (MULTICHIP_r05.json rc=134).
+
+Three pieces:
+
+* :mod:`~deepspeed_tpu.sharding.mesh` — the process-global named mesh,
+  constructed ONCE from the ``tpu`` config block (axes pipe/data/mics/
+  expert/seq/tensor, built on ``parallel.topology.build_mesh``). Every
+  engine, inference engine, and hybrid program runs on THIS mesh object,
+  so their collectives share one device order by construction.
+* :mod:`~deepspeed_tpu.sharding.registry` — the spec registry: every
+  engine pytree (params, master, optimizer state, grads, KV cache,
+  batches) maps to a :class:`~jax.sharding.NamedSharding` derived from one
+  place. The ZeRO :class:`ShardingPlan` is a view over this registry.
+* :mod:`~deepspeed_tpu.sharding.jit` — :func:`sharded_jit`, the ONLY way
+  engine code compiles a program: explicit ``in_shardings`` /
+  ``out_shardings`` / ``donate_argnums`` are mandatory keyword arguments,
+  and every compiled program lands in a process-global table that
+  ``ds_report mesh`` renders and the ds_doctor ``sharding/unspecified-jit``
+  lint audits.
+"""
+
+from deepspeed_tpu.sharding.jit import (INHERIT, ProgramRecord, program_table,
+                                        render_program_table,
+                                        reset_program_table, sharded_jit)
+from deepspeed_tpu.sharding.mesh import (ensure_global_mesh, global_mesh,
+                                         mesh_axes_string, reset_global_mesh)
+from deepspeed_tpu.sharding.registry import ShardingRegistry
+
+__all__ = [
+    "INHERIT", "ProgramRecord", "ShardingRegistry", "ensure_global_mesh",
+    "global_mesh", "mesh_axes_string", "program_table",
+    "render_program_table", "reset_global_mesh", "reset_program_table",
+    "sharded_jit",
+]

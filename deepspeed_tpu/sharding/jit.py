@@ -1,0 +1,218 @@
+"""``sharded_jit`` — the one door engine programs walk through to compile.
+
+Wraps ``jax.jit`` with three obligations the bare call lets you skip:
+
+* ``in_shardings`` / ``out_shardings`` are REQUIRED keyword arguments.
+  A program compiled without them on a multi-device mesh leaves XLA free
+  to invent shardings — including a device-group order that disagrees
+  with the train step's, which is the RLHF ``generate()`` deadlock class
+  (MULTICHIP_r05.json: collective rendezvous timeout, rc=134). Writing
+  :data:`INHERIT` is allowed — it states, explicitly, "this operand is
+  already committed to the right placement" — but it must be WRITTEN.
+* ``donate_argnums`` is required (pass ``()`` to donate nothing): every
+  program states its buffer-reuse contract where the reviewer can see it.
+* every compiled program is recorded in a process-global table —
+  ``(label, call site, mesh axes, in/out spec summary, donation)`` —
+  which ``ds_report mesh`` renders and the ds_doctor
+  ``sharding/unspecified-jit`` lint audits.
+
+The wrapper is intentionally thin: it resolves :data:`INHERIT` to the
+``None`` jax.jit spells inference with, registers the record, and returns
+the jitted callable unchanged (lower/compile/AOT all still work).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+__all__ = ["INHERIT", "ProgramRecord", "program_table", "sharded_jit",
+           "render_program_table", "reset_program_table",
+           "describe_shardings"]
+
+
+class _Inherit:
+    """Sentinel: 'inherit the committed operand's sharding' — the explicit
+    spelling of what a bare ``jax.jit`` does implicitly. Resolves to None
+    at the jax level; the program table records that it was chosen."""
+
+    def __repr__(self):
+        return "INHERIT"
+
+
+INHERIT = _Inherit()
+
+
+@dataclasses.dataclass
+class ProgramRecord:
+    """One engine-compiled program's sharding contract."""
+
+    label: str
+    call_site: str
+    mesh_axes: str
+    in_desc: str
+    out_desc: str
+    donate: Tuple[int, ...]
+    inherited_in: bool          # whole-argument INHERIT appeared in inputs
+    inherited_out: bool
+    generation: int = 0         # global-mesh generation at compile wrap time
+
+
+_LOCK = threading.Lock()
+_PROGRAMS: Dict[str, ProgramRecord] = {}
+
+
+def program_table() -> Dict[str, ProgramRecord]:
+    """Snapshot of every program registered this process (label-keyed;
+    re-registering a label — engines recompiling — overwrites)."""
+    with _LOCK:
+        return dict(_PROGRAMS)
+
+
+def reset_program_table() -> None:
+    with _LOCK:
+        _PROGRAMS.clear()
+
+
+def _resolve(tree):
+    """INHERIT → None (jax.jit's 'infer from operand'), recursively.
+    Returns (resolved, saw_inherit)."""
+    saw = False
+
+    def leaf(x):
+        nonlocal saw
+        if isinstance(x, _Inherit):
+            saw = True
+            return None
+        return x
+
+    resolved = jax.tree.map(leaf, tree,
+                            is_leaf=lambda x: isinstance(x, _Inherit) or x is None)
+    return resolved, saw
+
+
+def describe_shardings(tree, limit: int = 4) -> str:
+    """Compact multiset of the distinct PartitionSpecs in a shardings
+    pytree — ``P('data',)×12 P()×3`` — for the program table."""
+    if isinstance(tree, _Inherit):
+        return "inherit"
+    if tree is None:
+        return "infer"
+    counts: Dict[str, int] = {}
+    for leaf in jax.tree.leaves(
+            tree, is_leaf=lambda x: isinstance(x, _Inherit) or x is None):
+        if isinstance(leaf, _Inherit):
+            key = "inherit"
+        elif hasattr(leaf, "spec"):   # NamedSharding
+            key = f"P{tuple(leaf.spec)!r}"
+        else:
+            key = repr(leaf)
+        counts[key] = counts.get(key, 0) + 1
+    if not counts:
+        # a zero-argument program (in_shardings=()) has nothing to inherit
+        return "no-args"
+    items = sorted(counts.items(), key=lambda kv: -kv[1])
+    shown = [f"{k}×{v}" if v > 1 else k for k, v in items[:limit]]
+    if len(items) > limit:
+        shown.append(f"(+{len(items) - limit} more)")
+    return " ".join(shown)
+
+
+def _caller_site() -> str:
+    frame = inspect.currentframe()
+    try:
+        f = frame.f_back.f_back      # skip _caller_site and sharded_jit
+        while f is not None and f.f_code.co_filename.endswith(
+                os.path.join("sharding", "jit.py")):
+            f = f.f_back
+        if f is None:
+            return "<unknown>"
+        path = f.f_code.co_filename
+        marker = os.sep + "deepspeed_tpu" + os.sep
+        i = path.rfind(marker)
+        rel = path[i + len(os.sep):] if i >= 0 else os.path.basename(path)
+        return f"{rel.replace(os.sep, '/')}:{f.f_lineno}"
+    finally:
+        del frame
+
+
+def sharded_jit(fn, *, label: str, in_shardings, out_shardings,
+                donate_argnums: Tuple[int, ...],
+                static_argnums=None, static_argnames=None,
+                mesh=None):
+    """``jax.jit`` with the sharding contract stated and recorded.
+
+    Args:
+      label: stable program name (``"engine/train_batch"``) — the table
+        key, what the lint and ``ds_report mesh`` print.
+      in_shardings / out_shardings: pytree (prefix) of
+        :class:`~jax.sharding.NamedSharding` (or :data:`INHERIT` /
+        per-leaf ``None`` for explicitly-inherited operands). REQUIRED.
+      donate_argnums: REQUIRED — ``()`` means "nothing donated", written
+        down rather than defaulted.
+      mesh: records the mesh identity in the table (defaults to the
+        process-global mesh at wrap time).
+    """
+    if not label:
+        raise ValueError("sharded_jit: a non-empty program label is required")
+    if in_shardings is None or out_shardings is None:
+        raise TypeError(
+            f"sharded_jit({label!r}): in_shardings/out_shardings must be "
+            "explicit — pass registry specs or sharding.INHERIT. A bare "
+            "None means 'let XLA decide', which is the unspecified-jit "
+            "deadlock class this wrapper exists to forbid")
+    from deepspeed_tpu.sharding.mesh import (global_mesh, mesh_axes_string,
+                                             mesh_generation)
+
+    in_resolved, in_inh = _resolve(in_shardings)
+    out_resolved, out_inh = _resolve(out_shardings)
+    record = ProgramRecord(
+        label=label,
+        call_site=_caller_site(),
+        mesh_axes=mesh_axes_string(mesh if mesh is not None else global_mesh()),
+        in_desc=describe_shardings(in_shardings),
+        out_desc=describe_shardings(out_shardings),
+        donate=tuple(donate_argnums),
+        inherited_in=in_inh or isinstance(in_shardings, _Inherit),
+        inherited_out=out_inh or isinstance(out_shardings, _Inherit),
+        generation=mesh_generation())
+    with _LOCK:
+        _PROGRAMS[label] = record
+
+    kwargs: Dict[str, Any] = dict(donate_argnums=tuple(donate_argnums))
+    if static_argnums is not None:
+        kwargs["static_argnums"] = static_argnums
+    if static_argnames is not None:
+        kwargs["static_argnames"] = static_argnames
+    if in_resolved is not None:
+        kwargs["in_shardings"] = in_resolved
+    if out_resolved is not None:
+        kwargs["out_shardings"] = out_resolved
+    jitted = jax.jit(fn, **kwargs)
+    try:
+        jitted.program_record = record   # introspection hook (ds_report/tests)
+    except (AttributeError, TypeError):
+        pass
+    return jitted
+
+
+def render_program_table(mesh: Optional[Any] = None) -> str:
+    """The per-program in/out spec table ``ds_report mesh`` prints."""
+    from deepspeed_tpu.sharding.mesh import global_mesh, mesh_axes_string
+
+    mesh = mesh if mesh is not None else global_mesh()
+    rows = sorted(program_table().values(), key=lambda r: r.label)
+    lines = [f"mesh: {mesh_axes_string(mesh)}"
+             + (f" ({len(rows)} compiled program(s))" if rows else
+                " (no programs compiled yet)")]
+    for r in rows:
+        donate = f"donate={list(r.donate)}" if r.donate else "donate=()"
+        lines.append(f"  {r.label}  [{r.mesh_axes}]  {donate}  @ {r.call_site}")
+        lines.append(f"    in:  {r.in_desc}")
+        lines.append(f"    out: {r.out_desc}")
+    return "\n".join(lines)

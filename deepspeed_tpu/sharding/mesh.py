@@ -1,0 +1,152 @@
+"""The process-global named mesh.
+
+One ``jax.sharding.Mesh`` per process, constructed from the ``tpu`` config
+block through :func:`~deepspeed_tpu.parallel.topology.build_mesh` and CACHED:
+asking for the same axis dims returns the SAME object, so the train engine,
+the inference engine, the hybrid engine and the serving front-end compile
+their programs against one device order. A request for different dims
+rebuilds (a new "generation") — legitimate for sequential jobs in one
+process (the multichip dryrun runs five topologies back to back), logged so
+an accidental topology flap is visible.
+
+Why object identity matters: two meshes built from the same dims have equal
+device order (``mesh_utils.create_device_mesh`` is deterministic), but every
+independently-built mesh is another chance for a subsystem to pass
+``devices=`` or ``axis_dims=`` that differ subtly — and a program compiled
+over a mesh whose device order disagrees with the train step's deadlocks
+the collective rendezvous (the MULTICHIP_r05 failure class). One cached
+object turns "the same mesh" from a convention into a fact.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from jax.sharding import Mesh
+
+from deepspeed_tpu.utils.logging import logger
+
+_GLOBAL_MESH: Optional[Mesh] = None
+_GENERATION: int = 0
+_RNG_PINNED = False
+
+
+def _enable_sharding_invariant_rng() -> None:
+    """Force partitionable threefry ON (one-time, with the first mesh).
+
+    On jax 0.4.x the flag defaults to False, and non-partitionable
+    threefry is NOT sharding-invariant: the same ``jax.random.normal``
+    compiled with dp/pipe-sharded ``out_shardings`` yields DIFFERENT
+    values than the unsharded draw (measured: 0.09 abs diff on a 0.02-std
+    init). That silently made a model's initialization depend on its
+    topology — a pp=2 engine trained from different weights than the
+    pp=1 engine with the same seed. One mesh, one RNG semantics: every
+    placement decision flows through this package, so the invariance
+    knob lives here too.
+    """
+    global _RNG_PINNED
+    if _RNG_PINNED:
+        return
+    import jax
+
+    try:
+        if not jax.config.jax_threefry_partitionable:
+            jax.config.update("jax_threefry_partitionable", True)
+            logger.info("jax_threefry_partitionable enabled: random inits "
+                        "are now sharding-invariant (a sharded draw equals "
+                        "the unsharded draw for the same key)")
+    except AttributeError:
+        pass     # newer jax: always-on, flag removed
+    _RNG_PINNED = True
+
+
+def global_mesh() -> Optional[Mesh]:
+    """The current process-global mesh, or None before the first build."""
+    return _GLOBAL_MESH
+
+
+def mesh_generation() -> int:
+    """How many times the global mesh has been (re)built this process."""
+    return _GENERATION
+
+
+def _dims_of(mesh: Mesh) -> Dict[str, int]:
+    return {a: int(mesh.shape[a]) for a in mesh.axis_names}
+
+
+def ensure_global_mesh(mesh_config=None, devices=None,
+                       axis_dims: Optional[Dict[str, int]] = None) -> Mesh:
+    """Return THE process mesh for the requested topology.
+
+    Same resolved axis dims as the current global mesh → the cached object.
+    Different dims → a fresh build replaces it (logged). Accepts the same
+    arguments as :func:`~deepspeed_tpu.parallel.topology.build_mesh`; with
+    none given, the dims resolve from a default ``TPUMeshConfig`` (data =
+    all devices).
+    """
+    global _GLOBAL_MESH, _GENERATION
+    from deepspeed_tpu.parallel.topology import _resolve_mesh_dims, build_mesh
+
+    _enable_sharding_invariant_rng()
+    if axis_dims is None:
+        import jax
+
+        from deepspeed_tpu.runtime.config import TPUMeshConfig
+
+        n = len(devices) if devices is not None else len(jax.devices())
+        axis_dims = _resolve_mesh_dims(mesh_config or TPUMeshConfig(), n)
+    # normalize against the canonical axis set (missing axes = size 1):
+    # "data=8" and "data=8 with mics/seq elided" are the SAME topology and
+    # must hit the same cache entry — a spurious rebuild would hand two
+    # subsystems two distinct Mesh objects for one topology
+    from deepspeed_tpu.parallel.topology import ALL_AXES
+
+    want = {a: int(axis_dims.get(a, 1)) for a in ALL_AXES}
+    for a, v in axis_dims.items():
+        want[a] = int(v)
+    cur = _GLOBAL_MESH
+    if cur is not None and _dims_of(cur) == want and devices is None:
+        return cur
+    mesh = build_mesh(devices=devices, axis_dims=want)
+    if cur is not None and _dims_of(cur) != want:
+        logger.info(
+            f"global mesh rebuilt: {_nontrivial(_dims_of(cur))} -> "
+            f"{_nontrivial(want)} (generation {_GENERATION + 1}); programs "
+            "compiled on the previous mesh keep running on it — sequential "
+            "jobs are fine, interleaving them is not")
+    _GLOBAL_MESH = mesh
+    _GENERATION += 1
+    return mesh
+
+
+def adopt_global_mesh(mesh: Mesh) -> Mesh:
+    """Install a caller-built mesh (mpu=, resize survivor meshes) as the
+    process-global one, so later same-dims requests reuse it."""
+    global _GLOBAL_MESH, _GENERATION
+    _enable_sharding_invariant_rng()
+    if mesh is not _GLOBAL_MESH:
+        _GLOBAL_MESH = mesh
+        _GENERATION += 1
+    return mesh
+
+
+def reset_global_mesh() -> None:
+    """Drop the cached mesh (tests; a fresh comm backend does this)."""
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = None
+
+
+def _nontrivial(dims: Dict[str, int]) -> Dict[str, int]:
+    return {a: v for a, v in dims.items() if v > 1} or dict(list(dims.items())[:1])
+
+
+def mesh_axes_string(mesh: Optional[Mesh]) -> str:
+    """Compact ``data=4×tensor=2`` identity of a mesh — the string ds_perf
+    ledger entries carry so a benchmark line is mesh-attributable, and the
+    header ``ds_report mesh`` prints. Size-1 axes are elided; a fully
+    trivial mesh renders as ``single-device``."""
+    if mesh is None:
+        return "unmeshed"
+    parts = [f"{a}={int(mesh.shape[a])}" for a in mesh.axis_names
+             if int(mesh.shape[a]) > 1]
+    return "×".join(parts) if parts else "single-device"

@@ -49,11 +49,16 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.fixture(autouse=True)
 def _reset_comm():
-    """Each test gets a fresh global comm backend."""
+    """Each test gets a fresh global comm backend (and sharding core)."""
     yield
     from deepspeed_tpu.comm import comm
 
     comm.cdb = None
+    from deepspeed_tpu.sharding import mesh as _smesh
+    from deepspeed_tpu.sharding import jit as _sjit
+
+    _smesh.reset_global_mesh()
+    _sjit.reset_program_table()
 
 
 @pytest.fixture

@@ -521,11 +521,16 @@ class TestSmokeMatrix:
 
     def test_explicitly_requested_pass_without_inputs_says_skipped(self):
         """A pass the caller asked for by name that cannot run must say so
-        (info finding), not render as a clean result."""
+        (info finding), not render as a clean result. The sharding pass's
+        unspecified-jit lint runs model-free (and must be CLEAN on the
+        migrated tree), but its sharding-PLAN sub-pass still needs a
+        fixture — the skip note says which half did not run."""
         report = run_doctor(dict(BASE_CFG), passes=("sharding", "collectives"),
                             world_size=1)
         rules = {f.rule for f in report.findings}
         assert rules == {"sharding/pass-skipped", "collectives/pass-skipped"}
+        [sk] = [f for f in report.findings if f.rule == "sharding/pass-skipped"]
+        assert "unspecified-jit lint ran" in sk.message
         assert all(f.severity == "info" for f in report.findings)
         assert not report.should_fail("error")
 

@@ -1,0 +1,196 @@
+"""Same-shape unit tests for the five multichip gate programs.
+
+Each dryrun program of ``__graft_entry__.dryrun_multichip(8)`` gets a named
+test with the SAME topology shape (smaller model dims where that doesn't
+change the program structure), so gate breakage localizes to a test name
+instead of an rc=134 tail. Plus the VERDICT #7 compositions (ring+ZeRO-3,
+Ulysses) and the deterministic regression drill for the seed-era RLHF
+generate/train deadlock (chaos-marked).
+
+Topology shapes (8 virtual CPU devices from conftest):
+  1. dp4×tp2 ZeRO-3 fused train step
+  2. pp2×tp2×dp2 1F1B pipeline + ZeRO-3
+  3. dp2×ep4 Switch-MoE + ZeRO-3 (a2a over the expert axis)
+  4. dp2×sp4 ring-attention sequence parallel + ZeRO-1
+  5. dp4×tp2 ZeRO-3 RLHF hybrid generate→train
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model, synthetic_lm_batch
+
+
+def _mk(model, tpu, *, stage=3, extra=None, batch_size=None, gas=1):
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.sharding import mesh as smesh
+
+    comm.cdb = None
+    smesh.reset_global_mesh()
+    dp = 1
+    for a, v in tpu.items():
+        if a in ("data", "mics", "expert"):
+            dp *= v
+    cfg = {
+        "train_batch_size": batch_size if batch_size is not None else 2 * dp * gas,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": stage,
+                              "stage3_param_persistence_threshold": 0}
+        if stage >= 3 else {"stage": stage},
+        "tpu": tpu,
+        "steps_per_print": 0,
+    }
+    cfg.update(extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+@pytest.mark.multichip
+def test_program1_dp_tp_zero3():
+    """Gate program 1: dp4×tp2 ZeRO-3 fused train step, gas=2."""
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                     n_head=4, remat=True, use_flash_attention=False)
+    eng = _mk(GPT2Model(cfg), {"tensor": 2, "data": 4}, gas=2)
+    batch = synthetic_lm_batch(eng.train_batch_size(), 32, cfg.vocab_size, seed=0)
+    loss = eng.train_batch(batch)
+    assert np.isfinite(float(loss))
+    # ZeRO-3: the block stacks must actually be dp-sharded
+    qkv = eng.state.params["blocks"]["qkv_w"]
+    assert qkv.sharding.spec != jax.sharding.PartitionSpec()
+
+
+@pytest.mark.multichip
+def test_program2_1f1b_pipeline_zero3():
+    """Gate program 2: pp2×tp2×dp2 NeoX-flavored 1F1B pipeline + ZeRO-3."""
+    from deepspeed_tpu.models.gpt2_pipe import PipelinedGPT2
+
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=4,
+                     n_head=4, remat=True, use_flash_attention=False,
+                     rotary_pct=0.25, parallel_residual=True)
+    eng = _mk(PipelinedGPT2(cfg, num_stages=2, num_micro=4, schedule="1f1b"),
+              {"pipe": 2, "tensor": 2, "data": 2}, batch_size=16)
+    batch = synthetic_lm_batch(eng.train_batch_size(), 32, cfg.vocab_size, seed=1)
+    loss = eng.train_batch(batch)
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.multichip
+def test_program3_moe_expert_parallel():
+    """Gate program 3: dp2×ep4 Switch-8-expert MoE, expert bank sharded."""
+    from deepspeed_tpu.models.gpt2_moe import MoEGPT2
+
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                     n_head=4, remat=True, use_flash_attention=False)
+    eng = _mk(MoEGPT2(cfg, num_experts=8, ep_size=4),
+              {"data": 2, "expert": 4}, batch_size=8)
+    batch = synthetic_lm_batch(eng.train_batch_size(), 32, cfg.vocab_size, seed=2)
+    loss = eng.train_batch(batch)
+    assert np.isfinite(float(loss))
+    wi = eng.state.params["moe"]["experts"]["wi"]
+    assert wi.addressable_shards[0].data.shape[1] == wi.shape[1] // 4
+
+
+@pytest.mark.multichip
+def test_program4_ring_sp_zero1():
+    """Gate program 4: dp2×sp4 ring-attention sequence parallel + ZeRO-1."""
+    cfg = GPT2Config(vocab_size=256, n_positions=128, n_embd=64, n_layer=2,
+                     n_head=4, remat=True, use_flash_attention=False,
+                     sequence_parallel="ring")
+    eng = _mk(GPT2Model(cfg), {"data": 2, "seq": 4}, stage=1, batch_size=4)
+    batch = synthetic_lm_batch(eng.train_batch_size(), 128, cfg.vocab_size, seed=3)
+    loss = eng.train_batch(batch)
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.multichip
+def test_program5_rlhf_hybrid_generate_train():
+    """Gate program 5: dp4×tp2 ZeRO-3 hybrid generate→train, one iteration."""
+    cfg = GPT2Config(vocab_size=256, n_positions=96, n_embd=64, n_layer=2,
+                     n_head=4, remat=False, use_flash_attention=False)
+    eng = _mk(GPT2Model(cfg), {"tensor": 2, "data": 4},
+              extra={"hybrid_engine": {"enabled": True, "max_out_tokens": 48}},
+              batch_size=8)
+    prompts = np.random.RandomState(7).randint(
+        0, cfg.vocab_size, size=(8, 16)).astype(np.int32)
+    seqs = np.asarray(eng.generate(prompts, max_new_tokens=16))
+    assert seqs.shape == (8, 32)
+    assert (seqs[:, :16] == prompts).all(), "prompt echo mismatch"
+    mask = np.zeros(seqs.shape, np.float32)
+    mask[:, 16:] = 1.0
+    loss = eng.train_batch({"input_ids": seqs.astype(np.int32),
+                            "loss_mask": mask})
+    assert np.isfinite(float(loss))
+
+
+# --------------------------------------------------------------- VERDICT #7
+@pytest.mark.multichip
+def test_composition_ring_sp_with_zero3():
+    """Ring-SP composed with ZeRO-3 (not just ZeRO-1): params dp-sharded
+    while tokens shard over 'seq' — the composition VERDICT #7 asked for."""
+    cfg = GPT2Config(vocab_size=256, n_positions=128, n_embd=64, n_layer=2,
+                     n_head=4, remat=True, use_flash_attention=False,
+                     sequence_parallel="ring")
+    eng = _mk(GPT2Model(cfg), {"data": 2, "seq": 4}, stage=3, batch_size=4)
+    batch = synthetic_lm_batch(eng.train_batch_size(), 128, cfg.vocab_size, seed=4)
+    losses = [float(eng.train_batch(batch)) for _ in range(2)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[1] < losses[0]    # it actually optimizes
+
+
+@pytest.mark.multichip
+def test_composition_ulysses_sp():
+    """Ulysses head-scatter SP (heads % seq == 0) trains finite."""
+    cfg = GPT2Config(vocab_size=256, n_positions=128, n_embd=64, n_layer=2,
+                     n_head=4, remat=True, use_flash_attention=False,
+                     sequence_parallel="ulysses")
+    eng = _mk(GPT2Model(cfg), {"data": 2, "seq": 4}, stage=1, batch_size=4)
+    batch = synthetic_lm_batch(eng.train_batch_size(), 128, cfg.vocab_size, seed=5)
+    loss = eng.train_batch(batch)
+    assert np.isfinite(float(loss))
+
+
+# ------------------------------------------------- the deadlock regression
+@pytest.mark.multichip
+@pytest.mark.chaos
+def test_generate_train_alternation_drill():
+    """Deterministic regression drill for the seed-era deadlock class
+    (ADVICE.md high, MULTICHIP_r05 rc=134): alternate generate/train
+    program dispatch under dp×tp ZeRO-3 on the 8-device simulated mesh.
+    The two programs have DIFFERENT collective structures (dp-subgroup
+    gathers vs 8-device permutes); before the sharding core, XLA invented
+    conflicting device-group orders and the rendezvous wedged ~1-in-2
+    runs. Clean completion of the alternation IS the assertion — plus the
+    program table showing generate compiled with explicit placements."""
+    cfg = GPT2Config(vocab_size=256, n_positions=96, n_embd=32, n_layer=2,
+                     n_head=2, remat=False, use_flash_attention=False)
+    eng = _mk(GPT2Model(cfg), {"tensor": 2, "data": 4},
+              extra={"hybrid_engine": {"enabled": True, "max_out_tokens": 48}},
+              batch_size=8)
+    rs = np.random.RandomState(11)
+    for it in range(4):
+        prompts = rs.randint(0, cfg.vocab_size, size=(8, 16)).astype(np.int32)
+        seqs = np.asarray(eng.generate(prompts, max_new_tokens=8))
+        assert seqs.shape == (8, 24)
+        assert (seqs[:, :16] == prompts).all()
+        mask = np.zeros(seqs.shape, np.float32)
+        mask[:, 16:] = 1.0
+        loss = eng.train_batch({"input_ids": seqs.astype(np.int32),
+                                "loss_mask": mask})
+        assert np.isfinite(float(loss)), f"iteration {it}"
+    stats = eng.hybrid_stats()
+    assert stats["generate_calls"] == 4
+
+    # the structural fix is visible in the program table: the generate
+    # program carries explicit in/out shardings on the dp×tp mesh
+    from deepspeed_tpu.sharding import program_table
+
+    gen = [r for label, r in program_table().items()
+           if label.startswith("hybrid/generate")]
+    assert gen, "hybrid generate program missing from the program table"
+    assert all(not r.inherited_in or r.in_desc != "inherit" for r in gen)
+    assert all(r.in_desc != "infer" and r.out_desc != "infer" for r in gen)

@@ -74,7 +74,11 @@ def test_pld_theta_one_is_identity():
     base, _ = _train(_config(), steps=2)
     gated, _ = _train(_config({"enabled": True, "theta": 1.0, "gamma": 0.0}),
                       steps=2)
-    np.testing.assert_allclose(base, gated, rtol=1e-5)
+    # rtol: the gated step is a DIFFERENT XLA program (the keep-gates are
+    # traced in), so fused-f32 reassociation drifts the loss a hair —
+    # measured 1.7e-5 rel under partitionable threefry; 5e-5 still pins
+    # "identity", a dropped block would move the loss by percents
+    np.testing.assert_allclose(base, gated, rtol=5e-5)
 
 
 def test_pld_works_under_gas_scan():

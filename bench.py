@@ -747,7 +747,14 @@ def northstar_evidence(on_tpu: bool, n_dev: int) -> dict:
                             model64.init_params(jax.random.PRNGKey(0)))
     ids64 = jnp.asarray(synthetic_lm_batch(
         bs64, seq, cfg64.vocab_size, seed=0)["input_ids"])
-    grad_fn = jax.jit(jax.grad(lambda p, i: model64.loss(p, {"input_ids": i})))
+    # single-chip measurement program: placement is wherever the operands
+    # live, stated explicitly (INHERIT) so the sharding lint can see it
+    from deepspeed_tpu.sharding import INHERIT, sharded_jit
+
+    grad_fn = sharded_jit(
+        jax.grad(lambda p, i: model64.loss(p, {"input_ids": i})),
+        label="bench/northstar_grad", donate_argnums=(),
+        in_shardings=INHERIT, out_shardings=INHERIT)
     drain = lambda r: float(jnp.asarray(jax.tree.leaves(r)[0]).ravel()[0])
     drain(grad_fn(params64, ids64))          # compile
     # host contention only ever INFLATES wall time, so take the best of two
@@ -776,7 +783,8 @@ def northstar_evidence(on_tpu: bool, n_dev: int) -> dict:
 
     reps = 20
 
-    @jax.jit
+    @partial(sharded_jit, label="bench/northstar_opt_update",
+             donate_argnums=(), in_shardings=INHERIT, out_shardings=INHERIT)
     def upd_loop(w, st, gr):
         # lax.scan inside ONE jit: the ~10ms-per-call tunnel dispatch would
         # otherwise dominate a ~1ms HBM-bound update (axon measurement rule)

@@ -20,7 +20,16 @@ lints what is statically knowable from the program BEFORE step 0:
   constraint checks (zero stage vs offload, watchdog vs telemetry, …);
 * **self-lint** (:mod:`~deepspeed_tpu.analysis.selflint`) — an AST lint
   of this codebase (untimed host collectives outside ``comm``, bare
-  ``time.time()`` in the step path) that runs in tier-1.
+  ``time.time()`` in the step path) that runs in tier-1;
+* **xray pass** (:mod:`~deepspeed_tpu.analysis.xray` +
+  :mod:`~deepspeed_tpu.analysis.hlo_model`) — the post-GSPMD layer: AOT
+  lower+compile every program of the ``sharded_jit`` table (no
+  execution) and lint the COMPILED HLO — cross-program collective
+  rendezvous compatibility (the rc=134 deadlock class as a permanent
+  lint), promise-vs-actual shardings per pytree family, dropped
+  donations from the executable's alias table, and a static
+  per-program comm-bytes model (``static_comm_bytes`` in the perf
+  ledger).
 
 Entry points: the ``analysis`` ds_config block (engine init — a STRICT
 no-op when the block is absent: this package is never even imported),
@@ -35,4 +44,14 @@ from deepspeed_tpu.analysis.doctor import (engine_graph_analysis,  # noqa: F401
                                            engine_init_analysis, run_doctor)
 
 __all__ = ["Finding", "AnalysisReport", "AnalysisError", "SEVERITIES",
-           "run_doctor", "engine_init_analysis", "engine_graph_analysis"]
+           "run_doctor", "engine_init_analysis", "engine_graph_analysis",
+           "run_xray"]
+
+
+def run_xray(*args, **kwargs):
+    """Lazy alias for :func:`deepspeed_tpu.analysis.xray.run_xray` (the
+    xray module imports jax-heavy machinery; keep it off the package
+    import path)."""
+    from deepspeed_tpu.analysis.xray import run_xray as _run
+
+    return _run(*args, **kwargs)

@@ -27,11 +27,24 @@ Options:
 
 Exit codes: 0 = clean (below fail-on), 2 = findings tripped fail-on,
 1 = usage/internal error.
+
+Subcommand::
+
+    ds_doctor xray --config ds_config.json [--model gpt2] [--devices 8]
+
+builds a family-fixture engine from the config, runs ONE train step to
+populate the ``sharded_jit`` program table, then AOT-compiles every
+program and lints the COMPILED HLO (collective-order, promise-vs-actual,
+donation audit, static comm bytes) — the post-GSPMD layer the trace
+passes cannot see. ``--devices N`` forces N simulated CPU devices (set
+before the jax backend initializes), so an 8-way ZeRO config x-rays on a
+laptop.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -74,8 +87,75 @@ def _load_graph_builder(spec: str, cfg):
     return fn, args, donate
 
 
+def xray_cli(argv) -> int:
+    """``ds_doctor xray`` — build an engine fixture, step once, x-ray
+    the compiled fleet."""
+    ap = argparse.ArgumentParser(
+        prog="ds_doctor xray",
+        description="post-GSPMD compiled-HLO analysis of every program "
+                    "in the sharded_jit table")
+    ap.add_argument("--config", required=True, help="ds_config JSON path")
+    ap.add_argument("--model", default="gpt2",
+                    help="registry family/preset fixture (default gpt2)")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force N simulated CPU devices (must be set "
+                         "before the jax backend initializes)")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warn", "never"])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    if args.devices and args.devices > 1:
+        # same rewrite rule as __graft_entry__: a PRE-EXISTING smaller
+        # count in XLA_FLAGS must be raised, not silently kept — or the
+        # "8-device" analysis quietly runs on a 4-device mesh
+        import re
+
+        fl = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", fl)
+        if m is None:
+            fl = (fl + f" --xla_force_host_platform_device_count="
+                  f"{args.devices}").strip()
+        elif int(m.group(1)) < args.devices:
+            fl = fl.replace(m.group(0),
+                            f"--xla_force_host_platform_device_count="
+                            f"{args.devices}")
+        os.environ["XLA_FLAGS"] = fl
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    from deepspeed_tpu.analysis.findings import AnalysisReport
+    from deepspeed_tpu.analysis.xray import xray_for_config
+
+    try:
+        result = xray_for_config(args.config, args.model,
+                                 batch_size=args.batch, seq_len=args.seq)
+    except FileNotFoundError as e:
+        print(f"ds_doctor xray: {e}", file=sys.stderr)
+        return 1
+    report = AnalysisReport().extend(result.findings, "xray")
+    if args.json:
+        import json as _json
+
+        payload = _json.loads(report.to_json())
+        payload["programs"] = result.comm
+        print(_json.dumps(payload, indent=2))
+    else:
+        print(result.render())
+        if report.findings:
+            print(report.render("ds_doctor xray findings"))
+    return 2 if report.should_fail(args.fail_on) else 0
+
+
 def main(argv=None) -> int:
-    args = _parse(list(sys.argv[1:] if argv is None else argv))
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "xray":
+        return xray_cli(argv[1:])
+    args = _parse(argv)
     from deepspeed_tpu.analysis.doctor import ALL_PASSES, run_doctor
 
     # None = "every pass its inputs allow"; an explicit list additionally

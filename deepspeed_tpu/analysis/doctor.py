@@ -20,8 +20,14 @@ from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
 from deepspeed_tpu.analysis.findings import AnalysisReport, Finding
 
-ALL_PASSES = ("schema", "sharding", "graph", "collectives", "selflint")
-# what the engine runs by default (selflint is a CI concern, not a job's)
+ALL_PASSES = ("schema", "sharding", "graph", "collectives", "selflint",
+              "xray")
+# what "no --passes given" expands to: every TRACE-ONLY pass. xray is
+# deliberately absent — it AOT-compiles programs (XLA, not a trace), so it
+# runs only when named explicitly (same opt-in contract as the engine's).
+DEFAULT_PASSES = ("schema", "sharding", "graph", "collectives", "selflint")
+# what the engine runs by default (selflint is a CI concern, not a job's;
+# xray costs one AOT compile per program — explicit opt-in only)
 ENGINE_PASSES = ("schema", "sharding", "graph", "collectives")
 
 
@@ -60,8 +66,12 @@ def engine_init_analysis(engine, param_shapes) -> AnalysisReport:
                                min_elements=acfg.min_replicated_elements),
             "sharding")
         # the unspecified-jit lint: no engine program may enter jax.jit
-        # outside sharded_jit (AST over the package, memoized per process)
-        report.extend(lint_unspecified_jit(), "sharding")
+        # outside sharded_jit (AST over the package, memoized per process).
+        # Package only here: the repo-script scan (bin/*, bench.py) is a CI
+        # concern — a job vendoring this package next to its own bench.py
+        # must not die at engine init over scripts that never run
+        report.extend(lint_unspecified_jit(include_scripts=False),
+                      "sharding")
     return _finish(report, acfg.fail_on,
                    log=lambda m: log_dist(m, ranks=[0]))
 
@@ -131,6 +141,66 @@ def engine_graph_analysis(engine, batch, gas: int) -> AnalysisReport:
         report.extend(verify_collective_consistency(rec), "collectives")
     return _finish(report, acfg.fail_on,
                    log=lambda m: log_dist(m, ranks=[0]))
+
+
+def _compiled_donation_lint(fn, args, donate_argnums, min_bytes: int):
+    """The donation story from the COMPILED alias table of the user step
+    (the ``graph/missing-donation`` rebase): AOT lower+compile the graph
+    with its declared donation, then read what the executable actually
+    aliases — a large arg never donated is flagged as missing-donation
+    with compiled byte counts, and a donated arg whose buffers produced
+    no alias is flagged as ``xray/donation-dropped``. Returns None when
+    the compile (or the parameter mapping) is not possible, and the
+    caller falls back to the jaxpr heuristic — one defect is one
+    finding either way."""
+    import jax
+
+    from deepspeed_tpu.analysis.graph_lint import RULE_DONATION
+    from deepspeed_tpu.analysis.hlo_model import parse_hlo_module
+    from deepspeed_tpu.analysis.xray import RULE_DONATION_DROPPED
+
+    donated = set(donate_argnums)
+    try:
+        jitted = jax.jit(fn, donate_argnums=tuple(donated))
+        model = parse_hlo_module(jitted.lower(*args).compile().as_text())
+    except Exception:
+        return None
+    ranges = []
+    n = 0
+    for arg in args:
+        leaves = len(jax.tree.leaves(arg))
+        ranges.append((n, n + leaves))
+        n += leaves
+    if len(model.parameter_bytes) != n:
+        return None     # parameter mapping disagrees — don't guess
+    aliased = model.aliased_parameters()
+    findings = []
+    for i, (lo, hi) in enumerate(ranges):
+        nbytes = sum(model.parameter_bytes[lo:hi])
+        if i in donated:
+            dropped = sum(model.parameter_bytes[j] for j in range(lo, hi)
+                          if j not in aliased)
+            if dropped >= min_bytes:
+                findings.append(Finding(
+                    rule=RULE_DONATION_DROPPED, severity="warning",
+                    message=(f"train step donates argument {i} but "
+                             f"{dropped / 2**20:.0f} MiB of it produced no "
+                             "input-output alias in the compiled executable "
+                             "— the donation silently dropped (usually a "
+                             "dtype/layout change between the donated input "
+                             "and every output); old and new stay live "
+                             "together"),
+                    citation=f"arg[{i}]", pass_name="xray"))
+        elif nbytes >= min_bytes:
+            findings.append(Finding(
+                rule=RULE_DONATION, severity="warning",
+                message=(f"train step argument {i} ({nbytes / 2**20:.0f} MiB "
+                         "in the compiled executable) is not donated — XLA "
+                         "keeps the old tree alive next to the new one, "
+                         f"doubling its peak HBM; add donate_argnums=({i},) "
+                         "if the caller never reuses it"),
+                citation=f"arg[{i}]", pass_name="xray"))
+    return findings
 
 
 # ----------------------------------------------------------------- CLI driver
@@ -211,7 +281,7 @@ def run_doctor(config: Any,
     import json as _json
 
     explicit = passes is not None
-    passes = tuple(passes or ALL_PASSES)
+    passes = tuple(passes or DEFAULT_PASSES)
     report = AnalysisReport()
 
     def skipped(pass_name: str, why: str) -> None:
@@ -286,13 +356,51 @@ def run_doctor(config: Any,
                            min_promote_elements=cfg.analysis.min_promote_elements),
                 "graph")
             if donate_argnums is not None:
-                report.extend(
-                    lint_donation(args, donate_argnums,
-                                  min_bytes=cfg.analysis.min_donate_bytes),
-                    "graph")
+                # donation story, one defect = one finding: with the xray
+                # pass also requested, the COMPILED alias table is the
+                # source of truth (graph/missing-donation rebased on what
+                # the executable actually aliases + xray/donation-dropped
+                # for declared-but-dropped); the jaxpr heuristic stays the
+                # no-compile fallback
+                compiled_findings = None
+                if "xray" in passes:
+                    compiled_findings = _compiled_donation_lint(
+                        fn, args, donate_argnums,
+                        min_bytes=cfg.analysis.min_donate_bytes)
+                if compiled_findings is not None:
+                    report.extend(compiled_findings, "xray")
+                else:
+                    report.extend(
+                        lint_donation(args, donate_argnums,
+                                      min_bytes=cfg.analysis.min_donate_bytes),
+                        "graph")
         else:
             skipped("graph", _schema_why() if cfg is None else
                     "needs --model or --graph (something to trace)")
+
+    if "xray" in passes:
+        from deepspeed_tpu.sharding import program_table
+
+        records = [r for r in program_table().values() if r.can_lower()]
+        if records:
+            from deepspeed_tpu.analysis.xray import run_xray
+
+            kw = {}
+            if cfg is not None:
+                # honor the SAME thresholds the trace passes honor — a
+                # raised min_replicated_elements/min_donate_bytes must
+                # silence the xray variants of those findings too
+                kw = dict(
+                    min_replicated_elements=cfg.analysis.min_replicated_elements,
+                    min_donate_bytes=cfg.analysis.min_donate_bytes)
+            result = run_xray(records, **kw)
+            report.extend(result.findings, "xray")
+            report.xray = result       # CLI renders the comm table from this
+        else:
+            skipped("xray",
+                    "the process-global program table holds no dispatched "
+                    "programs — run an engine step first (bin/ds_doctor "
+                    "xray builds one from --model and does this for you)")
 
     if "collectives" in passes:
         if collective_logs and len(collective_logs) < 2:

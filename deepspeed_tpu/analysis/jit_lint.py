@@ -22,7 +22,9 @@ Allowlisted files (bare jax.jit permitted):
 * ``env_report.py`` — a lower-only capability probe, never dispatched on a
   training mesh;
 * ``profiling/flops_profiler/profiler.py`` — AOT ``lower()`` for jaxpr
-  walks; nothing is executed.
+  walks; nothing is executed;
+* ``analysis/doctor.py`` — the compiled donation lint AOT-compiles a
+  user-supplied graph to read its alias table; nothing is dispatched.
 
 Zero findings on the migrated tree is a tier-1 assertion
 (tests/unit/test_sharding.py), so a bare jit cannot merge back in.
@@ -43,6 +45,9 @@ BARE_JIT_ALLOWED = (
     "sharding/jit.py",
     "env_report.py",
     "profiling/flops_profiler/profiler.py",
+    # AOT lower().compile() of a USER-supplied graph purely to read its
+    # alias table (the compiled donation lint) — nothing is dispatched
+    "analysis/doctor.py",
 )
 
 
@@ -110,18 +115,48 @@ def lint_jit_source(src: str, relpath: str) -> List[Finding]:
 _AST_CACHE = {}
 
 
+def repo_script_paths(root: str) -> List[str]:
+    """The repo-level entry scripts the lint also covers: ``bin/*``
+    (extensionless python launchers) and ``bench.py``. These dispatch
+    real programs — bench.py compiles the whole ladder — so a bare
+    ``jax.jit`` there is exactly as deadlock-capable as one in the
+    package; package-only coverage left them a blind spot."""
+    repo = os.path.dirname(root)
+    out: List[str] = []
+    bench = os.path.join(repo, "bench.py")
+    if os.path.isfile(bench):
+        out.append(bench)
+    bindir = os.path.join(repo, "bin")
+    if os.path.isdir(bindir):
+        for fn in sorted(os.listdir(bindir)):
+            path = os.path.join(bindir, fn)
+            if not os.path.isfile(path):
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    head = f.read(128)
+            except (OSError, UnicodeDecodeError):
+                continue
+            first = head.splitlines()[0] if head else ""
+            if "python" in first:
+                out.append(path)
+    return out
+
+
 def lint_unspecified_jit(root: Optional[str] = None,
-                         skip_dirs: Sequence[str] = ("__pycache__",)
-                         ) -> List[Finding]:
-    """AST lint of every .py file of the deepspeed_tpu package. Memoized
-    per root: the source tree does not change mid-process, and the engine
-    runs this at every init."""
+                         skip_dirs: Sequence[str] = ("__pycache__",),
+                         include_scripts: bool = True) -> List[Finding]:
+    """AST lint of every .py file of the deepspeed_tpu package, plus the
+    repo's entry scripts (``bin/*``, ``bench.py``) when they sit next to
+    it. Memoized per root: the source tree does not change mid-process,
+    and the engine runs this at every init."""
     if root is None:
         import deepspeed_tpu
 
         root = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
-    if root in _AST_CACHE:
-        return list(_AST_CACHE[root])
+    key = (root, include_scripts)
+    if key in _AST_CACHE:
+        return list(_AST_CACHE[key])
     findings: List[Finding] = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d not in skip_dirs]
@@ -136,7 +171,17 @@ def lint_unspecified_jit(root: Optional[str] = None,
             except OSError:
                 continue     # the selflint pass reports unreadable files
             findings.extend(lint_jit_source(src, rel))
-    _AST_CACHE[root] = list(findings)
+    if include_scripts:
+        repo = os.path.dirname(root)
+        for path in repo_script_paths(root):
+            rel = os.path.relpath(path, repo).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            findings.extend(lint_jit_source(src, rel))
+    _AST_CACHE[key] = list(findings)
     return findings
 
 

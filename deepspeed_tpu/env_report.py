@@ -334,6 +334,14 @@ def main(args=None):
         # `ds_report rewind <save_dir>` — the restore ladder's view of a
         # checkpoint dir (tiers, verification, what would be picked)
         return rewind_section(args[1:])
+    if args and args[0] == "xray":
+        # `ds_report xray --config X [--model F] [--devices N]` — the
+        # post-GSPMD compiled-fleet view (collective schedules, actual
+        # shardings, donation aliases, static comm bytes); the full tool
+        # is `ds_doctor xray`
+        from deepspeed_tpu.analysis.cli import xray_cli
+
+        return xray_cli(args[1:])
     line = "-" * 72
     print(line)
     print("deepspeed_tpu environment report")

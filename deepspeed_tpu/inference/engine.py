@@ -431,7 +431,8 @@ class InferenceEngine:
                     label=f"inference/generate[new={max_new_tokens}]",
                     donate_argnums=(), mesh=self.mesh,
                     in_shardings=(self._params_in_shardings(), ids_sh, repl),
-                    out_shardings=ids_sh)
+                    out_shardings=ids_sh,
+                    meta={"params_argnum": 0})
             with self.mesh:
                 ids = jax.device_put(ids, ids_sh)
                 return self._compiled[key](self.params, ids, rng)
@@ -463,7 +464,8 @@ class InferenceEngine:
                             in_shardings=(params_in, ids_sh),
                             out_shardings=(INHERIT,
                                            cache_sh if cache_sh is not None
-                                           else INHERIT)),
+                                           else INHERIT),
+                            meta={"params_argnum": 0}),
                 sharded_jit(df, label=f"inference/decode[new={max_new_tokens}]",
                             # the cache is dead after the decode consumes it —
                             # donating it avoids a second live KV buffer
@@ -471,7 +473,8 @@ class InferenceEngine:
                             in_shardings=(params_in, ids_sh, INHERIT,
                                           cache_sh if cache_sh is not None
                                           else INHERIT, repl),
-                            out_shardings=ids_sh))
+                            out_shardings=ids_sh,
+                            meta={"params_argnum": 0, "cache_argnum": 3}))
         pf, df = self._compiled[key]
         ids = jax.device_put(ids, ids_sh)
         tracer = _telemetry.get_tracer()

@@ -122,12 +122,15 @@ def exposed_comm_from_events(events: List[dict],
     return sum(per_step[s] for s in steps) / len(steps)
 
 
-def collect(engine, session=None, timed_steps: Optional[int] = None
-            ) -> Dict[str, Any]:
+def collect(engine, session=None, timed_steps: Optional[int] = None,
+            static_comm: bool = True) -> Dict[str, Any]:
     """The full attribution dict for one engine run. ``session`` defaults
     to the live telemetry session; ``timed_steps`` windows the span
     breakdown and the exposed-comm average to the last N steps (the
-    measurement window — warmup/compile spans otherwise dominate p99)."""
+    measurement window — warmup/compile spans otherwise dominate p99).
+    ``static_comm`` stamps the xray compiled-HLO comm bill (one AOT
+    compile of the train program on multi-device meshes; 0 for free on a
+    single device)."""
     from deepspeed_tpu import telemetry
 
     if session is None:
@@ -176,4 +179,25 @@ def collect(engine, session=None, timed_steps: Optional[int] = None
             att["flops_per_batch"] = flops
     except Exception as e:
         logger.warning(f"perf attribution: flops estimate failed: {e}")
+    # ---- static comm: the xray ring-model wire bytes of the COMPILED
+    # train program — the hardware-free number `ds_perf gate --metric
+    # static_comm_bytes` regresses on (ROADMAP Item 2's before/after).
+    # Lazy import by design: the xray module only loads when a perf
+    # entry is actually recorded with the knob on, and failure degrades
+    # to absence like every other attribution piece.
+    if static_comm:
+        try:
+            from deepspeed_tpu.analysis.xray import static_comm_for_engine
+
+            sc = static_comm_for_engine(engine)
+            if sc is not None:
+                att["static_comm_bytes"] = int(sc["static_comm_bytes"])
+                att["static_comm"] = {
+                    "by_kind": sc["by_kind"],
+                    "collectives": sc["collectives"],
+                    "est_bus_us": sc["est_bus_us"],
+                    "program": sc.get("program"),
+                }
+        except Exception as e:
+            logger.warning(f"perf attribution: static comm failed: {e}")
     return att

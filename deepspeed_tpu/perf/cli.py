@@ -108,6 +108,14 @@ def _exposed_line(r):
             + (" [REGRESSED]" if r.get("exposed_comm_regressed") else ""))
 
 
+def _static_comm_line(r):
+    if "new_static_comm_bytes" not in r:
+        return ""
+    return (f"  static_comm {r['old_static_comm_bytes'] / 2**20:.2f} -> "
+            f"{r['new_static_comm_bytes'] / 2**20:.2f} MiB/dev/step"
+            + (" [REGRESSED]" if r.get("static_comm_regressed") else ""))
+
+
 def _cmd_diff(args) -> int:
     old = led.latest_by_series(_load(args.old))
     new = led.latest_by_series(_load(args.new))
@@ -136,10 +144,14 @@ def _cmd_diff(args) -> int:
                                if r["fingerprint_changed"] else "")
         print(f"{mark} {r['series']}: {_fmt_val(r['old_value'])} -> "
               f"{_fmt_val(r['new_value'])} ({r['rel_delta']:+.1%})"
-              f"{noise}{fp}{_exposed_line(r)}")
+              f"{noise}{fp}{_exposed_line(r)}{_static_comm_line(r)}")
         if "exposed_comm" in attr_sel and "new_exposed_comm_us" not in r:
             print(f"   {r['series']}: exposed_comm not recorded on both "
                   "sides (needs telemetry-instrumented entries)")
+        if "static_comm_bytes" in attr_sel \
+                and "new_static_comm_bytes" not in r:
+            print(f"   {r['series']}: static_comm_bytes not recorded on "
+                  "both sides (needs perf.static_comm entries)")
     return 0
 
 
@@ -184,11 +196,17 @@ def _cmd_gate(args) -> int:
             # the run never measured
             missing.append(f"{k} (exposed_comm attribution)")
             continue
+        if "static_comm_bytes" in attr_sel \
+                and "new_static_comm_bytes" not in r:
+            missing.append(f"{k} (static_comm_bytes attribution)")
+            continue
         checked.append(r)
         if r["verdict"] == "regression" or not r["new_value"] \
                 or r.get("goodput_regressed") \
                 or ("exposed_comm" in attr_sel
-                    and r.get("exposed_comm_regressed")):
+                    and r.get("exposed_comm_regressed")) \
+                or ("static_comm_bytes" in attr_sel
+                    and r.get("static_comm_regressed")):
             failures.append(r)
     if args.json:
         print(json.dumps({"checked": checked, "missing": missing,
@@ -207,7 +225,8 @@ def _cmd_gate(args) -> int:
                          f"{r['new_goodput']:.3f}"
                          + (" [REGRESSED]" if r.get("goodput_regressed")
                             else ""))
-            print(line + _world_tag(r) + _exposed_line(r))
+            print(line + _world_tag(r) + _exposed_line(r)
+                  + _static_comm_line(r))
         for k in crashed:
             e = newest[k]
             print(f"FAIL {k}: newest run FAILED "
@@ -280,7 +299,11 @@ def main(argv=None) -> int:
                         "'exposed_comm' gates the selected series on their "
                         "exposed-comm µs/step attribution (lower is better; "
                         "growth past tolerance + a 50µs floor fails) — the "
-                        "overlap win regresses like a headline metric")
+                        "overlap win regresses like a headline metric. "
+                        "'static_comm_bytes' gates on the xray compiled-HLO "
+                        "comm bill (lower is better; deterministic, so any "
+                        "growth past tolerance + a 1MiB floor is a real "
+                        "schedule regression — no hardware needed)")
     g.add_argument("--all", action="store_true",
                    help="gate every series the two files share")
     g.add_argument("--allow-missing", action="store_true",

@@ -218,10 +218,16 @@ _T_CRIT = {1: 12.71, 2: 4.30, 3: 3.18, 4: 2.78, 5: 2.57, 6: 2.45, 7: 2.36,
 # flag microsecond jitter on entries that expose next to nothing.
 EXPOSED_COMM_FLOOR_US = 50.0
 
+# Static-comm regression floor (bytes/device/step): the xray ring-model
+# bill is DETERMINISTIC for a fixed program, so any growth is a real
+# schedule change — but sub-floor deltas (a rounding-level reshard on a
+# tiny fixture) should not fail CI.
+STATIC_COMM_FLOOR_BYTES = 1 << 20
+
 # Attribution-level metrics `ds_perf gate/diff --metric` understands in
 # addition to series-key substrings: these select WHAT is compared (the
 # embedded attribution value), not WHICH series.
-ATTRIBUTION_METRICS = ("exposed_comm", "goodput")
+ATTRIBUTION_METRICS = ("exposed_comm", "goodput", "static_comm_bytes")
 
 # Minimum per-side sample count for the t gate to carry a verdict: with
 # fewer, a failed significance test means "underpowered", not "noise",
@@ -353,6 +359,23 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         out["exposed_comm_delta_us"] = en - eo
         out["exposed_comm_regressed"] = (
             (en - eo) > max(rel_tol * max(eo, 1.0), EXPOSED_COMM_FLOOR_US))
+    # static_comm_bytes rides the same way (stamped by the xray pass from
+    # the COMPILED train program's collective schedule): LOWER is better,
+    # and unlike a measured metric it is deterministic per program — a
+    # quantized/hierarchical collective rewrite (ROADMAP Item 2) shows up
+    # as a drop here with no hardware in the loop, and a schedule
+    # regression (an extra all-gather, a lost overlap rewrite) as growth.
+    # Judged relative with an absolute floor; no t gate (nothing to be
+    # noisy about).
+    so = (old.get("attribution") or {}).get("static_comm_bytes")
+    sn = (new.get("attribution") or {}).get("static_comm_bytes")
+    if so is not None and sn is not None:
+        so, sn = float(so), float(sn)
+        out["old_static_comm_bytes"] = so
+        out["new_static_comm_bytes"] = sn
+        out["static_comm_delta_bytes"] = sn - so
+        out["static_comm_regressed"] = (
+            (sn - so) > max(rel_tol * max(so, 1.0), STATIC_COMM_FLOOR_BYTES))
     go, gn = old.get("goodput_fraction"), new.get("goodput_fraction")
     if go is not None and gn is not None:
         out["old_goodput"] = float(go)

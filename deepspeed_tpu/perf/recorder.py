@@ -130,7 +130,8 @@ class PerfRecorder:
                             else attribution)
         if want_attribution:
             entry["attribution"] = _attribution.collect(
-                self.engine, session=session, timed_steps=timed_steps)
+                self.engine, session=session, timed_steps=timed_steps,
+                static_comm=getattr(self.cfg, "static_comm", True))
             gf = (entry["attribution"].get("goodput") or {}).get(
                 "goodput_fraction")
             if gf is not None:

@@ -520,7 +520,7 @@ class AnalysisConfig(DeepSpeedConfigModel):
     'analysis' section for the rule table."""
     enabled: bool = Field(True, description="run the analyzer at engine init + first train_batch (the block being present opts in; set false to keep the block but skip the work)")
     fail_on: str = Field("error", description="'error' aborts init/step-0 on any error finding; 'warn' also on warnings; 'never' reports only")
-    passes: list = Field([], description="subset of (schema, sharding, graph, collectives) to run; empty = all four (selflint is a CI pass, not an engine pass)")
+    passes: list = Field([], description="subset of (schema, sharding, graph, collectives, xray) to run; empty = the first four (selflint is a CI pass, not an engine pass; xray — the post-GSPMD compiled-HLO analyzer — costs one AOT compile per program and runs after the FIRST train_batch, so it must be named explicitly)")
     record_collectives: bool = Field(True, description="record this rank's static collective sequence during the step trace and cross-check it against the other ranks")
     min_promote_elements: int = Field(65536, gt=0, description="dtype-promotion lint fires only for matmuls with an operand at least this large (scalar/loss-path fp32 math is fine)")
     min_replicated_elements: int = Field(100_000, gt=0, description="sharding lint ignores leaves smaller than this (small leaves are intentionally kept whole)")
@@ -537,7 +537,8 @@ class AnalysisConfig(DeepSpeedConfigModel):
     @field_validator("passes")
     @classmethod
     def _passes_known(cls, v):
-        known = ("schema", "sharding", "graph", "collectives", "selflint")
+        known = ("schema", "sharding", "graph", "collectives", "selflint",
+                 "xray")
         bad = [p for p in v if p not in known]
         if bad:
             raise ValueError(f"analysis.passes: unknown pass(es) {bad}; "
@@ -581,6 +582,7 @@ class PerfConfig(DeepSpeedConfigModel):
     enabled: bool = Field(True, description="arm the perf recorder (the block being present opts in; set false to keep the block but skip the work)")
     ledger_path: str = Field("", description="append each perf_record() entry to this JSONL ledger (process 0 only); empty = entries are returned to the caller but not persisted")
     attribution: bool = Field(True, description="embed the telemetry/profiling attribution (span p50/p99, memory census, flops, exposed comm) in each entry; false = headline + identity fields only")
+    static_comm: bool = Field(True, description="stamp the train program's static comm bill (xray ring-model wire bytes per collective kind from the compiled HLO) into each entry as attribution.static_comm_bytes — the hardware-free number `ds_perf gate --metric static_comm_bytes` regresses on; multi-device meshes pay one AOT compile per entry, single-device short-circuits to 0")
 
 
 class GoodputConfig(DeepSpeedConfigModel):

@@ -266,6 +266,7 @@ class DeepSpeedEngine:
         self._analysis_enabled = (self._config.analysis_present
                                   and self._config.analysis.enabled)
         self._analysis_graph_done = False
+        self._analysis_xray_done = False
         self._analysis_batch_shapes = None
         self._collective_fingerprint = None
         if self._analysis_enabled:
@@ -1219,7 +1220,13 @@ class DeepSpeedEngine:
                 donate_argnums=(0,), mesh=self.mesh,
                 in_shardings=(self.state_shardings, batch_sh),
                 out_shardings=(self.state_shardings,
-                               self.sharding.replicated()))
+                               self.sharding.replicated()),
+                # xray promise-vs-actual: arg 0 is the TrainState whose
+                # families (params/master/opt_state) the ZeRO stage promises
+                # partitioned — TrainState is a NamedTuple, so tree paths
+                # are indices and the field names ride the meta
+                meta={"state_argnum": 0,
+                      "state_fields": list(TrainState._fields)})
         return self._compiled_train_batch[key]
 
     # ------------------------------------------------- 1-bit optimizer path
@@ -1315,7 +1322,9 @@ class DeepSpeedEngine:
                 donate_argnums=(0,), mesh=self.mesh,
                 in_shardings=(self.state_shardings, batch_sh),
                 out_shardings=(self.state_shardings,
-                               self.sharding.replicated()))
+                               self.sharding.replicated()),
+                meta={"state_argnum": 0,
+                      "state_fields": list(TrainState._fields)})
         return self._compiled_train_batch[key]
 
     # --------------------------------------------------- NVMe-offload stepping
@@ -1491,6 +1500,16 @@ class DeepSpeedEngine:
         if inj is not None and inj.targets("train_step"):
             inj.before("train_step", f"step={getattr(self, '_host_step', 0) + 1}")
         loss = self._train_batch_instrumented(batch, gas)
+        if self._analysis_enabled and not self._analysis_xray_done and \
+                "xray" in (self._config.analysis.passes or ()):
+            # post-GSPMD x-ray AFTER the first step: the program table now
+            # holds compiled programs with captured abstract args. Opt-in
+            # by naming the pass — each analyzed program costs one AOT
+            # compile (same path as aot_memory_analysis), not a trace.
+            self._analysis_xray_done = True
+            from deepspeed_tpu.analysis.xray import engine_xray_analysis
+
+            engine_xray_analysis(self)
         if self._consistency_interval and \
                 self._host_step % self._consistency_interval == 0:
             from deepspeed_tpu.resilience.consistency import \

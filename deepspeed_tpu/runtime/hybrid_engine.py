@@ -114,7 +114,8 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 gen, label=f"hybrid/generate[new={max_new_tokens}]",
                 donate_argnums=(), mesh=self.mesh,
                 in_shardings=(self.state_shardings.params, ids_sh, repl, repl),
-                out_shardings=ids_sh)
+                out_shardings=ids_sh,
+                meta={"params_argnum": 0})
         rng = jax.random.PRNGKey(self._host_rng_seed() if seed is None else seed)
         t0 = time.perf_counter()
         with self.mesh:

@@ -27,6 +27,7 @@ import dataclasses
 import inspect
 import os
 import threading
+import weakref
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -50,7 +51,15 @@ INHERIT = _Inherit()
 
 @dataclasses.dataclass
 class ProgramRecord:
-    """One engine-compiled program's sharding contract."""
+    """One engine-compiled program's sharding contract.
+
+    Beyond the human-readable table row, the record keeps what the
+    post-GSPMD analyzer (``deepspeed_tpu.analysis.xray``) needs to AOT
+    re-lower the program WITHOUT an engine in hand: the jitted callable,
+    the resolved promise trees, and — captured at the first real
+    dispatch — abstract argument shapes carrying each COMMITTED
+    operand's sharding (so an INHERIT program re-lowers against the
+    same placements it actually compiled with)."""
 
     label: str
     call_site: str
@@ -61,6 +70,29 @@ class ProgramRecord:
     inherited_in: bool          # whole-argument INHERIT appeared in inputs
     inherited_out: bool
     generation: int = 0         # global-mesh generation at compile wrap time
+    # --- post-GSPMD analysis hooks (xray) -------------------------------
+    mesh: Any = None            # the Mesh object programs lower under
+    in_shardings: Any = None    # resolved promise tree (INHERIT -> None)
+    out_shardings: Any = None
+    meta: Optional[Dict[str, Any]] = None   # call-site tags (state_argnum …)
+    # WEAK reference to the jax.jit callable (the engine's _ShardedProgram
+    # proxy holds the strong one): the process-global table must not pin a
+    # dead engine — the jitted step closes over the engine and its whole
+    # TrainState, and value-parameterized labels (generate[new=N]) would
+    # otherwise accumulate one pinned engine per N for process lifetime
+    jitted_ref: Any = None      # callable -> jitted | None
+    abstract_args: Optional[Tuple] = None   # captured at first dispatch
+    abstract_kwargs: Optional[Dict[str, Any]] = None
+
+    @property
+    def jitted(self):
+        """The underlying jitted callable, or None once its program (and
+        engine) have been garbage-collected."""
+        return self.jitted_ref() if self.jitted_ref is not None else None
+
+    def can_lower(self) -> bool:
+        """True while a dispatch-captured, re-lowerable program is alive."""
+        return self.jitted is not None and self.abstract_args is not None
 
 
 _LOCK = threading.Lock()
@@ -141,10 +173,69 @@ def _caller_site() -> str:
         del frame
 
 
+def _abstract_leaf(x):
+    """A leaf's re-lowerable stand-in: array-likes become
+    ShapeDtypeStructs (keeping a COMMITTED jax.Array's sharding — the
+    placement jit actually inherited), everything else (static values,
+    Python scalars) passes through unchanged."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return x
+    sharding = None
+    if getattr(x, "_committed", False):
+        sharding = getattr(x, "sharding", None)
+    try:
+        if sharding is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sharding)
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+    except Exception:
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+class _ShardedProgram:
+    """Thin dispatch proxy around the jitted callable: forwards every
+    call/attribute untouched, and on the FIRST call snapshots the
+    arguments' abstract shapes (+ committed shardings) into the program
+    record — that snapshot is what lets ``ds_doctor xray`` AOT
+    lower+compile the exact program later, with no engine in hand.
+    Snapshot cost is paid once; afterwards ``__call__`` is one flag
+    check on top of the pjit fast path."""
+
+    __slots__ = ("_jitted", "program_record", "_captured")
+
+    def __init__(self, jitted, record: ProgramRecord):
+        self._jitted = jitted
+        self.program_record = record
+        self._captured = False
+
+    def _capture(self, args, kwargs):
+        self._captured = True
+        rec = self.program_record
+        try:
+            rec.abstract_args = tuple(
+                jax.tree.map(_abstract_leaf, a) for a in args)
+            rec.abstract_kwargs = {k: jax.tree.map(_abstract_leaf, v)
+                                   for k, v in kwargs.items()}
+        except Exception:
+            rec.abstract_args = rec.abstract_kwargs = None
+
+    def __call__(self, *args, **kwargs):
+        if not self._captured:
+            self._capture(args, kwargs)
+        return self._jitted(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+    def __repr__(self):
+        return f"<sharded_jit {self.program_record.label!r}>"
+
+
 def sharded_jit(fn, *, label: str, in_shardings, out_shardings,
                 donate_argnums: Tuple[int, ...],
                 static_argnums=None, static_argnames=None,
-                mesh=None):
+                mesh=None, meta: Optional[Dict[str, Any]] = None):
     """``jax.jit`` with the sharding contract stated and recorded.
 
     Args:
@@ -157,6 +248,9 @@ def sharded_jit(fn, *, label: str, in_shardings, out_shardings,
         down rather than defaulted.
       mesh: records the mesh identity in the table (defaults to the
         process-global mesh at wrap time).
+      meta: optional call-site tags for the post-GSPMD analyzer (e.g.
+        ``{"state_argnum": 0}`` marks which argument is the TrainState
+        whose families the xray promise-vs-actual pass audits).
     """
     if not label:
         raise ValueError("sharded_jit: a non-empty program label is required")
@@ -180,7 +274,10 @@ def sharded_jit(fn, *, label: str, in_shardings, out_shardings,
         donate=tuple(donate_argnums),
         inherited_in=in_inh or isinstance(in_shardings, _Inherit),
         inherited_out=out_inh or isinstance(out_shardings, _Inherit),
-        generation=mesh_generation())
+        generation=mesh_generation(),
+        mesh=mesh if mesh is not None else global_mesh(),
+        in_shardings=in_resolved, out_shardings=out_resolved,
+        meta=dict(meta) if meta else None)
     with _LOCK:
         _PROGRAMS[label] = record
 
@@ -198,7 +295,11 @@ def sharded_jit(fn, *, label: str, in_shardings, out_shardings,
         jitted.program_record = record   # introspection hook (ds_report/tests)
     except (AttributeError, TypeError):
         pass
-    return jitted
+    try:
+        record.jitted_ref = weakref.ref(jitted)
+    except TypeError:
+        record.jitted_ref = (lambda j=jitted: j)   # unlikely; stay analyzable
+    return _ShardedProgram(jitted, record)
 
 
 def render_program_table(mesh: Optional[Any] = None) -> str:

@@ -176,6 +176,20 @@ if "--overlap" in sys.argv[1:]:
         sys.exit("bench.py: --overlap requires a mode "
                  "(overlapped|serial|off)")
     os.environ["BENCH_OVERLAP"] = sys.argv[_i + 1]
+# --wire MODE (or BENCH_WIRE): add the `wire` ds_config block (ds_wire —
+# qwZ/hpZ/qgZ wire-speed ZeRO collectives) to every engine-backed line.
+# "off" arms NOTHING but still applies the same intra-host mesh factoring
+# (tpu.ici) as the quantized modes, so the on/off pair shares one
+# mesh_axes identity and `ds_perf diff/gate --metric static_comm_bytes`
+# compares them — the wire knob itself is stamped into the metric string,
+# config, fingerprint and the entry's `wire_mode`. Unset = no block AND
+# no factoring (strict no-op). BENCH_WIRE_ICI overrides the auto host
+# split (default: half the devices on a single-process simulated mesh).
+if "--wire" in sys.argv[1:]:
+    _i = sys.argv[1:].index("--wire") + 1
+    if _i + 1 >= len(sys.argv):
+        sys.exit("bench.py: --wire requires a mode (off|qwz|qwz+hpz|full)")
+    os.environ["BENCH_WIRE"] = sys.argv[_i + 1]
 
 import jax
 import numpy as np
@@ -418,6 +432,41 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
             raise ValueError(f"BENCH_OVERLAP={overlap_mode!r} not in "
                              "('overlapped', 'serial', 'off')")
         ds_config["overlap"] = {"schedule": overlap_mode}
+    wire_mode = os.environ.get("BENCH_WIRE", "")
+    if wire_mode:
+        if wire_mode not in ("off", "qwz", "qwz+hpz", "full"):
+            raise ValueError(f"BENCH_WIRE={wire_mode!r} not in "
+                             "('off', 'qwz', 'qwz+hpz', 'full')")
+        # one mesh identity for the whole on/off pair: every wire mode —
+        # including "off" — factors the data axis into (hosts × ici), so
+        # ds_perf compares entries laid out identically and the xray comm
+        # model can split intra-/inter-host bytes on BOTH sides
+        ici = int(os.environ.get("BENCH_WIRE_ICI", 0)) or (
+            n_dev // 2 if n_dev >= 4 and n_dev % 2 == 0 else 1)
+        if ici > 1:
+            ds_config["tpu"] = {"data": -1, "ici": ici}
+        # EVERY wire mode — including "off" — arms the same overlap
+        # schedule: the quantized gather rides the overlap engine's
+        # prefetched scan, and the off side must compile the SAME
+        # restructured program so the static_comm_bytes delta measures the
+        # quantization alone, not overlap-vs-no-overlap
+        ds_config.setdefault("overlap", {})
+        if wire_mode != "off":
+            wire_block = {"weight_quant_bits": 8}
+            if wire_mode in ("qwz+hpz", "full"):
+                if ici > 1:
+                    wire_block["secondary_partition"] = True
+                    wire_block["secondary_size"] = ici
+                else:
+                    # NO engine-side auto-factoring either: the off side
+                    # runs flat, so hpZ must not silently change the mesh
+                    # identity of the pair — it just degrades to qwZ here
+                    print(f"# wire={wire_mode}: no intra-host split at "
+                          f"{n_dev} device(s) (BENCH_WIRE_ICI) — hpZ "
+                          "inactive, running qwZ only", file=sys.stderr)
+            if wire_mode == "full":
+                wire_block["grad_quant_bits"] = 4
+            ds_config["wire"] = wire_block
     if gas > 1:
         # bf16 accumulator: gas>1 must not add a resident fp32 grad tree on
         # top of the full optimizer state (16G HBM budget)
@@ -485,9 +534,10 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     final_loss = float(loss)
     off_tag = f", offload={offload}" if offload != "none" else ""
     ov_tag = f", overlap={overlap_mode}" if overlap_mode else ""
+    wire_tag = f", wire={wire_mode}" if wire_mode else ""
     line = {
         "metric": f"{model_name} pretrain MFU (bs={per_chip_bs}/chip, seq={seq}, "
-                  f"{n_dev} chip(s), gas={gas}{off_tag}{ov_tag}, "
+                  f"{n_dev} chip(s), gas={gas}{off_tag}{ov_tag}{wire_tag}, "
                   f"tok/s/chip={tok_per_sec_chip:.0f}, "
                   f"TFLOPs/chip={achieved/1e12:.1f}, loss={final_loss:.3f})",
         "value": round(mfu, 4),
@@ -508,6 +558,7 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
                         "steps": steps, "batch_size": batch_size,
                         "n_head": config.n_head,
                         "overlap": overlap_mode or None,
+                        "wire": wire_mode or None,
                         "flash_block": getattr(config, "flash_block", None)},
                 extra={"vs_baseline": line["vs_baseline"],
                        "tok_per_sec_chip": round(tok_per_sec_chip, 1),

@@ -63,12 +63,31 @@ class QuantizedTensor:
                 f"dtype={self.dtype})")
 
 
+def quant_group_layout(n_in: int, group_size: int):
+    """(group_size, n_groups, padded_in) for an ``n_in``-row contraction dim.
+
+    A group size the dim does not divide PADS the dim up to the next group
+    boundary instead of silently collapsing to one whole-dim group (the
+    old behavior): the padded rows are what actually crosses the wire in a
+    quantized gather, so ``QuantizedTensor.nbytes`` — the number
+    ``static_comm_bytes`` bills — must account them (pinned by
+    tests/unit/test_wire.py). ``group_size`` ≥ the dim still means one
+    group (nothing to pad against)."""
+    if group_size <= 0 or group_size >= n_in:
+        return n_in, 1, n_in
+    padded = ((n_in + group_size - 1) // group_size) * group_size
+    return group_size, padded // group_size, padded
+
+
 def _group_reshape(w, group_size: int):
-    """(..., in, out) → (..., n_groups, group_size, out)."""
+    """(..., in, out) → (..., n_groups, group_size, out), zero-padding the
+    ``in`` dim up to a group boundary when needed (see
+    :func:`quant_group_layout`)."""
     *lead, n_in, n_out = w.shape
-    if group_size <= 0 or group_size > n_in or n_in % group_size:
-        group_size = n_in
-    return w.reshape(*lead, n_in // group_size, group_size, n_out), group_size
+    group_size, _, padded = quant_group_layout(n_in, group_size)
+    if padded != n_in:
+        w = jnp.pad(w, [(0, 0)] * len(lead) + [(0, padded - n_in), (0, 0)])
+    return w.reshape(*lead, padded // group_size, group_size, n_out), group_size
 
 
 def quantize_tensor(w, num_bits: int = 8, group_size: int = 128,
@@ -80,6 +99,11 @@ def quantize_tensor(w, num_bits: int = 8, group_size: int = 128,
     Asymmetric mode stores a per-group zero-point instead of centering at 0.
     """
     assert num_bits in (8, 4), num_bits
+    if num_bits == 4 and group_size % 2:
+        # nibble packing pairs rows within a group: round an odd group up
+        # (the pre-padding code collapsed such sizes to one whole-dim
+        # group; with padded groups the even neighbor keeps them working)
+        group_size += 1
     orig_dtype = w.dtype
     orig_shape = tuple(int(s) for s in w.shape)
     if w.ndim == 1:
@@ -128,6 +152,12 @@ def dequantize_tensor(leaf: "QuantizedTensor", dtype=None):
     if leaf.zero is not None:
         w = w + leaf.zero[..., None, :]
     out_dtype = dtype or jnp.dtype(leaf.dtype)
+    # collapse (G, gs) back to the (possibly padded) contraction dim, then
+    # strip the group padding off before restoring the original shape
+    n_in = leaf.shape[-2] if len(leaf.shape) >= 2 else leaf.shape[0]
+    w = w.reshape(*w.shape[:-3], w.shape[-3] * w.shape[-2], w.shape[-1])
+    if w.shape[-2] != n_in:
+        w = jax.lax.slice_in_dim(w, 0, n_in, axis=w.ndim - 2)
     return w.reshape(leaf.shape).astype(out_dtype)
 
 

@@ -41,7 +41,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS,
-                                             MICS_AXIS, SEQ_AXIS, TENSOR_AXIS)
+                                             ICI_AXIS, MICS_AXIS, SEQ_AXIS,
+                                             TENSOR_AXIS)
 from deepspeed_tpu.utils import shard_map_compat
 
 NEG_INF = -1e30
@@ -55,7 +56,7 @@ def _qkv_spec(mesh, seq_axis: str, n_heads: int,
     ways for Ulysses' in-manual head scatter), head_dim whole. Mirrors the
     placement the surrounding GSPMD program already uses, so the manual
     boundary reshards nothing."""
-    batch_axes = tuple(a for a in (DATA_AXIS, MICS_AXIS, EXPERT_AXIS)
+    batch_axes = tuple(a for a in (DATA_AXIS, MICS_AXIS, ICI_AXIS, EXPERT_AXIS)
                        if mesh.shape.get(a, 1) > 1)
     tp = mesh.shape.get(TENSOR_AXIS, 1)
     heads = TENSOR_AXIS if (tp > 1 and n_heads % (tp * head_groups) == 0) \

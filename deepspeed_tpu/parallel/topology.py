@@ -35,13 +35,23 @@ DATA_AXIS = "data"
 # immediately inside 'data' puts each shard group on contiguous ICI
 # neighbors — the hierarchical intra-node gather MiCS hand-codes.
 MICS_AXIS = "mics"
+# Intra-host sub-axis of the data-parallel world (ds_wire hpZ, ZeRO++ §4):
+# when wire.secondary_partition is set, the data axis is factored into
+# (DATA_AXIS = inter-host groups, ICI_AXIS = devices within a host), so a
+# SECONDARY replica of the ZeRO-3 shards can be held partitioned over the
+# fast intra-host links only — the backward regather then never crosses
+# hosts. Placed immediately inside 'data' (like 'mics') so each host group
+# lands on contiguous ICI neighbors. Size 1 (absent) on every topology
+# that does not opt in, so existing meshes are unchanged.
+ICI_AXIS = "ici"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
-ALL_AXES = (PIPE_AXIS, DATA_AXIS, MICS_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+ALL_AXES = (PIPE_AXIS, DATA_AXIS, MICS_AXIS, ICI_AXIS, EXPERT_AXIS, SEQ_AXIS,
+            TENSOR_AXIS)
 
 # Axes over which dense parameters are replicated (ZeRO shards over these).
-DP_AXES = (DATA_AXIS, MICS_AXIS, EXPERT_AXIS)
+DP_AXES = (DATA_AXIS, MICS_AXIS, ICI_AXIS, EXPERT_AXIS)
 
 
 class ProcessTopology:
@@ -127,6 +137,7 @@ def _resolve_mesh_dims(mesh_config, n_devices: int) -> Dict[str, int]:
         PIPE_AXIS: mesh_config.pipe,
         DATA_AXIS: mesh_config.data,
         MICS_AXIS: getattr(mesh_config, "mics", 1),
+        ICI_AXIS: getattr(mesh_config, "ici", 1),
         EXPERT_AXIS: mesh_config.expert,
         SEQ_AXIS: mesh_config.seq,
         TENSOR_AXIS: mesh_config.tensor,
@@ -134,7 +145,7 @@ def _resolve_mesh_dims(mesh_config, n_devices: int) -> Dict[str, int]:
     fixed = int(np.prod([v for v in dims.values() if v != -1]))
     if dims[DATA_AXIS] == -1:
         if n_devices % fixed != 0:
-            raise ValueError(f"device count {n_devices} not divisible by pipe*mics*expert*seq*tensor={fixed}")
+            raise ValueError(f"device count {n_devices} not divisible by pipe*mics*ici*expert*seq*tensor={fixed}")
         dims[DATA_AXIS] = n_devices // fixed
     total = int(np.prod(list(dims.values())))
     if total != n_devices:

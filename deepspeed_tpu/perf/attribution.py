@@ -194,6 +194,8 @@ def collect(engine, session=None, timed_steps: Optional[int] = None,
                 att["static_comm_bytes"] = int(sc["static_comm_bytes"])
                 att["static_comm"] = {
                     "by_kind": sc["by_kind"],
+                    "inter_gather_scatter_bytes":
+                        sc.get("inter_gather_scatter_bytes"),
                     "collectives": sc["collectives"],
                     "est_bus_us": sc["est_bus_us"],
                     "program": sc.get("program"),

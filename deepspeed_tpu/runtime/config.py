@@ -270,6 +270,10 @@ class TPUMeshConfig(DeepSpeedConfigModel):
     # the data axis into (data=replica groups, mics=shard) from
     # zero_optimization.mics_shard_size (reference zero/mics.py:31)
     mics: int = Field(1, ge=1)
+    # ds_wire intra-host sub-axis (ZeRO++ hpZ); normally not set by hand —
+    # engine init factors the data axis into (data=inter-host groups,
+    # ici=devices per host) from wire.secondary_partition/secondary_size
+    ici: int = Field(1, ge=1)
     expert: int = Field(1, ge=1)
     seq: int = Field(1, ge=1)
     tensor: int = Field(1, ge=1)
@@ -651,6 +655,37 @@ class OverlapConfig(DeepSpeedConfigModel):
         return v
 
 
+class WireConfig(DeepSpeedConfigModel):
+    """ds_wire — wire-speed ZeRO collectives (runtime/wire.py): the three
+    ZeRO++-style rewrites (qwZ quantized weight all-gather, hpZ secondary
+    intra-host partition, qgZ hierarchical quantized gradient exchange —
+    PAPERS.md: ZeRO++, EQuARX) expressed as sharding-spec-level transforms
+    the overlap engine's prefetched layer scan schedules. Every knob is a
+    per-collective accuracy-vs-bandwidth trade; the delta is provable
+    hardware-free — each on/off pair lands as two perf-ledger entries whose
+    ``static_comm_bytes`` (by collective kind, intra-/inter-host split on
+    ``ici``-factored meshes) ``ds_perf gate --metric static_comm_bytes``
+    enforces. STRICT no-op when the block is absent: the wire module is
+    never imported, the overlap scan and the lowered HLO are byte-identical
+    (asserted in tests/unit/test_wire.py — same contract as ``overlap``/
+    ``goodput``/``rewind``). See docs/CONFIG.md 'wire' section and the
+    README "Shrinking the wire" walkthrough."""
+    enabled: bool = Field(True, description="arm the wire engine (the block being present opts in; set false to keep the block but skip the work)")
+    weight_quant_bits: int = Field(8, description="qwZ: bits of the block-quantized ZeRO-3 weight all-gather (8 = int8 codes, 4 = packed int4, 0 = full-width bf16 gather); active at ZeRO stage 3 with the overlap block armed — the gather moves codes + per-group f32 scales instead of bf16")
+    grad_quant_bits: int = Field(0, description="qgZ: bits of the hierarchical quantized gradient exchange (4/8; 0 = off). Owns the grad sync on the stage-0 pure-DP shard-mapped step (adam/adamw) with error-feedback residuals riding the optimizer state; at ZeRO stage >= 1 the grad reduce is GSPMD-inserted and this knob is loudly inert (a 1-bit optimizer alongside it is refused — both would own the exchange)")
+    secondary_partition: bool = Field(False, description="hpZ: hold a secondary QUANTIZED replica of the ZeRO-3 shards partitioned over the intra-host 'ici' sub-axis only, so every per-layer gather (and the backward regather walk) stays on the fast intra-host links — one small inter-host code gather per step rebuilds the replica; costs its resident codes (params/ici bytes per device)")
+    secondary_size: int = Field(0, ge=0, description="devices per host group for the hpZ factoring (the 'ici' sub-axis size); 0 = auto: the real per-host device count on multi-process runs, half the data axis on a single-process simulated mesh; must divide the data axis")
+    group_size: int = Field(64, gt=0, description="quantization group length (rows sharing one f32 scale) for qwZ codes and qgZ chunks; smaller = tighter error, more scale overhead on the wire (f32/group)")
+
+    @field_validator("weight_quant_bits", "grad_quant_bits")
+    @classmethod
+    def _bits_known(cls, v):
+        if v not in (0, 4, 8):
+            raise ValueError(f"wire quant bits must be 0 (off), 4 or 8, "
+                             f"got {v}")
+        return v
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Fault-tolerant serving front-end (deepspeed_tpu/serving/ +
     ``bin/ds_serve``): a request-lifecycle manager around the inference
@@ -788,6 +823,10 @@ class DeepSpeedConfig:
         # byte-identical, checkpoint path untouched)
         self.overlap = OverlapConfig(**pd.get("overlap", {}))
         self.overlap_present = "overlap" in pd
+        # presence matters, same contract again: no block, no wire module
+        # (never imported; the overlap scan and lowered HLO byte-identical)
+        self.wire = WireConfig(**pd.get("wire", {}))
+        self.wire_present = "wire" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -855,7 +894,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "rewind", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wire", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

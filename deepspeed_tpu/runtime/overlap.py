@@ -170,7 +170,8 @@ class StackedGatherPlan:
     against scan ``xs`` elements at trace time by treedef + leaf shapes."""
 
     def __init__(self, plan: ShardingPlan, shapes_subtree: Any,
-                 specs_subtree: Any, grad_reduce: str, remat_gather: bool):
+                 specs_subtree: Any, grad_reduce: str, remat_gather: bool,
+                 wire=None):
         self.mesh = plan.mesh
         self.dp_axes = tuple(plan.dp_axes)
         self.grad_reduce = grad_reduce
@@ -190,6 +191,16 @@ class StackedGatherPlan:
                 self.slice_specs.append(None)
             else:
                 self.slice_specs.append((gathered, sharded))
+        # ds_wire (runtime/wire.py): per-leaf quantized-gather plans — the
+        # qwZ/hpZ drop-in for the gather below. None entries (or no wire
+        # engine at all) keep the full-width path byte-identical.
+        self.wire = wire if wire is not None and \
+            getattr(wire, "weight_active", False) else None
+        self.wire_leaves = (self.wire.plan_stacked(leaves, self.slice_specs)
+                            if self.wire is not None else None)
+        self.secondary = bool(self.wire is not None and self.wire.secondary
+                              and any(lw is not None and lw.sec_q is not None
+                                      for lw in self.wire_leaves))
 
     @property
     def active(self) -> bool:
@@ -232,26 +243,85 @@ class StackedGatherPlan:
         gather.defvjp(fwd, bwd)
         return gather(x)
 
-    def gather_slice(self, sliced_element: Any) -> Any:
+    def gather_slice(self, sliced_element: Any, sec_slices=None) -> Any:
         """Gather one layer's slice of the stacked subtree (leaves without
-        dp sharding pass through untouched)."""
+        dp sharding pass through untouched). With a wire plan, eligible
+        leaves gather QUANTIZED (codes + scales on the wire; from the hpZ
+        secondary replica's slice when one is held) — the quantized op
+        identity is recorded distinctly so the PR 4 collective fingerprints
+        hash it stably."""
         from jax.ad_checkpoint import checkpoint_name
 
         from deepspeed_tpu.comm import comm as _comm
 
         leaves = self.treedef.flatten_up_to(sliced_element)
         out = []
-        for leaf, specs, stacked in zip(leaves, self.slice_specs,
-                                        self.stacked_shapes):
+        for i, (leaf, specs, stacked) in enumerate(
+                zip(leaves, self.slice_specs, self.stacked_shapes)):
             if specs is None:
                 out.append(leaf)
                 continue
             gathered, sharded = specs
-            _comm.record_engine_collective(
-                "zero3_gather", stacked[1:], getattr(leaf, "dtype", "?"),
-                self.dp_axes)
-            g = self._gather_leaf(leaf, gathered, sharded)
+            lw = self.wire_leaves[i] if self.wire_leaves is not None else None
+            if lw is not None:
+                sec_qt = sec_slices[i] if sec_slices is not None else None
+                op = (f"zero3_gather[q{lw.bits}"
+                      + ("/sec]" if sec_qt is not None else "]"))
+                axes = (("ici",) if sec_qt is not None else self.dp_axes)
+                _comm.record_engine_collective(
+                    op, stacked[1:], getattr(leaf, "dtype", "?"), axes)
+                g = lw.gather(leaf, sec_qt, self.grad_reduce)
+            else:
+                _comm.record_engine_collective(
+                    "zero3_gather", stacked[1:], getattr(leaf, "dtype", "?"),
+                    self.dp_axes)
+                g = self._gather_leaf(leaf, gathered, sharded)
             out.append(checkpoint_name(g, _GATHERED_NAME))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    # ------------------------------------------------- hpZ secondary replica
+    def build_secondary(self, element: Any):
+        """The per-step secondary replica of one matched stacked element:
+        a list (aligned with the flattened leaves) of stacked
+        QuantizedTensors constrained to the intra-host `secondary` specs —
+        ONE inter-host code gather for the whole stack — or None entries
+        for leaves that keep the full-width path."""
+        from deepspeed_tpu.comm import comm as _comm
+
+        leaves = self.treedef.flatten_up_to(element)
+        out = []
+        for leaf, lw, stacked in zip(leaves, self.wire_leaves,
+                                     self.stacked_shapes):
+            if lw is None or lw.sec_q is None:
+                out.append(None)
+                continue
+            _comm.record_engine_collective(
+                f"hpz_secondary[q{lw.bits}]", stacked,
+                getattr(leaf, "dtype", "?"), self.dp_axes)
+            out.append(lw.quantize_stacked(leaf))
+        return out
+
+    def slice_secondary(self, sec, i):
+        """Layer ``i``'s slices of a build_secondary() result."""
+        if sec is None:
+            return None
+        return [lw.slice_qt(qt, i) if qt is not None else None
+                for lw, qt in zip(self.wire_leaves, sec)]
+
+    def constrain_gathered(self, element: Any) -> Any:
+        """Re-pin a gathered slice's wired leaves at the GATHERED placement
+        (applied to the ring-carry slot right before the body consumes it):
+        without the anchor at the use site, GSPMD may store the carry/
+        residuals sharded and re-gather the weight at the matmul — at full
+        width, unwinding the quantized gather's entire wire win."""
+        if self.wire_leaves is None:
+            return element
+        import jax.lax as lax
+
+        leaves = self.treedef.flatten_up_to(element)
+        out = [lax.with_sharding_constraint(leaf, lw.gathered_leaf)
+               if lw is not None else leaf
+               for leaf, lw in zip(leaves, self.wire_leaves)]
         return jax.tree_util.tree_unflatten(self.treedef, out)
 
 
@@ -267,7 +337,8 @@ def find_stacked_plan(engine, cfg) -> Optional[StackedGatherPlan]:
         return None
     sp = StackedGatherPlan(engine.plan, shapes[key], specs[key],
                            grad_reduce=cfg.grad_reduce,
-                           remat_gather=cfg.remat_gather)
+                           remat_gather=cfg.remat_gather,
+                           wire=getattr(engine, "_wire", None))
     return sp if sp.active else None
 
 
@@ -296,13 +367,36 @@ def prefetched_layer_scan(body, init, xs, unroll: int,
         return jax.lax.scan(body, init, xs, unroll=max(1, int(unroll)))
     depth = max(1, min(int(depth), max(1, length - 1)))
 
-    def slice_at(i):
+    # ds_wire hpZ: the secondary quantized replica of each matched stacked
+    # element, built ONCE per step (one inter-host code gather); per-layer
+    # gathers — forward and the remat-replayed backward regather, whose
+    # inputs these slices become — then stay on the intra-host axis.
+    secondary = None
+    if getattr(stacked, "secondary", False):
+        secondary = [stacked.build_secondary(e) if m else None
+                     for e, m in zip(elements, matched)]
+
+    def slice_prim(i):
         return tuple(jax.tree.map(
             lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False), e)
             for e in elements)
 
-    raw_gather = lambda sl: tuple(
-        stacked.gather_slice(e) if m else e for e, m in zip(sl, matched))
+    if secondary is None:
+        slice_at = slice_prim
+        raw_gather = lambda sl: tuple(
+            stacked.gather_slice(e) if m else e for e, m in zip(sl, matched))
+    else:
+        def slice_at(i):
+            return (slice_prim(i),
+                    tuple(stacked.slice_secondary(s, i) if s is not None
+                          else None for s in secondary))
+
+        def raw_gather(sl):
+            prim, secs = sl
+            return tuple(
+                stacked.gather_slice(e, sec_slices=secs[j]) if m else e
+                for j, (e, m) in enumerate(zip(prim, matched)))
+
     if stacked.remat_gather:
         gather = jax.checkpoint(
             raw_gather, policy=jax.checkpoint_policies.nothing_saveable)
@@ -317,7 +411,11 @@ def prefetched_layer_scan(body, init, xs, unroll: int,
     def loop(carry, i):
         c, ring = carry
         nxt = gather(slice_at(jnp.minimum(i + depth, length - 1)))
-        new_c, y = body(c, rewrap(ring[0]))
+        head = ring[0]
+        if secondary is not None or stacked.wire_leaves is not None:
+            head = tuple(stacked.constrain_gathered(e) if m else e
+                         for e, m in zip(head, matched))
+        new_c, y = body(c, rewrap(head))
         return (new_c, ring[1:] + (nxt,)), y
 
     (final, _), ys = jax.lax.scan(loop, (init, buf), jnp.arange(length),
@@ -470,9 +568,28 @@ class OverlapEngine:
         if self._gather_compiled is None:
             from deepspeed_tpu.sharding import sharded_jit
 
-            self._gather_bytes = self._gather_phase_bytes()
+            wire = getattr(eng, "_wire", None)
+            if wire is not None and wire.weight_active:
+                # ds_wire qwZ on the measured serial schedule: the explicit
+                # gather phase moves codes + scales, and the timed comm
+                # span bills the actual (padded) wire bytes — the chaos
+                # `collective` delay drill inflates the same span
+                leaf_fn, self._gather_bytes = wire.serial_gather(
+                    eng.plan._master_shapes, eng.plan.param_specs,
+                    eng.plan.dp_axes)
+
+                def gather_fn(p):
+                    leaves, tdef = jax.tree_util.tree_flatten(p)
+                    return tdef.unflatten(
+                        [leaf_fn(i, x) for i, x in enumerate(leaves)])
+
+                label = "overlap/zero3_gather_q"
+            else:
+                gather_fn = lambda p: p
+                label = "overlap/zero3_gather"
+                self._gather_bytes = self._gather_phase_bytes()
             self._gather_compiled = sharded_jit(
-                lambda p: p, label="overlap/zero3_gather",
+                gather_fn, label=label,
                 donate_argnums=(), mesh=eng.mesh,
                 in_shardings=(eng.state_shardings.params,),
                 out_shardings=self._gathered_shardings())

@@ -1,0 +1,779 @@
+"""ds_wire — wire-speed ZeRO collectives (qwZ / hpZ / qgZ).
+
+PR 9 hides the ZeRO collectives behind compute and ds_xray prices their
+wire bytes off the compiled HLO; this module makes the bytes themselves
+smaller, the three ZeRO++-style rewrites (PAPERS.md: ZeRO++, EQuARX)
+expressed as sharding-spec-level transforms the existing machinery
+schedules:
+
+* **qwZ** — block-quantized weight all-gather: the per-layer ZeRO-3
+  gather inside :class:`~deepspeed_tpu.runtime.overlap.StackedGatherPlan`
+  moves int8 (or packed-int4) codes plus per-group f32 scales instead of
+  full-width bf16. Expressed as ``quantize → with_sharding_constraint(the
+  QuantizedTensor children, gathered specs) → dequantize`` so GSPMD
+  inserts the all-gather ON THE CODES; a ``custom_vjp`` makes the whole
+  chain a straight-through gather whose backward still reduce-scatters
+  the cotangent sharded — the quantized gather rides the same
+  double-buffered prefetch carry, remat policy and per-block grad
+  reduce as the full-width one.
+* **hpZ** — secondary intra-host partition: a second, QUANTIZED replica
+  of the stacked ZeRO-3 shards is laid out over the mesh's ``ici``
+  sub-axis only (replicated across hosts; the registry's ``secondary``
+  spec family), built once per step from the primary shards — one small
+  inter-host code gather for the whole stack — after which every
+  per-layer gather (the forward's and the backward's regather, which
+  ``remat_gather`` replays from the saved secondary slices) is an
+  intra-host collective that never crosses the slow link. This lands
+  PR 9's open remainder: the backward regather walk reads from the fast
+  axis.
+* **qgZ** — hierarchical quantized gradient exchange, generalizing
+  ``runtime/comm/compressed.py``'s 1-bit chunk/pack pattern to int4/int8
+  with per-group scales and error-feedback residuals: intra-host
+  all-to-all + full-precision local reduce, then a QUANTIZED inter-host
+  exchange, then the gather back — :func:`hierarchical_quantized_allreduce`
+  is a pure shard_map-callable function, and :class:`QGZAdam` plugs it
+  into the engine's existing shard-mapped (1-bit-protocol) step so the
+  residuals ride the optimizer state (checkpointed, dp-sharded). The
+  GSPMD-inserted grad reduce of the ZeRO≥1 stages cannot be re-routed
+  through it on this jax (the partitioner resolves the cotangent's
+  pending sum at full width before any nonlinear op), so
+  ``grad_quant_bits`` arms the stage-0 pure-DP path and is loudly inert
+  elsewhere — the ds_doctor ``wire`` cross-field lints say exactly this.
+
+STRICT no-op contract: this module is imported only when the ``wire``
+ds_config block is present and enabled; without it the engine, the
+overlap scan and the lowered HLO are byte-identical (asserted in
+tests/unit/test_wire.py — same bar as ``overlap``/``goodput``/``rewind``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.ops.quantizer import (QuantizedTensor, dequantize_tensor,
+                                         quant_group_layout, quantize_tensor)
+from deepspeed_tpu.parallel.topology import DATA_AXIS, ICI_AXIS
+from deepspeed_tpu.runtime.zero.partition import _axes_of, _spec_tuple
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+__all__ = ["WireEngine", "LeafWire", "secondary_spec",
+           "hierarchical_quantized_allreduce", "qgz_state_shapes", "QGZAdam"]
+
+
+# ---------------------------------------------------------------------------
+# spec surgery: PartitionSpecs for a QuantizedTensor's children
+# ---------------------------------------------------------------------------
+def _axes_size(mesh, axes) -> int:
+    return int(np.prod([mesh.shape.get(a, 1) for a in axes] or [1]))
+
+
+def _drop_dp(entry, dp_axes):
+    axes = tuple(a for a in _axes_of(entry) if a not in dp_axes)
+    return axes[0] if len(axes) == 1 else (axes if axes else None)
+
+
+def secondary_spec(spec: Optional[P], ndim: int, dp_axes) -> P:
+    """The hpZ twin of a ZeRO-sharded spec: the dp axes on each dim are
+    replaced by the intra-host ``ici`` sub-axis alone — sharded within a
+    host, replicated across hosts (the registry's ``secondary`` family)."""
+    out = []
+    for entry in _spec_tuple(spec, ndim):
+        axes = _axes_of(entry)
+        if any(a in dp_axes for a in axes):
+            axes = tuple(a for a in axes if a not in dp_axes) + (ICI_AXIS,)
+        out.append(axes[0] if len(axes) == 1 else (axes if axes else None))
+    return P(*out)
+
+
+@dataclasses.dataclass
+class LeafWire:
+    """One stacked leaf's quantized-gather plan: the group layout plus the
+    NamedShardings of the QuantizedTensor children at each placement."""
+
+    bits: int
+    gs: int
+    view_shape: Tuple[int, ...]          # >=2-D view the quantizer sees
+    slice_shape: Tuple[int, ...]         # the real per-layer slice shape
+    g_q: NamedSharding                   # codes, gathered
+    g_s: NamedSharding                   # scales, gathered
+    s_q: NamedSharding                   # codes, ZeRO-sharded (the pin that
+    s_s: NamedSharding                   #   forces the AG onto the CODES —
+    #   without it GSPMD may gather the input and recompute the quantize)
+    sec_q: Optional[NamedSharding]       # codes, secondary (stacked, dim0=L)
+    sec_s: Optional[NamedSharding]
+    sharded_leaf: NamedSharding          # the full slice's ZeRO placement
+    gathered_leaf: NamedSharding         # the dequantized value's placement
+    #   (the final anchor — without it GSPMD re-shards the dequantized
+    #   weight and pays a full-width gather again at the matmul)
+    wire_nbytes: int                     # codes+scales bytes of one gather
+
+    # ------------------------------------------------------------- builders
+    def _stacked(self, sh: NamedSharding) -> NamedSharding:
+        return NamedSharding(sh.mesh, P(None, *sh.spec))
+
+    def quantize_stacked(self, stacked_leaf):
+        """The hpZ secondary replica of a stacked leaf: quantize AT the
+        ZeRO-sharded placement, constrain the codes to the intra-host
+        ``secondary`` placement (ONE inter-host code gather for the whole
+        stack), cut the gradient path — the straight-through estimator
+        routes grads through the primary."""
+        L = stacked_leaf.shape[0]
+        qt = quantize_tensor(stacked_leaf.reshape((L,) + self.view_shape),
+                             num_bits=self.bits, group_size=self.gs)
+        q = lax.with_sharding_constraint(qt.q, self._stacked(self.s_q))
+        s = lax.with_sharding_constraint(qt.scale, self._stacked(self.s_s))
+        qt = QuantizedTensor(
+            qt.num_bits,
+            lax.with_sharding_constraint(q, self.sec_q),
+            lax.with_sharding_constraint(s, self.sec_s),
+            None, qt.shape, qt.dtype)
+        return lax.stop_gradient(qt)
+
+    def slice_qt(self, qt: QuantizedTensor, i) -> QuantizedTensor:
+        idx = lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+        return QuantizedTensor(qt.num_bits, idx(qt.q), idx(qt.scale), None,
+                               self.view_shape, qt.dtype)
+
+    # --------------------------------------------------------------- gather
+    def gathered_qt(self, qt: QuantizedTensor) -> QuantizedTensor:
+        return QuantizedTensor(
+            qt.num_bits,
+            lax.with_sharding_constraint(qt.q, self.g_q),
+            lax.with_sharding_constraint(qt.scale, self.g_s),
+            None, qt.shape, qt.dtype)
+
+    def gather(self, x, sec_qt: Optional[QuantizedTensor], grad_reduce: str):
+        """The drop-in replacement for ``StackedGatherPlan._gather_leaf``:
+        forward gathers CODES (from the secondary replica when hpZ holds
+        one, else quantized from the primary slice), dequantizes, and the
+        straight-through backward lands the cotangent at the sharded
+        layout (grad_reduce="scan") or leaves it gathered ("post") —
+        byte-for-byte the same backward contract as the full-width gather.
+        The secondary slices enter as explicit ``custom_vjp`` ARGUMENTS
+        (zero/float0 cotangents), never as closed-over tracers — a closure
+        would leak out of the remat re-trace."""
+        s_sh = self.sharded_leaf
+        view, out_shape = self.view_shape, self.slice_shape
+        bits, gs = self.bits, self.gs
+        meta = (self.num_bits_shape_dtype(sec_qt)
+                if sec_qt is not None else None)
+
+        @jax.custom_vjp
+        def g(v, sec_q, sec_scale):
+            if sec_q is not None:
+                nb, shape, dt = meta
+                qt = QuantizedTensor(nb, sec_q, sec_scale, None, shape, dt)
+            else:
+                qt = quantize_tensor(v.reshape(view), num_bits=bits,
+                                     group_size=gs)
+                # pin the codes AT the ZeRO-sharded placement before the
+                # gathered constraint: the reshard (the all-gather on the
+                # wire) then provably happens ON THE CODES — without the
+                # pin GSPMD may gather the bf16 input and recompute the
+                # quantize on every device instead
+                qt = QuantizedTensor(
+                    qt.num_bits,
+                    lax.with_sharding_constraint(qt.q, self.s_q),
+                    lax.with_sharding_constraint(qt.scale, self.s_s),
+                    None, qt.shape, qt.dtype)
+            w = dequantize_tensor(self.gathered_qt(qt), dtype=v.dtype)
+            return lax.with_sharding_constraint(w.reshape(out_shape),
+                                                self.gathered_leaf)
+
+        def fwd(v, sec_q, sec_scale):
+            return g(v, sec_q, sec_scale), None
+
+        def bwd(_, ct):
+            if grad_reduce == "scan":
+                ct = lax.with_sharding_constraint(ct, s_sh)
+            if sec_qt is None:
+                return (ct, None, None)
+            # integer operands take float0 cotangents; the (stop-gradient)
+            # scales take zeros — the straight-through path is the primary
+            return (ct, np.zeros(tuple(sec_qt.q.shape), jax.dtypes.float0),
+                    jnp.zeros(sec_qt.scale.shape, sec_qt.scale.dtype))
+
+        g.defvjp(fwd, bwd)
+        if sec_qt is not None:
+            return g(x, sec_qt.q, sec_qt.scale)
+        return g(x, None, None)
+
+    @staticmethod
+    def num_bits_shape_dtype(qt: QuantizedTensor):
+        return (qt.num_bits, qt.shape, qt.dtype)
+
+
+def plan_leaf_wire(mesh, slice_shape, sharded: P, dp_axes, *,
+                   bits: int, group_size: int,
+                   secondary: bool) -> Optional[LeafWire]:
+    """A LeafWire for one dp-sharded slice, or None when the leaf cannot
+    carry the quantized layout (spec not mappable onto the group-split
+    contraction dim, or the group count not divisible by the target
+    axes) — such leaves keep the full-width gather, logged once."""
+    if bits not in (4, 8):
+        return None
+    ndim = len(slice_shape)
+    if ndim == 0 or not all(int(s) > 0 for s in slice_shape):
+        return None
+    entries = _spec_tuple(sharded, ndim)
+    if ndim >= 2:
+        view = tuple(int(s) for s in slice_shape)
+        view_entries = tuple(entries)
+    else:
+        view = (int(slice_shape[0]), 1)
+        view_entries = (entries[0], None)
+    gdim = len(view) - 2
+    gs, n_groups, _padded = quant_group_layout(view[gdim], group_size)
+    if bits == 4 and gs % 2:
+        return None
+
+    def child_entries(es):
+        q = es[:gdim] + (es[gdim], None, es[-1])
+        s = es[:gdim] + (es[gdim], es[-1])
+        return q, s
+
+    q_shape = view[:gdim] + (n_groups, gs // 2 if bits == 4 else gs, view[-1])
+    s_shape = view[:gdim] + (n_groups, view[-1])
+
+    def shardable(shape, es):
+        return all(size % _axes_size(mesh, _axes_of(e)) == 0
+                   for size, e in zip(shape, es))
+
+    g_entries = tuple(_drop_dp(e, dp_axes) for e in view_entries)
+    gq_e, gs_e = child_entries(g_entries)
+    if not (shardable(q_shape, gq_e) and shardable(s_shape, gs_e)):
+        return None
+    sq_e0, ss_e0 = child_entries(view_entries)
+    if not (shardable(q_shape, sq_e0) and shardable(s_shape, ss_e0)):
+        return None
+    sec_q = sec_s = None
+    if secondary:
+        sec_entries = tuple(secondary_spec(P(*view_entries), len(view),
+                                           dp_axes))
+        sq_e, ss_e = child_entries(sec_entries)
+        if shardable(q_shape, sq_e) and shardable(s_shape, ss_e):
+            # the secondary replica is STACKED (leading layer dim whole)
+            sec_q = NamedSharding(mesh, P(None, *sq_e))
+            sec_s = NamedSharding(mesh, P(None, *ss_e))
+    wire_nbytes = int(np.prod(q_shape)) + 4 * int(np.prod(s_shape))
+    return LeafWire(
+        bits=bits, gs=gs, view_shape=view,
+        slice_shape=tuple(int(s) for s in slice_shape),
+        g_q=NamedSharding(mesh, P(*gq_e)), g_s=NamedSharding(mesh, P(*gs_e)),
+        s_q=NamedSharding(mesh, P(*sq_e0)),
+        s_s=NamedSharding(mesh, P(*ss_e0)),
+        sec_q=sec_q, sec_s=sec_s,
+        sharded_leaf=NamedSharding(mesh, P(*entries)),
+        gathered_leaf=NamedSharding(
+            mesh, P(*(_drop_dp(e, dp_axes) for e in entries))),
+        wire_nbytes=wire_nbytes)
+
+
+# ---------------------------------------------------------------------------
+# the engine-side driver
+# ---------------------------------------------------------------------------
+class WireEngine:
+    """Per-engine wire state: which rewrites are active on this mesh/stage,
+    the registry's ``secondary`` spec family, the per-leaf gather plans the
+    overlap engine consumes, and the qgZ optimizer wrap."""
+
+    def __init__(self, engine, cfg):
+        self.engine = engine
+        self.cfg = cfg
+        plan = engine.plan
+        self.mesh = plan.mesh
+        self.dp_axes = tuple(plan.dp_axes)
+        self.group_size = int(cfg.group_size)
+        self.weight_bits = int(cfg.weight_quant_bits)
+        self.grad_bits = int(cfg.grad_quant_bits)
+        self.ici = int(self.mesh.shape.get(ICI_AXIS, 1))
+        stage = plan.zero_stage
+
+        self.secondary = bool(cfg.secondary_partition) and self.ici > 1 \
+            and stage >= 3
+        if cfg.secondary_partition and self.ici <= 1:
+            log_dist(
+                "wire.secondary_partition: the mesh carries no intra-host "
+                "'ici' sub-axis (single host group) — hpZ has no fast axis "
+                "to keep the regather on; set wire.secondary_size (or "
+                "tpu.ici) to factor the data axis, e.g. to the per-host "
+                "device count", ranks=[0])
+        self.weight_active = (self.weight_bits > 0 and stage >= 3
+                              and bool(self.dp_axes))
+        if self.weight_bits > 0 and not self.weight_active:
+            log_dist(
+                f"wire.weight_quant_bits={self.weight_bits}: params are only "
+                f"dp-sharded at ZeRO stage 3 (stage {stage}, dp axes "
+                f"{self.dp_axes}) — there is no weight all-gather to "
+                "quantize; qwZ inactive", ranks=[0])
+        if (self.weight_active or self.secondary) and \
+                not engine._config.overlap_present:
+            log_dist(
+                "wire: the quantized weight gather rides the overlap "
+                "engine's prefetched layer scan — add the `overlap` block "
+                "(qwZ/hpZ are inactive without it; the wire block alone "
+                "changes nothing)", ranks=[0])
+        # registry-derived `secondary` family: the hpZ placement of every
+        # param leaf, next to params/master/grads — ds_report mesh renders
+        # it and the overlap plan reads its stacked twin through LeafWire
+        if self.cfg.secondary_partition and self.weight_bits == 0:
+            log_dist(
+                "wire.secondary_partition with weight_quant_bits=0: the "
+                "secondary replica rides the QUANTIZED gather plan — with "
+                "qwZ off there is no wire gather to redirect and hpZ is "
+                "inert; set weight_quant_bits to 8 (or 4)", ranks=[0])
+        if stage >= 3 and self.ici > 1:
+            try:
+                shapes = plan._master_shapes
+                specs = jax.tree.map(
+                    lambda sh, sp: secondary_spec(sp, len(sh.shape),
+                                                  self.dp_axes),
+                    shapes, plan.param_specs)
+                plan.registry.register("secondary", specs)
+            except Exception as e:   # reporting sugar must not kill init
+                logger.warning(f"wire: secondary spec family failed: {e}")
+        log_dist(f"wire: mode={self.mode} (weight_bits={self.weight_bits}, "
+                 f"grad_bits={self.grad_bits}, secondary="
+                 f"{'on' if self.secondary else 'off'}, "
+                 f"group_size={self.group_size}, ici={self.ici})", ranks=[0])
+
+    # ------------------------------------------------------------- identity
+    @property
+    def mode(self) -> str:
+        """The config-derived mode string perf-ledger entries stamp as
+        ``wire_mode`` ("off" / "qwz" / "qwz+hpz" / "qwz+hpz+qgz", …)."""
+        parts = []
+        if self.weight_bits > 0:
+            parts.append("qwz")
+        if self.cfg.secondary_partition:
+            parts.append("hpz")
+        if self.grad_bits > 0:
+            parts.append("qgz")
+        return "+".join(parts) if parts else "off"
+
+    # ------------------------------------------------- stacked-gather plans
+    def plan_stacked(self, leaves, slice_specs) -> List[Optional[LeafWire]]:
+        """Per-leaf gather plans for the overlap engine's stacked subtree
+        (None entries keep the full-width gather)."""
+        out: List[Optional[LeafWire]] = []
+        skipped = []
+        for leaf, sp in zip(leaves, slice_specs):
+            if sp is None or not self.weight_active:
+                out.append(None)
+                continue
+            _gathered, sharded = sp
+            lw = plan_leaf_wire(
+                self.mesh, tuple(leaf.shape[1:]), sharded,
+                self.dp_axes, bits=self.weight_bits,
+                group_size=self.group_size, secondary=self.secondary)
+            if lw is None:
+                skipped.append(tuple(leaf.shape[1:]))
+            out.append(lw)
+        if skipped:
+            log_dist(f"wire: {len(skipped)} stacked leaf(s) keep the "
+                     f"full-width gather (group layout not mappable onto "
+                     f"their sharding): shapes {skipped[:4]}"
+                     + ("…" if len(skipped) > 4 else ""), ranks=[0])
+        return out
+
+    # -------------------------------------------------- serial-schedule fn
+    def serial_gather(self, shapes, param_specs, dp_axes):
+        """(leaf_fn, wire_bytes) for the overlap serial schedule's explicit
+        gather program: quantized-gather eligible dp-sharded leaves, pass
+        the rest through (the program's out_shardings still place them
+        gathered). ``wire_bytes`` is what the timed comm span reports —
+        the actual padded code+scale bytes on the wire."""
+        is_p = lambda x: isinstance(x, P) or x is None
+        leaves = jax.tree.leaves(shapes)
+        spec_leaves = jax.tree.leaves(param_specs, is_leaf=is_p)
+        plans: List[Optional[LeafWire]] = []
+        total = 0
+        for sh, sp in zip(leaves, spec_leaves):
+            axes = set()
+            for e in _spec_tuple(sp, len(sh.shape)):
+                axes.update(_axes_of(e))
+            if not any(a in dp_axes for a in axes):
+                plans.append(None)
+                continue
+            lw = plan_leaf_wire(self.mesh, tuple(sh.shape), sp,
+                                dp_axes, bits=self.weight_bits,
+                                group_size=self.group_size, secondary=False)
+            plans.append(lw)
+            total += (lw.wire_nbytes if lw is not None
+                      else int(np.prod(sh.shape))
+                      * jnp.dtype(sh.dtype).itemsize)
+
+        def leaf_fn(i, x):
+            lw = plans[i]
+            if lw is None:
+                return x
+            qt = quantize_tensor(x.reshape(lw.view_shape),
+                                 num_bits=lw.bits, group_size=lw.gs)
+            qt = QuantizedTensor(
+                qt.num_bits,
+                lax.with_sharding_constraint(qt.q, lw.s_q),
+                lax.with_sharding_constraint(qt.scale, lw.s_s),
+                None, qt.shape, qt.dtype)
+            w = dequantize_tensor(lw.gathered_qt(qt), dtype=x.dtype)
+            return lax.with_sharding_constraint(w.reshape(lw.slice_shape),
+                                                lw.gathered_leaf)
+
+        return leaf_fn, total
+
+    # --------------------------------------------------- qgZ optimizer wrap
+    def wrap_grad_sync(self, opt, config):
+        """Swap the engine's optimizer for :class:`QGZAdam` when the wire's
+        grad sync can own the exchange (stage 0, pure-DP mesh, adam/adamw);
+        loudly inert otherwise — the ds_doctor ``wire`` cross-field lints
+        mirror each branch."""
+        if self.grad_bits <= 0:
+            return opt
+        if getattr(opt, "is_onebit", False):
+            raise ValueError(
+                "wire.grad_quant_bits with a 1-bit optimizer: both want to "
+                "own the gradient exchange (the 1-bit family already "
+                "compresses its momentum sync to 1 bit) — drop "
+                "wire.grad_quant_bits or use a dense optimizer")
+        stage = self.engine.plan.zero_stage
+        if stage != 0:
+            log_dist(
+                f"wire.grad_quant_bits={self.grad_bits}: ZeRO stage {stage} "
+                "gradient reductions are GSPMD-inserted (the partitioner "
+                "resolves the cotangent's pending sum at full width before "
+                "any nonlinear op on this jax) — the qgZ shard-mapped grad "
+                "sync applies at stage 0 on a pure-DP mesh; inert here",
+                ranks=[0])
+            return opt
+        bad = [f"{a}={int(n)}" for a, n in dict(self.mesh.shape).items()
+               if a not in (DATA_AXIS, ICI_AXIS) and int(n) > 1]
+        if bad:
+            log_dist(f"wire.grad_quant_bits: qgZ's shard-mapped step needs "
+                     f"a pure-DP (data[×ici]) mesh; axes {bad} — inert",
+                     ranks=[0])
+            return opt
+        if self.engine._config.fp16.enabled:
+            log_dist("wire.grad_quant_bits: fp16 dynamic loss scaling would "
+                     "sit inside the quantized loop — use bf16/fp32; inert",
+                     ranks=[0])
+            return opt
+        name = (config.optimizer_name or "").lower()
+        if self.engine.client_optimizer is not None or \
+                name not in ("adam", "adamw"):
+            log_dist(f"wire.grad_quant_bits: the qgZ grad sync wraps the "
+                     f"ds_config adam/adamw optimizer (got "
+                     f"{name or 'a client optimizer'}); inert", ranks=[0])
+            return opt
+        params = dict(config.optimizer_params or {})
+        log_dist(f"wire: qgZ grad sync armed — int{self.grad_bits} "
+                 f"hierarchical exchange (group_size={self.group_size}) "
+                 "inside the shard-mapped step; error-feedback residuals "
+                 "ride the optimizer state", ranks=[0])
+        return QGZAdam(bits=self.grad_bits, group_size=self.group_size,
+                       adam_w_mode=(name == "adamw"), **params)
+
+
+# ---------------------------------------------------------------------------
+# qgZ — hierarchical quantized gradient exchange (shard_map-callable)
+# ---------------------------------------------------------------------------
+def _flat_quant(rows: jnp.ndarray, bits: int, group_size: int):
+    """(..., n) f32 → (codes int8 (..., n[/2]), scales f32 (..., n/gs)).
+    n must be a multiple of ``group_size`` (qgz pads its chunks so)."""
+    *lead, n = rows.shape
+    g = rows.reshape(*lead, n // group_size, group_size)
+    qmax = 127.0 if bits == 8 else 7.0
+    scale = jnp.max(jnp.abs(g), axis=-1, keepdims=True) / qmax
+    q = jnp.clip(jnp.round(g / jnp.maximum(scale, 1e-12)), -qmax, qmax
+                 ).astype(jnp.int8)
+    if bits == 4:
+        lo = q[..., 0::2]
+        hi = q[..., 1::2]
+        q = ((hi.astype(jnp.uint8) << 4) | (lo.astype(jnp.uint8) & 0x0F)
+             ).astype(jnp.int8)
+    return (q.reshape(*lead, -1),
+            scale.reshape(*lead, n // group_size).astype(jnp.float32))
+
+
+def _flat_dequant(codes: jnp.ndarray, scales: jnp.ndarray, bits: int,
+                  group_size: int) -> jnp.ndarray:
+    *lead, nc = codes.shape
+    if bits == 4:
+        u = codes.astype(jnp.uint8)
+        lo = (u & 0x0F).astype(jnp.int8)
+        lo = jnp.where(lo >= 8, lo - 16, lo)
+        hi = (u >> 4).astype(jnp.int8)
+        hi = jnp.where(hi >= 8, hi - 16, hi)
+        q = jnp.stack([lo, hi], axis=-1).reshape(*lead, nc * 2)
+    else:
+        q = codes
+    n = q.shape[-1]
+    g = q.reshape(*lead, n // group_size, group_size).astype(jnp.float32)
+    return (g * scales[..., None]).reshape(*lead, n)
+
+
+def _bound_axis_size(name) -> int:
+    """Static size of a bound mesh axis inside shard_map — this jax 0.4.x
+    has no ``lax.axis_size``; ``core.axis_frame`` returns the size."""
+    if isinstance(name, (tuple, list)):
+        return int(np.prod([_bound_axis_size(n) for n in name]))
+    try:
+        return int(lax.axis_size(name))            # newer jax
+    except AttributeError:
+        from jax.core import axis_frame
+
+        frame = axis_frame(name)
+        return int(getattr(frame, "size", frame))
+
+
+def qgz_group_size(group_size: int) -> int:
+    """qgZ quant groups are always EVEN-sized (int4 packs nibble PAIRS
+    within a group); an odd request rounds up — applied identically by the
+    chunk sizing and the exchange so the layouts always agree."""
+    return group_size + (group_size % 2)
+
+
+def qgz_chunk_size(numel: int, world: int, group_size: int = 64) -> int:
+    """Per-device chunk length: ceil(numel/world) rounded up so every chunk
+    tiles into whole (even-sized, int4-packable) quant groups."""
+    unit = qgz_group_size(group_size)
+    c = math.ceil(numel / world)
+    return ((c + unit - 1) // unit) * unit
+
+
+def qgz_state_shapes(numel: int, world_inner: int, world_outer: int,
+                     group_size: int = 64) -> Tuple[int, int]:
+    """(worker_error_len, server_error_len) for a flat buffer — the
+    error-feedback residual sizes that ride the optimizer state."""
+    c = qgz_chunk_size(numel, world_inner * world_outer, group_size)
+    return world_outer * c, c
+
+
+def hierarchical_quantized_allreduce(flat: jnp.ndarray,
+                                     worker_error: jnp.ndarray,
+                                     server_error: jnp.ndarray,
+                                     *,
+                                     outer_axis: str = DATA_AXIS,
+                                     inner_axis: Optional[str] = None,
+                                     bits: int = 8,
+                                     group_size: int = 64):
+    """Mean of ``flat`` across (inner × outer) mesh axes with the inter-host
+    hop quantized — the qgZ exchange, generalizing
+    :func:`~deepspeed_tpu.runtime.comm.compressed.compressed_allreduce`'s
+    chunk/pack pattern to int4/int8 with per-group scales:
+
+    1. **intra-host** (``inner_axis``): all-to-all chunking + full-precision
+       local reduce — each device ends holding its host's partial sum for
+       its slab (ICI-fast, never quantized);
+    2. **inter-host** (``outer_axis``): the partials are error-feedback
+       block-quantized and all-to-all'd across hosts, dequantized, reduced
+       — only int codes + per-group f32 scales cross the slow link;
+    3. **gather back**: the reduced chunk is quantized once more (server
+       residual) and all-gathered outer-then-inner.
+
+    Must run inside a traced per-device context (shard_map) binding the
+    axes. ``worker_error``/``server_error`` are this device's persistent
+    residuals (:func:`qgz_state_shapes`); returns ``(mean, new_worker_error,
+    new_server_error)``. With ``inner_axis=None`` the exchange is flat
+    (single-level) quantized."""
+    assert bits in (4, 8), bits
+    group_size = qgz_group_size(group_size)
+    w_i = _bound_axis_size(inner_axis) if inner_axis is not None else 1
+    w_o = _bound_axis_size(outer_axis)
+    world = w_i * w_o
+    chunk = int(server_error.shape[0])
+    assert int(worker_error.shape[0]) == w_o * chunk, \
+        (worker_error.shape, w_o, chunk)
+    numel = flat.shape[0]
+    buf = jnp.zeros((world * chunk,), jnp.float32
+                    ).at[:numel].set(flat.astype(jnp.float32))
+    buf = buf.reshape(w_i, w_o * chunk)
+
+    # ---- phase 1: intra-host chunking + full-precision reduce ----------
+    if inner_axis is not None and w_i > 1:
+        recv = lax.all_to_all(buf, inner_axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+        partial = jnp.sum(recv.reshape(w_i, w_o * chunk), axis=0)
+    else:
+        partial = buf.reshape(w_o * chunk)
+
+    # ---- phase 2: quantized inter-host exchange ------------------------
+    comp = partial + worker_error
+    codes, scales = _flat_quant(comp.reshape(w_o, chunk), bits, group_size)
+    new_worker_error = comp - _flat_dequant(codes, scales, bits, group_size
+                                            ).reshape(-1)
+    recv_c = lax.all_to_all(codes, outer_axis, split_axis=0, concat_axis=0,
+                            tiled=False).reshape(w_o, -1)
+    recv_s = lax.all_to_all(scales, outer_axis, split_axis=0, concat_axis=0,
+                            tiled=False).reshape(w_o, -1)
+    reduced = jnp.sum(_flat_dequant(recv_c, recv_s, bits, group_size),
+                      axis=0) / world                       # (chunk,) mean
+
+    # ---- phase 3: quantized gather back --------------------------------
+    comp_s = reduced + server_error
+    c2, s2 = _flat_quant(comp_s, bits, group_size)
+    new_server_error = comp_s - _flat_dequant(c2, s2, bits, group_size)
+    all_c = lax.all_gather(c2, outer_axis)                  # (w_o, chunk')
+    all_s = lax.all_gather(s2, outer_axis)
+    rows = _flat_dequant(all_c, all_s, bits, group_size)    # (w_o, chunk)
+    if inner_axis is not None and w_i > 1:
+        rows = lax.all_gather(rows.reshape(w_o * chunk), inner_axis)
+        result = rows.reshape(-1)[:numel]
+    else:
+        result = rows.reshape(-1)[:numel]
+    return result, new_worker_error, new_server_error
+
+
+# ---------------------------------------------------------------------------
+# QGZAdam — exact AdamW over qgZ-synced grads (1-bit engine protocol)
+# ---------------------------------------------------------------------------
+class QGZAdam:
+    """Dense AdamW whose gradient averaging is the qgZ hierarchical
+    quantized exchange, plugged into the engine's existing shard-mapped
+    (1-bit-protocol) step: ``update_local`` runs per-device with local
+    grads, the exchange's error-feedback residuals ride the optimizer
+    state (per-worker leading dim, dp-sharded, checkpointed like any other
+    state leaf). Unlike the 1-bit family there are no phases — grads are
+    synced exactly (up to the quantizer's bounded, feedback-compensated
+    error) every step, so the moments stay replicated."""
+
+    is_onebit = True     # the engine's shard-mapped step protocol
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, bits=8, group_size=64,
+                 adam_w_mode=True, **unused):
+        self.lr = float(lr)
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.bits = int(bits)
+        self.group_size = int(group_size)
+        self.adam_w_mode = bool(adam_w_mode)
+        self._param_treedef = None
+        self._dims = None
+
+    # ------------------------------------------------------------- topology
+    def _mesh_dims(self):
+        if self._dims is None:
+            from deepspeed_tpu import comm as dist
+
+            mesh = dist.get_mesh()
+            self._dims = (int(mesh.shape.get(DATA_AXIS, 1)),
+                          int(mesh.shape.get(ICI_AXIS, 1)))
+        return self._dims
+
+    @property
+    def comm_axes(self) -> Tuple[str, ...]:
+        d, i = self._mesh_dims()
+        return (DATA_AXIS, ICI_AXIS) if i > 1 else (DATA_AXIS,)
+
+    @property
+    def comm_axis(self):
+        axes = self.comm_axes
+        return axes if len(axes) > 1 else axes[0]
+
+    def _world_size(self) -> int:
+        d, i = self._mesh_dims()
+        return d * i
+
+    # ----------------------------------------------------------------- state
+    def init(self, params):
+        from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdamState
+
+        d, i = self._mesh_dims()
+        w = d * i
+        self._param_treedef = jax.tree.structure(params)
+
+        def numel(p):
+            return int(np.prod(p.shape, dtype=np.int64)) if p.shape else 1
+
+        def we(p):
+            wl, _ = qgz_state_shapes(numel(p), i, d, self.group_size)
+            return jnp.zeros((w, wl), jnp.float32)
+
+        def se(p):
+            _, sl = qgz_state_shapes(numel(p), i, d, self.group_size)
+            return jnp.zeros((w, sl), jnp.float32)
+
+        return OnebitAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            nu=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            worker_error=jax.tree.map(we, params),
+            server_error=jax.tree.map(se, params))
+
+    def state_partition_specs(self):
+        from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdamState
+
+        assert self._param_treedef is not None, "call init(params) first"
+        per_leaf = lambda spec: jax.tree.unflatten(
+            self._param_treedef, [spec] * self._param_treedef.num_leaves)
+        err = P(self.comm_axes if len(self.comm_axes) > 1
+                else self.comm_axes[0])
+        return OnebitAdamState(count=P(), mu=per_leaf(P()), nu=per_leaf(P()),
+                               worker_error=per_leaf(err),
+                               server_error=per_leaf(err))
+
+    # -------------------------------------------------------------- protocol
+    def phase_for_step(self, host_step: int) -> str:
+        return "qgz"
+
+    def phases(self):
+        return ("qgz",)
+
+    def effective_params(self, params, masters, state):
+        return params
+
+    # ---------------------------------------------------------------- update
+    def _sync_leaf(self, g, we_row, se_row):
+        d, i = self._mesh_dims()
+        out, nwe, nse = hierarchical_quantized_allreduce(
+            g.reshape(-1).astype(jnp.float32), we_row, se_row,
+            outer_axis=DATA_AXIS,
+            inner_axis=ICI_AXIS if i > 1 else None,
+            bits=self.bits, group_size=self.group_size)
+        return out.reshape(g.shape), nwe, nse
+
+    def update_local(self, grads, state, masters, lr, phase: str):
+        from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdamState
+
+        count = state.count + 1
+        leaves, tdef = jax.tree.flatten(grads)
+        wes = jax.tree.leaves(state.worker_error)
+        ses = jax.tree.leaves(state.server_error)
+        synced = [self._sync_leaf(g, we[0], se[0])
+                  for g, we, se in zip(leaves, wes, ses)]
+        g_avg = tdef.unflatten([s[0] for s in synced])
+        new_we = tdef.unflatten([s[1][None] for s in synced])
+        new_se = tdef.unflatten([s[2][None] for s in synced])
+
+        if self.weight_decay != 0.0 and not self.adam_w_mode:
+            # plain adam folds L2 into the gradient (the dense path's
+            # adam_leaf_update semantics); adamw decouples it below
+            g_avg = jax.tree.map(
+                lambda g, p: g + self.weight_decay * p.astype(jnp.float32),
+                g_avg, masters)
+        mu = jax.tree.map(lambda m, g: self.b1 * m + (1 - self.b1) * g,
+                          state.mu, g_avg)
+        nu = jax.tree.map(lambda v, g: self.b2 * v + (1 - self.b2)
+                          * jnp.square(g), state.nu, g_avg)
+        c = count.astype(jnp.float32)
+        bc1 = 1.0 - self.b1 ** c
+        bc2 = 1.0 - self.b2 ** c
+
+        def upd(m, v, p):
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.weight_decay != 0.0 and self.adam_w_mode:
+                u = u + self.weight_decay * p.astype(jnp.float32)
+            return -lr * u
+
+        updates = jax.tree.map(upd, mu, nu, masters)
+        new_state = OnebitAdamState(count=count, mu=mu, nu=nu,
+                                    worker_error=new_we, server_error=new_se)
+        return updates, new_state

@@ -30,7 +30,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import (DATA_AXIS, DP_AXES, EXPERT_AXIS,
-                                             MICS_AXIS, SEQ_AXIS, TENSOR_AXIS)
+                                             ICI_AXIS, MICS_AXIS, SEQ_AXIS,
+                                             TENSOR_AXIS)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -337,7 +338,7 @@ def plan_sharding(param_shapes: Any,
                     "the ZeRO sharding memory savings.")
 
     if batch_spec is None:
-        batch_axes = tuple(a for a in (DATA_AXIS, MICS_AXIS, EXPERT_AXIS)
+        batch_axes = tuple(a for a in (DATA_AXIS, MICS_AXIS, ICI_AXIS, EXPERT_AXIS)
                            if mesh.shape.get(a, 1) > 1)
         if mesh.shape.get(SEQ_AXIS, 1) > 1:
             # sequence parallelism: tokens dim sharded over 'seq' too

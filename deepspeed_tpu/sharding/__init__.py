@@ -31,12 +31,13 @@ from deepspeed_tpu.sharding.jit import (INHERIT, ProgramRecord, program_table,
                                         render_program_table,
                                         reset_program_table, sharded_jit)
 from deepspeed_tpu.sharding.mesh import (ensure_global_mesh, global_mesh,
-                                         mesh_axes_string, reset_global_mesh)
+                                         host_device_groups, mesh_axes_string,
+                                         reset_global_mesh)
 from deepspeed_tpu.sharding.registry import ShardingRegistry
 
 __all__ = [
     "INHERIT", "ProgramRecord", "ShardingRegistry", "ensure_global_mesh",
-    "global_mesh", "mesh_axes_string", "program_table",
+    "global_mesh", "host_device_groups", "mesh_axes_string", "program_table",
     "render_program_table", "reset_global_mesh", "reset_program_table",
     "sharded_jit",
 ]

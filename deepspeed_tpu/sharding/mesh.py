@@ -140,6 +140,45 @@ def _nontrivial(dims: Dict[str, int]) -> Dict[str, int]:
     return {a: v for a, v in dims.items() if v > 1} or dict(list(dims.items())[:1])
 
 
+def host_device_groups(mesh: Optional[Mesh]):
+    """Device-id groups per *host* — the boundary ds_wire's hpZ keeps the
+    backward regather inside and the xray comm model splits wire bytes on
+    (``all-gather`` vs ``all-gather/intra``). Three sources, in order:
+
+    * a real multi-process run: group by ``device.process_index`` — the
+      actual host boundary;
+    * a single-process mesh carrying the wire's ``ici`` sub-axis (size
+      > 1): the DCN-ish axes (pipe, data, mics) index the host groups and
+      everything inside (ici, expert, seq, tensor) is one host — the
+      simulated-fleet host model the 8-dev drills run on;
+    * neither: ``None`` — the mesh encodes no host structure, and the
+      comm model keeps its flat (un-split) accounting, so ledgers from
+      pre-wire topologies stay byte-comparable.
+    """
+    if mesh is None:
+        return None
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.parallel.topology import (DATA_AXIS, ICI_AXIS,
+                                                 MICS_AXIS, PIPE_AXIS)
+
+    if jax.process_count() > 1:
+        by_proc = {}
+        for d in mesh.devices.flat:
+            by_proc.setdefault(int(d.process_index), set()).add(int(d.id))
+        return tuple(frozenset(g) for _, g in sorted(by_proc.items()))
+    if int(mesh.shape.get(ICI_AXIS, 1)) <= 1:
+        return None
+    inter = [i for i, a in enumerate(mesh.axis_names)
+             if a in (PIPE_AXIS, DATA_AXIS, MICS_AXIS)]
+    groups = {}
+    for coords, dev in np.ndenumerate(mesh.devices):
+        key = tuple(coords[i] for i in inter)
+        groups.setdefault(key, set()).add(int(dev.id))
+    return tuple(frozenset(g) for _, g in sorted(groups.items()))
+
+
 def mesh_axes_string(mesh: Optional[Mesh]) -> str:
     """Compact ``data=4×tensor=2`` identity of a mesh — the string ds_perf
     ledger entries carry so a benchmark line is mesh-attributable, and the

@@ -22,7 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS,
-                                             MICS_AXIS, SEQ_AXIS)
+                                             ICI_AXIS, MICS_AXIS, SEQ_AXIS)
 
 __all__ = ["ShardingRegistry"]
 
@@ -84,7 +84,7 @@ class ShardingRegistry:
             if first is None:
                 return ()
             return tuple(first) if isinstance(first, (tuple, list)) else (first,)
-        return tuple(a for a in (DATA_AXIS, MICS_AXIS, EXPERT_AXIS)
+        return tuple(a for a in (DATA_AXIS, MICS_AXIS, ICI_AXIS, EXPERT_AXIS)
                      if self.mesh.shape.get(a, 1) > 1)
 
     def batch_spec(self, ndim: int) -> P:

@@ -35,7 +35,12 @@ N-device CPU mesh (--xla_force_host_platform_device_count) so the
 ZeRO-3/dp sharding paths run off-TPU; `--overlap overlapped|serial|off`
 (BENCH_OVERLAP) adds the `overlap` ds_config block — run the same line
 under `serial` then `overlapped` and `ds_perf diff --metric exposed_comm`
-prices the hidden-collectives win from the two ledger entries.
+prices the hidden-collectives win from the two ledger entries. `--sdc`
+(BENCH_SDC=1) arms the ds_sentry `sdc` block (replay audits every
+BENCH_SDC_INTERVAL steps, default 2) and ASSERTS the recorded entry
+prices the defense: an `audit` goodput bucket plus an `sdc_overhead`
+attribution below audit_interval^-1 of wall — the number `ds_perf gate
+--metric sdc_overhead` then regresses on.
 
 Env knobs: BENCH_MODEL, BENCH_BS (per-chip microbatch), BENCH_SEQ,
 BENCH_STEPS, BENCH_GAS, BENCH_REMAT (none|full|dots|attn|attn_mlp; default
@@ -190,6 +195,15 @@ if "--wire" in sys.argv[1:]:
     if _i + 1 >= len(sys.argv):
         sys.exit("bench.py: --wire requires a mode (off|qwz|qwz+hpz|full)")
     os.environ["BENCH_WIRE"] = sys.argv[_i + 1]
+# --sdc (or BENCH_SDC=1): arm the ds_sentry `sdc` block on every
+# engine-backed line — deterministic replay audits every
+# BENCH_SDC_INTERVAL steps (default 2: the smoke's 3-step timed window
+# must hold at least one audit) + the in-step state checksum. The line
+# then asserts its own ledger entry carries the `audit` goodput bucket
+# and an `sdc_overhead` attribution under the audit_interval^-1 budget.
+# Unset = no block (strict no-op: the sdc module is never imported).
+if "--sdc" in sys.argv[1:]:
+    os.environ["BENCH_SDC"] = "1"
 
 import jax
 import numpy as np
@@ -467,6 +481,14 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
             if wire_mode == "full":
                 wire_block["grad_quant_bits"] = 4
             ds_config["wire"] = wire_block
+    sdc_on = os.environ.get("BENCH_SDC", "0") == "1"
+    sdc_interval = int(os.environ.get("BENCH_SDC_INTERVAL", 2))
+    if sdc_on:
+        # ds_sentry: replay audits + in-step checksum; the goodput ledger
+        # below prices the audits into their own badput bucket, and the
+        # recorded entry asserts the overhead stays under the
+        # audit_interval^-1 budget (the sdc contract ds_perf gate holds)
+        ds_config["sdc"] = {"audit_interval": sdc_interval}
     if gas > 1:
         # bf16 accumulator: gas>1 must not add a resident fp32 grad tree on
         # top of the full optimizer state (16G HBM budget)
@@ -535,9 +557,10 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
     off_tag = f", offload={offload}" if offload != "none" else ""
     ov_tag = f", overlap={overlap_mode}" if overlap_mode else ""
     wire_tag = f", wire={wire_mode}" if wire_mode else ""
+    sdc_tag = f", sdc@{sdc_interval}" if sdc_on else ""
     line = {
         "metric": f"{model_name} pretrain MFU (bs={per_chip_bs}/chip, seq={seq}, "
-                  f"{n_dev} chip(s), gas={gas}{off_tag}{ov_tag}{wire_tag}, "
+                  f"{n_dev} chip(s), gas={gas}{off_tag}{ov_tag}{wire_tag}{sdc_tag}, "
                   f"tok/s/chip={tok_per_sec_chip:.0f}, "
                   f"TFLOPs/chip={achieved/1e12:.1f}, loss={final_loss:.3f})",
         "value": round(mfu, 4),
@@ -559,6 +582,7 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
                         "n_head": config.n_head,
                         "overlap": overlap_mode or None,
                         "wire": wire_mode or None,
+                        "sdc": sdc_interval if sdc_on else None,
                         "flash_block": getattr(config, "flash_block", None)},
                 extra={"vs_baseline": line["vs_baseline"],
                        "tok_per_sec_chip": round(tok_per_sec_chip, 1),
@@ -581,6 +605,30 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
                 print(note, file=sys.stderr)
         except Exception as e:
             print(f"# perf record failed: {e}", file=sys.stderr)
+        if sdc_on:
+            # the sdc acceptance — OUTSIDE the best-effort try above: a
+            # missing audit bucket must FAIL the bench, not print a note.
+            # The entry must PRICE the defense: an `audit` goodput bucket
+            # over the timed window and an sdc_overhead attribution under
+            # the audit_interval^-1 budget (each audit replays ~one step
+            # per interval, so the fraction sits near 1/(interval+1)
+            # with headroom).
+            att = line.get("attribution") or {}
+            so = att.get("sdc_overhead")
+            assert so is not None, (
+                "sdc armed but the ledger entry carries no sdc_overhead "
+                "attribution (goodput block missing, or perf_record "
+                "failed above)")
+            gp = att.get("goodput") or {}
+            assert gp.get("buckets_us", {}).get("audit", 0.0) > 0.0, \
+                "sdc armed but no audit bucket landed in the timed window"
+            budget = 1.0 / max(1, sdc_interval)
+            assert so < budget, (
+                f"sdc_overhead {so:.3f} exceeds the audit_interval^-1 "
+                f"budget {budget:.3f} — audits cost more wall than the "
+                "sdc contract allows")
+            print(f"# sdc: audit overhead {100.0 * so:.1f}% of wall "
+                  f"(budget {100.0 * budget:.0f}%)", file=sys.stderr)
 
     # free this preset's device memory before the next ladder entry (the
     # north-star evidence step otherwise inherits a chip full of dead

@@ -50,7 +50,7 @@ def _block_models() -> Dict[str, type]:
         "eigenvalue": C.EigenvalueConfig,
         "progressive_layer_drop": C.PLDConfig,
         "resilience": C.ResilienceConfig, "rewind": C.RewindConfig,
-        "watchdog": C.WatchdogConfig,
+        "sdc": C.SdcConfig, "watchdog": C.WatchdogConfig,
         "telemetry": C.TelemetryConfig, "analysis": C.AnalysisConfig,
         "profiling": C.ProfilingConfig, "perf": C.PerfConfig,
         "serving": C.ServingConfig, "goodput": C.GoodputConfig,
@@ -369,6 +369,28 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "floor, so any world change becomes a loud refusal — is "
                 "the floor meant for a bigger fleet?",
                 "elasticity.resize.min_world_size")
+    sdc = cfg.sdc
+    if "sdc" in pd and sdc.enabled:
+        if not ("rewind" in pd and rw.enabled):
+            add("warning",
+                "sdc without the rewind block: a corruption verdict with no "
+                "elastic resize (or when eviction is refused) recovers by "
+                "rewinding to the newest audited-clean snapshot — with no "
+                "tier-0 RAM ring the only fallback is the tier-2 disk "
+                "checkpoint, so every verdict costs up to a full checkpoint "
+                "interval of steps; enable the rewind block so detection "
+                "latency (≤ sdc.audit_interval steps) bounds the loss",
+                "sdc vs rewind")
+        if wd.consistency_interval > 0 and \
+                sdc.audit_interval < wd.consistency_interval:
+            add("info",
+                f"sdc.audit_interval ({sdc.audit_interval}) is tighter than "
+                f"watchdog.consistency_interval ({wd.consistency_interval}): "
+                "replay audits will catch a flip before the cross-host "
+                "agreement round ever sees its checksum — expected when you "
+                "want device-granular blame first; just know the agreement "
+                "round is then a backstop, not the detector",
+                "sdc.audit_interval vs watchdog.consistency_interval")
     gp = cfg.goodput
     if "goodput" in pd and gp.enabled and not (tel.enabled and tel.trace):
         add("warning",

@@ -78,6 +78,13 @@ class FleetResizeEvent(RuntimeError):
 # tests/drills reset it via clear_fleet_events().
 _FLEET_TARGET: Optional[int] = None
 
+# Devices a ds_sentry SDC verdict condemned (by device id): filtered out
+# of the survivor pool BEFORE the fleet-target truncation, so an evicted
+# chip never re-enters any post-event mesh. Same lifetime rules as
+# _FLEET_TARGET — a quarantine outlives the supervised run, like a real
+# hardware ticket; clear_fleet_events() resets it.
+_QUARANTINED: set = set()
+
 
 def set_fleet_target(n: Optional[int]) -> None:
     """Pin the simulated fleet to ``n`` devices (None = all). Drills use
@@ -86,17 +93,34 @@ def set_fleet_target(n: Optional[int]) -> None:
     _FLEET_TARGET = None if n is None else int(n)
 
 
+def quarantine_device(device_id: int) -> None:
+    """Remove a device from every future survivor pool (ds_sentry blame:
+    the chip produced provably-wrong bytes — no mesh should include it
+    until a human clears the ticket)."""
+    _QUARANTINED.add(int(device_id))
+    logger.warning(f"ds_resize: device {int(device_id)} QUARANTINED — "
+                   "excluded from every survivor mesh until "
+                   "clear_fleet_events()")
+
+
+def quarantined_devices() -> set:
+    """The condemned device ids (read-only copy)."""
+    return set(_QUARANTINED)
+
+
 def clear_fleet_events() -> None:
     set_fleet_target(None)
+    _QUARANTINED.clear()
 
 
 def survivor_devices() -> list:
     """The devices the simulated fleet still holds — engine factories for
     elastic runs build their mesh over this instead of ``jax.devices()``
-    so a post-event bring-up lands on the post-event world."""
+    so a post-event bring-up lands on the post-event world. Quarantined
+    devices are filtered first, then the fleet target truncates."""
     import jax
 
-    devs = list(jax.devices())
+    devs = [d for d in jax.devices() if d.id not in _QUARANTINED]
     if _FLEET_TARGET is None:
         return devs
     return devs[:max(1, min(len(devs), _FLEET_TARGET))]

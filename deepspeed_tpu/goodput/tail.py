@@ -119,6 +119,52 @@ def render_resize_line(gauges: Dict[str, float],
     return "  ".join(parts)
 
 
+def render_sdc_line(gauges: Dict[str, float],
+                    counters: Dict[str, float]) -> Optional[str]:
+    """The ds_sentry status line: audit cadence + last audited-clean step,
+    then the corruption ledger (verdicts by blamed device, evictions,
+    poisoned snapshots, sdc rewinds). Same contract as
+    :func:`render_rewind_line` — rendered by ``ds_top`` frames and the
+    ``ds_metrics`` footer, pure stdlib so the jax-free CLIs can
+    file-load it. Returns None when the run never armed the sdc block."""
+    if not any(k.startswith("sdc/") for k in gauges) and \
+            not any(k.startswith("sdc/") for k in counters):
+        return None
+    parts = ["sdc:"]
+    interval = gauges.get("sdc/audit_interval")
+    if interval:
+        parts.append(f"audit every {int(interval)} step(s)")
+    clean = gauges.get("sdc/last_clean_step")
+    if clean is not None and clean >= 0:
+        parts.append(f"last clean @step {int(clean)}")
+    verdicts = {k: v for k, v in counters.items()
+                if k.startswith("sdc/verdicts")}
+    if verdicts:
+        by_dev = ", ".join(
+            f"{int(v)}x dev{parse_label(k, 'device') or '?'}"
+            for k, v in sorted(verdicts.items()))
+        seg = f"VERDICTS {int(sum(verdicts.values()))} ({by_dev})"
+        vd = gauges.get("sdc/last_verdict_device")
+        vs = gauges.get("sdc/last_verdict_step")
+        if vd is not None and vs is not None:
+            seg += f", last blamed dev{int(vd)} @step {int(vs)}"
+        parts.append(seg)
+    else:
+        parts.append("no verdicts")
+    ev = sum(v for k, v in counters.items() if k.startswith("sdc/evictions"))
+    if ev:
+        parts.append(f"evicted {int(ev)} device(s)")
+    poisoned = sum(v for k, v in counters.items()
+                   if k.startswith("sdc/poisoned_snapshots"))
+    if poisoned:
+        parts.append(f"poisoned {int(poisoned)} snapshot(s)")
+    rewinds = sum(v for k, v in counters.items()
+                  if k.startswith("resilience/sdc_rewinds"))
+    if rewinds:
+        parts.append(f"sdc rewinds {int(rewinds)}")
+    return "  ".join(parts)
+
+
 class JSONLTailer:
     """Incremental reader of an append-mostly JSONL file.
 

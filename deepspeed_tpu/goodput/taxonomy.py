@@ -15,6 +15,11 @@ Buckets (priority order, highest first):
                     compile-duration listener the goodput recorder
                     installs stamps these; cat="compile");
 ``checkpoint``      save/load spans (cat="checkpoint");
+``audit``           SDC replay-audit re-execution (cat="audit") — the
+                    sentry's deliberate redundant compute; badput by
+                    definition, and priced ABOVE compute so the seconds
+                    it spends inside a ``train_batch`` span are charged
+                    to the audit, not claimed as goodput;
 ``data_wait``       the engine's ``data`` span — host input pipeline;
 ``straggler_wait``  inside a matched collective, time spent waiting for
                     the last-arriving rank (fleet-level only: needs >= 2
@@ -38,7 +43,7 @@ from typing import Dict, List, Optional, Tuple
 # priority order: earlier wins where spans overlap. `restart` and `idle`
 # are computed residually (gaps), never from spans, so they close the
 # partition.
-BUCKETS = ("watchdog_stall", "compile", "checkpoint", "data_wait",
+BUCKETS = ("watchdog_stall", "compile", "checkpoint", "audit", "data_wait",
            "straggler_wait", "exposed_comm", "compute", "restart", "idle")
 
 GOODPUT_BUCKETS = ("compute",)
@@ -47,7 +52,7 @@ BADPUT_BUCKETS = tuple(b for b in BUCKETS if b not in GOODPUT_BUCKETS)
 # span categories / names -> bucket (everything span-classifiable; the
 # residual buckets have no span class on purpose)
 _CAT_BUCKET = {"stall": "watchdog_stall", "compile": "compile",
-               "checkpoint": "checkpoint"}
+               "checkpoint": "checkpoint", "audit": "audit"}
 
 # compute evidence: host spans that mean "the step is executing device
 # work (or dispatching it)". train_batch encloses fwd/bwd/step, but the

@@ -19,7 +19,8 @@ from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.goodput.tail import (MetricsFollower, labeled_key,
                                         render_resize_line,
-                                        render_rewind_line)
+                                        render_rewind_line,
+                                        render_sdc_line)
 from deepspeed_tpu.goodput.taxonomy import GOODPUT_BUCKETS
 
 
@@ -131,6 +132,9 @@ def render_frame(records: List[dict], source: Optional[str] = None,
     rz = render_resize_line(g, s["counters"])
     if rz:
         out.append(rz)
+    sdc = render_sdc_line(g, s["counters"])
+    if sdc:
+        out.append(sdc)
 
     if s["comm_skew"] is not None:
         ratio, op, p50, mx = s["comm_skew"]

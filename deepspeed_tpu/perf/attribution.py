@@ -154,6 +154,21 @@ def collect(engine, session=None, timed_steps: Optional[int] = None,
                 att["goodput"] = gp
         except Exception as e:
             logger.warning(f"perf attribution: goodput ledger failed: {e}")
+    # ---- sdc_overhead: the replay-audit cost as a fraction of the timed
+    # window's wall — the number `ds_perf gate --metric sdc_overhead`
+    # regresses on. Stamped only when the sdc sentry is armed AND the
+    # goodput ledger priced the window (the `audit` bucket lives in the
+    # goodput taxonomy); an armed sentry whose window held no audit step
+    # stamps an honest 0.0, so the ledger still records which entries
+    # paid for defense.
+    if getattr(engine, "_sdc", None) is not None and att.get("goodput"):
+        gp = att["goodput"]
+        wall = sum(float(s.get("wall_us") or 0.0)
+                   for s in gp.get("per_step") or [])
+        if wall > 0:
+            att["sdc_overhead"] = round(
+                float((gp.get("buckets_us") or {}).get("audit", 0.0)) / wall,
+                5)
     # ---- memory: census buckets + compiled-step accounting
     try:
         res = engine.memory_census()

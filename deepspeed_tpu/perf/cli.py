@@ -121,6 +121,14 @@ def _static_comm_line(r):
             + (" [REGRESSED]" if r.get("static_comm_regressed") else ""))
 
 
+def _sdc_overhead_line(r):
+    if "new_sdc_overhead" not in r:
+        return ""
+    return (f"  sdc_overhead {r['old_sdc_overhead']:.2%} -> "
+            f"{r['new_sdc_overhead']:.2%} of wall"
+            + (" [REGRESSED]" if r.get("sdc_overhead_regressed") else ""))
+
+
 def _cmd_diff(args) -> int:
     old = led.latest_by_series(_load(args.old))
     new = led.latest_by_series(_load(args.new))
@@ -149,7 +157,8 @@ def _cmd_diff(args) -> int:
                                if r["fingerprint_changed"] else "")
         print(f"{mark} {r['series']}: {_fmt_val(r['old_value'])} -> "
               f"{_fmt_val(r['new_value'])} ({r['rel_delta']:+.1%})"
-              f"{noise}{fp}{_exposed_line(r)}{_static_comm_line(r)}")
+              f"{noise}{fp}{_exposed_line(r)}{_static_comm_line(r)}"
+              f"{_sdc_overhead_line(r)}")
         if "exposed_comm" in attr_sel and "new_exposed_comm_us" not in r:
             print(f"   {r['series']}: exposed_comm not recorded on both "
                   "sides (needs telemetry-instrumented entries)")
@@ -157,6 +166,10 @@ def _cmd_diff(args) -> int:
                 and "new_static_comm_bytes" not in r:
             print(f"   {r['series']}: static_comm_bytes not recorded on "
                   "both sides (needs perf.static_comm entries)")
+        if "sdc_overhead" in attr_sel and "new_sdc_overhead" not in r:
+            print(f"   {r['series']}: sdc_overhead not recorded on both "
+                  "sides (needs entries measured under the sdc + goodput "
+                  "blocks)")
     return 0
 
 
@@ -205,13 +218,18 @@ def _cmd_gate(args) -> int:
                 and "new_static_comm_bytes" not in r:
             missing.append(f"{k} (static_comm_bytes attribution)")
             continue
+        if "sdc_overhead" in attr_sel and "new_sdc_overhead" not in r:
+            missing.append(f"{k} (sdc_overhead attribution)")
+            continue
         checked.append(r)
         if r["verdict"] == "regression" or not r["new_value"] \
                 or r.get("goodput_regressed") \
                 or ("exposed_comm" in attr_sel
                     and r.get("exposed_comm_regressed")) \
                 or ("static_comm_bytes" in attr_sel
-                    and r.get("static_comm_regressed")):
+                    and r.get("static_comm_regressed")) \
+                or ("sdc_overhead" in attr_sel
+                    and r.get("sdc_overhead_regressed")):
             failures.append(r)
     if args.json:
         print(json.dumps({"checked": checked, "missing": missing,
@@ -231,7 +249,7 @@ def _cmd_gate(args) -> int:
                          + (" [REGRESSED]" if r.get("goodput_regressed")
                             else ""))
             print(line + _world_tag(r) + _exposed_line(r)
-                  + _static_comm_line(r))
+                  + _static_comm_line(r) + _sdc_overhead_line(r))
         for k in crashed:
             e = newest[k]
             print(f"FAIL {k}: newest run FAILED "
@@ -308,7 +326,11 @@ def main(argv=None) -> int:
                         "'static_comm_bytes' gates on the xray compiled-HLO "
                         "comm bill (lower is better; deterministic, so any "
                         "growth past tolerance + a 1MiB floor is a real "
-                        "schedule regression — no hardware needed)")
+                        "schedule regression — no hardware needed). "
+                        "'sdc_overhead' gates on the replay-audit cost as a "
+                        "fraction of wall (lower is better; absolute-point "
+                        "tolerance + a 0.5-point floor — the sdc sentry's "
+                        "defense must stay under audit_interval⁻¹ of wall)")
     g.add_argument("--all", action="store_true",
                    help="gate every series the two files share")
     g.add_argument("--allow-missing", action="store_true",

@@ -224,10 +224,18 @@ EXPOSED_COMM_FLOOR_US = 50.0
 # tiny fixture) should not fail CI.
 STATIC_COMM_FLOOR_BYTES = 1 << 20
 
+# SDC audit-overhead regression floor (absolute fraction points of wall):
+# the sdc sentry's contract is "the defense costs < audit_interval⁻¹ of
+# wall", so half a point of growth is noise on a short window but a
+# point of growth on a 100-step audit cadence means an audit got 2x
+# slower — real.
+SDC_OVERHEAD_FLOOR = 0.005
+
 # Attribution-level metrics `ds_perf gate/diff --metric` understands in
 # addition to series-key substrings: these select WHAT is compared (the
 # embedded attribution value), not WHICH series.
-ATTRIBUTION_METRICS = ("exposed_comm", "goodput", "static_comm_bytes")
+ATTRIBUTION_METRICS = ("exposed_comm", "goodput", "static_comm_bytes",
+                       "sdc_overhead")
 
 # Minimum per-side sample count for the t gate to carry a verdict: with
 # fewer, a failed significance test means "underpowered", not "noise",
@@ -382,6 +390,22 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         out["static_comm_delta_bytes"] = sn - so
         out["static_comm_regressed"] = (
             (sn - so) > max(rel_tol * max(so, 1.0), STATIC_COMM_FLOOR_BYTES))
+    # sdc_overhead rides the same way (stamped by the perf attribution
+    # from the goodput ledger's `audit` bucket when the sdc sentry is
+    # armed): LOWER is better — it is the wall-fraction the replay audits
+    # cost — judged in ABSOLUTE fraction points (it is already a ratio)
+    # with a floor, same shape as the goodput gate's drop test.
+    # `ds_perf gate --metric sdc_overhead` turns the flag into teeth.
+    ko = (old.get("attribution") or {}).get("sdc_overhead")
+    kn = (new.get("attribution") or {}).get("sdc_overhead")
+    if ko is not None and kn is not None:
+        ko, kn = float(ko), float(kn)
+        out["old_sdc_overhead"] = ko
+        out["new_sdc_overhead"] = kn
+        out["sdc_overhead_delta"] = kn - ko
+        out["sdc_overhead_regressed"] = (
+            (kn - ko) > max(rel_tol * max(ko, SDC_OVERHEAD_FLOOR),
+                            SDC_OVERHEAD_FLOOR))
     go, gn = old.get("goodput_fraction"), new.get("goodput_fraction")
     if go is not None and gn is not None:
         out["old_goodput"] = float(go)

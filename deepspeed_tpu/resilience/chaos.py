@@ -49,6 +49,18 @@ without a real TPU fault):
   post-event world — the ds_resize shrink/grow drills ("lose 2 of 8
   devices mid-run, keep training on 6") run on this.
 
+* ``bitflip`` (``bitflip_at``+``bitflip_rate`` scripted /
+  ``bitflip_rate`` alone randomized) — silent data corruption: XOR one
+  bit of the POST-step state (``bitflip_target`` picks
+  params|grads|opt_state; ``grads`` flips the freshly-updated params,
+  where a corrupted gradient manifests) on ONE device's shard/replica
+  (``bitflip_device``), at ``bitflip_bit`` of the element's bit
+  pattern. Deterministic per seed (a dedicated RNG stream, like
+  ``collective_mismatch``), fires once per injector when scripted, and
+  replicas are NOT kept coherent — exactly the marginal-chip failure
+  mode the ds_sentry replay audits exist to catch
+  (resilience/sdc.py).
+
 One fault class targets the STATIC analyzer instead of the runtime:
 ``collective_mismatch`` perturbs this rank's ds_doctor-recorded
 collective sequence (:meth:`ChaosInjector.perturb_collectives`), so the
@@ -109,7 +121,10 @@ class ChaosInjector:
                  grow_at: Optional[Dict[str, Sequence[int]]] = None,
                  grow_to: int = 0,
                  collective_mismatch: bool = False,
-                 collective_mismatch_rank: int = -1):
+                 collective_mismatch_rank: int = -1,
+                 bitflip_at: int = -1, bitflip_rate: float = 0.0,
+                 bitflip_target: str = "params", bitflip_device: int = 0,
+                 bitflip_bit: int = 12):
         self._rng = random.Random(seed)
         self.seed = seed
         self.source = "manual"      # "config" / "env": who installed it
@@ -133,6 +148,15 @@ class ChaosInjector:
         self.grow_to = int(grow_to)
         self.collective_mismatch = bool(collective_mismatch)
         self.collective_mismatch_rank = int(collective_mismatch_rank)
+        self.bitflip_at = int(bitflip_at)
+        self.bitflip_rate = float(bitflip_rate)
+        self.bitflip_target = str(bitflip_target)
+        self.bitflip_device = int(bitflip_device)
+        self.bitflip_bit = int(bitflip_bit)
+        self._bitflip_fired = False
+        # dedicated stream (like perturb_collectives): the flip pattern
+        # reproduces exactly regardless of what the I/O stream consumed
+        self._bitflip_rng = random.Random((seed << 8) ^ 0xB17F11)
         self._counts = defaultdict(int)
         self.log: list = []          # (op, action, path) — what actually fired
 
@@ -151,7 +175,12 @@ class ChaosInjector:
                            if cfg.grow_at_step >= 0 else None),
                   grow_to=cfg.grow_to,
                   collective_mismatch=cfg.collective_mismatch,
-                  collective_mismatch_rank=cfg.collective_mismatch_rank)
+                  collective_mismatch_rank=cfg.collective_mismatch_rank,
+                  bitflip_at=cfg.bitflip_at_step,
+                  bitflip_rate=cfg.bitflip_rate,
+                  bitflip_target=cfg.bitflip_target,
+                  bitflip_device=cfg.bitflip_device,
+                  bitflip_bit=cfg.bitflip_bit)
         inj.source = "config"
         return inj
 
@@ -292,6 +321,100 @@ class ChaosInjector:
             self.log.append((op, "fail", path))
             self._count(op, "fail")
             raise ChaosError(f"chaos: injected failure on {op} #{n} ({path})")
+
+    def bitflip_armed(self) -> bool:
+        """Does the bitflip fault class aim at the step loop? (Separate
+        from :meth:`targets` — the flip lands on device STATE, not an
+        op, so the engine gates the post-step hook on this.)"""
+        return self.bitflip_rate > 0.0
+
+    def perturb_state(self, state, step: int):
+        """``bitflip`` fault class: XOR one bit of the post-step state on
+        ONE device — the in-process stand-in for a marginal chip
+        corrupting a step's output. Returns the perturbed state pytree,
+        or None when nothing fired (not this step, rate draw missed,
+        scripted flip already spent, or the target device holds no shard
+        of the chosen leaf — e.g. it was quarantined out of the mesh).
+
+        The flip rebuilds ONLY the culprit device's buffer
+        (``make_array_from_single_device_arrays``), so a dp-REPLICATED
+        leaf ends with one divergent replica — replicas are never
+        verified to match, which is exactly the silent failure mode.
+        Leaf/element draws come from a DEDICATED seeded stream (like
+        ``perturb_collectives``); the default low-mantissa bit keeps
+        values finite so the bad-step sentinel cannot trip first — only
+        a bitwise check can see it."""
+        if not self.bitflip_armed():
+            return None
+        if self.bitflip_at >= 0:
+            # scripted: exactly once per injector — a rewound run
+            # re-treading the same step number must find it clean
+            if step != self.bitflip_at or self._bitflip_fired:
+                return None
+        rng = self._bitflip_rng
+        if rng.random() >= self.bitflip_rate:
+            return None
+        import jax
+        import numpy as np
+
+        # "grads" flips the freshly-updated params: the gradient itself
+        # is consumed inside the fused step, so a corrupted grad
+        # manifests exactly there
+        target = {"params": state.params, "grads": state.params,
+                  "opt_state": state.opt_state}[self.bitflip_target]
+        leaves = [l for l in jax.tree.leaves(target)
+                  if hasattr(l, "addressable_shards") and l.size > 0]
+        if not leaves:
+            return None
+        leaf = leaves[rng.randrange(len(leaves))]
+        all_devs = jax.devices()
+        if self.bitflip_device >= len(all_devs):
+            logger.warning(f"chaos: bitflip_device {self.bitflip_device} "
+                           f"beyond the backend's {len(all_devs)} device(s); "
+                           "skipping")
+            return None
+        dev = all_devs[self.bitflip_device]
+        shard = next((s for s in leaf.addressable_shards
+                      if s.device == dev), None)
+        if shard is None:
+            # the target chip is not in this run's mesh (quarantined /
+            # shrunk away) — a flip cannot land where no state lives
+            logger.info(f"chaos: bitflip target device {self.bitflip_device} "
+                        "holds no shard of the chosen leaf (not in the "
+                        "mesh?); skipping")
+            return None
+        a = np.array(np.asarray(shard.data), copy=True)
+        if a.size == 0:
+            return None
+        nbits = a.dtype.itemsize * 8
+        bit = min(self.bitflip_bit, nbits - 1)
+        elem = rng.randrange(a.size)
+        flat_bytes = a.reshape(-1).view(np.uint8)
+        flat_bytes[elem * a.dtype.itemsize + bit // 8] ^= np.uint8(
+            1 << (bit % 8))
+        bufs = []
+        for s in leaf.addressable_shards:
+            if s.device == dev:
+                bufs.append(jax.device_put(
+                    a, jax.sharding.SingleDeviceSharding(dev)))
+            else:
+                bufs.append(s.data)
+        new_leaf = jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, bufs)
+        flat, treedef = jax.tree_util.tree_flatten(state)
+        idx = next(i for i, l in enumerate(flat) if l is leaf)
+        flat[idx] = new_leaf
+        self._bitflip_fired = True
+        self.log.append(("train_state",
+                         f"bitflip dev{self.bitflip_device} "
+                         f"{self.bitflip_target} bit{bit} elem{elem}",
+                         f"step={step}"))
+        self._count("train_state", "bitflip")
+        logger.warning(
+            f"chaos: injected bitflip at step {step} — device "
+            f"{self.bitflip_device}, target {self.bitflip_target}, bit "
+            f"{bit}, element {elem} (silent: loss stays finite)")
+        return jax.tree_util.tree_unflatten(treedef, flat)
 
     def perturb_collectives(self, records: list, rank: Optional[int] = None) -> list:
         """``collective_mismatch`` fault class: deterministically perturb ONE

@@ -33,6 +33,15 @@ import numpy as np
 from deepspeed_tpu.utils.logging import logger
 
 
+# Version byte prefixed to every step-agreement row. Bump it whenever the
+# digest RECIPE changes (fields, order, encoding): a mixed-version fleet
+# would otherwise hash different tuples into honestly-different digests
+# and report a misleading "divergent rank" verdict — the proto check
+# names the real problem (software skew) before any majority vote runs.
+# v2: digest gained the ds_sentry ``extra`` checksum bytes.
+PROTO_VERSION = 2
+
+
 class DesyncError(RuntimeError):
     """Two ranks disagree on state that SPMD requires to be identical
     (config/topology/code at init; step counter, loss bits, or RNG key
@@ -63,13 +72,18 @@ def config_fingerprint(param_dict: dict, mesh=None, extra=None) -> str:
     return hashlib.sha256(blob).hexdigest()
 
 
-def step_digest(step: int, loss: float, rng_bytes: bytes = b"") -> str:
+def step_digest(step: int, loss: float, rng_bytes: bytes = b"",
+                extra: bytes = b"") -> str:
     """Digest of the per-step agreement tuple. ``loss`` is hashed as its
-    float32 BIT PATTERN (non-finite safe, sub-repr drift visible)."""
+    float32 BIT PATTERN (non-finite safe, sub-repr drift visible).
+    ``extra`` carries caller-supplied agreement bytes — the ds_sentry
+    online state checksum rides here, so dp-replicated STATE (not just
+    the loss scalar) must agree across ranks."""
     h = hashlib.sha256()
     h.update(np.int64(step).tobytes())
     h.update(np.float32(loss).tobytes())
     h.update(rng_bytes)
+    h.update(extra)
     return h.hexdigest()
 
 
@@ -84,15 +98,37 @@ def find_divergent(rows) -> List[int]:
 
 
 def _gather_rows(digest_hex: str) -> np.ndarray:
-    """Allgather this process's digest; returns (nproc, 32) uint8 rows.
+    """Allgather this process's digest; returns (nproc, 33) uint8 rows —
+    byte 0 is :data:`PROTO_VERSION`, bytes 1..32 the sha256 digest.
     (Factored out so tests can fabricate rosters without multiple hosts.)
     Routed through comm.allgather_host — the one sanctioned host-collective
     entry point (ds_doctor self-lint enforces this)."""
     from deepspeed_tpu.comm import comm as _comm
 
-    buf = np.frombuffer(bytes.fromhex(digest_hex), dtype=np.uint8)
+    buf = np.frombuffer(bytes([PROTO_VERSION]) + bytes.fromhex(digest_hex),
+                        dtype=np.uint8)
     rows = np.asarray(_comm.allgather_host(buf))
     return rows.reshape(-1, buf.size)
+
+
+def check_row_agreement(rows: np.ndarray, step: int) -> List[int]:
+    """The row-checking half of :func:`check_step_agreement`, factored so
+    tests can fabricate mixed-version rosters without multiple hosts.
+    Rows are (nproc, 33) uint8: version byte + digest. A version-column
+    disagreement raises ``desync(kind=proto)`` — software skew, not a
+    divergent rank — BEFORE any digest vote; otherwise returns the
+    divergent-rank indices of the digest columns."""
+    rows = np.asarray(rows, dtype=np.uint8)
+    versions = sorted({int(v) for v in rows[:, 0]})
+    if len(versions) > 1:
+        _count_desync("proto")
+        raise DesyncError(
+            f"cross-rank desync at step {step} (kind=proto): ranks are "
+            f"speaking agreement-protocol versions {versions} — this fleet "
+            "is running MIXED code versions, so digest differences would "
+            "be meaningless; align every host on one deepspeed_tpu "
+            "version before diagnosing state divergence")
+    return find_divergent(rows[:, 1:])
 
 
 def _count_desync(kind: str) -> None:
@@ -141,19 +177,22 @@ def verify_startup_consistency(param_dict: dict, mesh=None, extra=None,
     return fp
 
 
-def check_step_agreement(step: int, loss: float, rng=None) -> str:
+def check_step_agreement(step: int, loss: float, rng=None,
+                         extra: bytes = b"") -> str:
     """Every-N-steps agreement round on (step counter, loss bits, RNG-key
-    hash). Returns the digest; raises :class:`DesyncError` naming the
-    divergent rank(s) on mismatch. Single-process: digest only, no
-    collective."""
+    hash[, extra agreement bytes — the ds_sentry state checksum]).
+    Returns the digest; raises :class:`DesyncError` naming the
+    divergent rank(s) on mismatch, or ``desync(kind=proto)`` when the
+    fleet disagrees on the agreement protocol itself (mixed code
+    versions). Single-process: digest only, no collective."""
     import jax
 
     rng_bytes = b"" if rng is None else np.asarray(rng).tobytes()
-    digest = step_digest(step, loss, rng_bytes)
+    digest = step_digest(step, loss, rng_bytes, extra=extra)
     if jax.process_count() == 1:
         return digest
     rows = _gather_rows(digest)
-    bad = find_divergent(rows)
+    bad = check_row_agreement(rows, step)
     if bad:
         _count_desync("step_agreement")
         me = jax.process_index()

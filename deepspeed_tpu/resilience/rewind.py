@@ -68,7 +68,7 @@ class RamSnapshot:
     source (e.g. resetting to pretrained weights mid-process)."""
 
     __slots__ = ("step", "flat", "meta", "world", "ckpt_dir", "wall_ts",
-                 "nbytes")
+                 "nbytes", "checksum", "poisoned")
 
     def __init__(self, step: int, flat: Dict[str, np.ndarray], meta: dict,
                  world: dict, ckpt_dir: Optional[str] = None):
@@ -79,6 +79,12 @@ class RamSnapshot:
         self.ckpt_dir = ckpt_dir
         self.wall_ts = time.time()
         self.nbytes = sum(int(a.nbytes) for a in flat.values())
+        # ds_sentry poison-free ladder: folded checksum stamped at capture
+        # (when a checksummer hook is installed) and verified at restore;
+        # `poisoned` marks entries an SDC verdict condemned — the restore
+        # walk never serves them
+        self.checksum: Optional[int] = None
+        self.poisoned = False
 
 
 # The tier-0 ring is process-global ON PURPOSE: an in-process elastic
@@ -119,6 +125,11 @@ class RewindManager:
         self.last_recovery: Optional[dict] = None
         self._last_recovery_step: Optional[int] = None
         self._disabled_reason = None
+        # ds_sentry hook: a host-fold function stamping/verifying ring
+        # checksums (resilience/sdc.py installs it when armed). Default
+        # None keeps the ladder byte-for-byte unchanged — rewind never
+        # imports the sdc module.
+        self.checksummer = None
         import jax
 
         if jax.process_count() > 1:
@@ -188,6 +199,8 @@ class RewindManager:
             flat=flat, meta=capture_host_meta(eng),
             world=world_signature(eng),
             ckpt_dir=os.path.abspath(ckpt_dir) if ckpt_dir else None)
+        if self.checksummer is not None:
+            snap.checksum = self.checksummer(snap.flat)
         _RING.append(snap)
         del _RING[:-int(self.cfg.keep)]
         reg = _registry()
@@ -198,7 +211,12 @@ class RewindManager:
         return snap
 
     def newest(self) -> Optional[RamSnapshot]:
-        return _RING[-1] if _RING else None
+        """Newest non-poisoned ring entry (the emergency flush must never
+        persist a snapshot an SDC verdict condemned)."""
+        for snap in reversed(_RING):
+            if not snap.poisoned:
+                return snap
+        return None
 
     def has_ram_snapshot(self) -> bool:
         return self.active and bool(_RING)
@@ -243,6 +261,23 @@ class RewindManager:
         eng = self.engine
         for_dir = os.path.abspath(for_dir) if for_dir else None
         for snap in reversed(_RING):
+            if snap.poisoned:
+                logger.warning(
+                    f"rewind: RAM snapshot @step {snap.step} is marked "
+                    "poisoned (sdc verdict); skipping it")
+                _registry().counter("rewind/poisoned_skipped").inc()
+                continue
+            if snap.checksum is not None and self.checksummer is not None \
+                    and self.checksummer(snap.flat) != snap.checksum:
+                # the host copy itself rotted since capture (host-RAM
+                # corruption) — condemn it so later walks skip cheaply
+                snap.poisoned = True
+                logger.warning(
+                    f"rewind: RAM snapshot @step {snap.step} FAILED its "
+                    "checksum verify (host-side corruption since capture); "
+                    "marking poisoned and skipping it")
+                _registry().counter("rewind/poisoned_skipped").inc()
+                continue
             if for_dir is not None and snap.ckpt_dir is not None \
                     and snap.ckpt_dir != for_dir:
                 logger.warning(

@@ -446,6 +446,11 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
     ops: list = Field([], description="restrict injection to these ops (state_save/client_state/sampler_sidecar/manifest/latest/emergency_save/train_step/decode_step/collective); empty = all")
     collective_mismatch: bool = Field(False, description="perturb this rank's ds_doctor-recorded collective sequence (swap/mutate/phantom, seed-deterministic) so the static deadlock detector has a reproducible divergent rank to catch")
     collective_mismatch_rank: int = Field(-1, ge=-1, description="process whose recorded sequence is perturbed (-1 = every recording process)")
+    bitflip_at_step: int = Field(-1, ge=-1, description="silent-data-corruption drill (ds_sentry): at this train step, XOR one bit of the post-step state on bitflip_device — models a marginal chip corrupting the step's output; fires once even if the step is re-trodden after a rewind; -1 = off")
+    bitflip_rate: float = Field(0.0, ge=0.0, le=1.0, description="per-step probability of a bitflip (1.0 with bitflip_at_step = the deterministic acceptance drill; rate alone = the randomized sweep)")
+    bitflip_target: str = Field("params", description="which state tree the flip lands in: params | grads | opt_state (grads flips the freshly-updated params — a corrupted gradient manifests there)")
+    bitflip_device: int = Field(0, ge=0, description="addressable-device index whose shard/replica takes the flip (replicas are NOT kept coherent — exactly the failure mode)")
+    bitflip_bit: int = Field(12, ge=0, le=31, description="bit position in the 32-bit view of the chosen element (default low mantissa: values stay finite so the sentinel cannot trip first)")
 
     @model_validator(mode="after")
     def _fleet_drill_targets_set(self):
@@ -459,6 +464,17 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
             raise ValueError(
                 "resilience.chaos: grow_at_step is set but grow_to is "
                 f"{self.grow_to} — name the post-grow device count (>= 1)")
+        # an armed bitflip drill whose rate was left at the 0.0 default never
+        # fires — a typo, not a drill (same contract as shrink/grow above)
+        if self.bitflip_at_step >= 0 and self.bitflip_rate <= 0.0:
+            raise ValueError(
+                "resilience.chaos: bitflip_at_step is set but bitflip_rate "
+                f"is {self.bitflip_rate} — name the flip probability "
+                "(1.0 for a deterministic drill)")
+        if self.bitflip_target not in ("params", "grads", "opt_state"):
+            raise ValueError(
+                "resilience.chaos: bitflip_target must be 'params', 'grads' "
+                f"or 'opt_state', got {self.bitflip_target!r}")
         return self
 
 
@@ -748,6 +764,40 @@ class RewindConfig(DeepSpeedConfigModel):
     emergency_fresh: bool = Field(True, description="capture a fresh snapshot at the stop boundary before flushing (steps_lost 0) instead of flushing the possibly ram_interval-stale newest ring entry; false = flush-what-you-have, the fastest exit")
 
 
+class SdcConfig(DeepSpeedConfigModel):
+    """ds_sentry silent-data-corruption defense (resilience/sdc.py). The
+    failure mode every other robustness layer misses: a marginal chip
+    flips a bit mid-step, the loss stays finite and plausible, and the
+    corrupted state poisons every snapshot downstream while sentinel,
+    consistency and watchdog all stay green. TPUs are deterministic by
+    construction (one mesh, one device order, partitionable threefry),
+    so re-executing the SAME compiled step program on the SAME inputs
+    must match **bitwise** — any mismatch is hardware, not numerics.
+    The sentry spends that property three ways: (1) every
+    ``audit_interval`` steps it stashes the step's inputs device-side
+    and replays the already-compiled program, comparing outputs
+    per-device; (2) a cheap folded integer checksum of the updated
+    state rides every step (one fused reduction, like the grad norm)
+    and is crossed through the watchdog's ``check_step_agreement``
+    allgather so dp-replicated ranks must agree; (3) on a verdict, a
+    bisection harness blames the culprit device, the tier-0 ring
+    entries newer than the last audited-clean step are marked poisoned,
+    and the culprit is quarantined out of the survivor mesh (elastic
+    evict-reshard) or the run rewinds to the newest clean snapshot.
+    Audit cost is priced as the goodput ``audit`` badput bucket —
+    bounded by construction at ~1/audit_interval of wall — and gated
+    by ``ds_perf gate`` as ``sdc_overhead``. STRICT no-op when the
+    block is absent: the module is never imported and the lowered step
+    HLO is byte-identical (asserted in tests). See docs/CONFIG.md
+    'sdc' section for the detection-latency/overhead table."""
+    enabled: bool = Field(True, description="arm the sentry (the block being present opts in; set false to keep the block but skip the work)")
+    audit_interval: int = Field(50, gt=0, description="replay-audit every N steps — the detection-latency bound AND the overhead bound (audit badput ≈ 1/N of wall)")
+    checksum: bool = Field(True, description="fold a per-step integer checksum of the updated state into the step program (rides the metrics; crossed through check_step_agreement when the watchdog consistency cadence is armed)")
+    quarantine: bool = Field(True, description="on a verdict, evict the blamed device via the elastic resize path (FleetResizeEvent, resumed resharded on survivors); false or resize unarmed = rewind-only recovery")
+    ring_verify: bool = Field(True, description="stamp the folded checksum on tier-0 RAM snapshots at capture and verify it on restore — a poisoned ring entry is skipped, never restored")
+    max_verdicts: int = Field(2, ge=0, description="SDC verdicts tolerated before giving up with SdcError (matches the sentinel's max_rewinds contract)")
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Verified checkpoints + recovery policy (resilience/ package). See
     docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
@@ -827,6 +877,11 @@ class DeepSpeedConfig:
         # (never imported; the overlap scan and lowered HLO byte-identical)
         self.wire = WireConfig(**pd.get("wire", {}))
         self.wire_present = "wire" in pd
+        # presence matters, same contract again: no block, no sdc module
+        # (never imported; the step metrics carry no checksum and the
+        # lowered step HLO is byte-identical)
+        self.sdc = SdcConfig(**pd.get("sdc", {}))
+        self.sdc_present = "sdc" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -894,7 +949,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "rewind", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wire", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wire", "sdc", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

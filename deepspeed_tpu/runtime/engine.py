@@ -64,6 +64,11 @@ class StepMetrics(NamedTuple):
     lr: jnp.ndarray
     loss_scale: jnp.ndarray
     overflow: jnp.ndarray
+    # ds_sentry online state checksum (uint32 fold of the updated
+    # params/opt_state) — None unless the `sdc` block arms it; a None
+    # field is an EMPTY pytree node, so the absent-block step program
+    # traces and lowers byte-identically
+    checksum: Any = None
 
 
 def _index_tag(index, shape) -> str:
@@ -599,6 +604,18 @@ class DeepSpeedEngine:
             from deepspeed_tpu.goodput.recorder import GoodputMeter
 
             self._goodput = GoodputMeter(self._config.goodput, engine=self)
+        # ---- sdc sentry (ds_sentry) ---------------------------------------
+        # silent-data-corruption defense (resilience/sdc.py): replay
+        # audits on TPU determinism, online state checksums, per-device
+        # blame, quarantine-and-evict, poison-free snapshot ladder.
+        # STRICT no-op when the ``sdc`` block is absent: the module is
+        # never imported, the step metrics carry no checksum, and the
+        # lowered step HLO is byte-identical (asserted in tests).
+        self._sdc = None
+        if self._config.sdc_present and self._config.sdc.enabled:
+            from deepspeed_tpu.resilience.sdc import SdcManager
+
+            self._sdc = SdcManager(self, self._config.sdc)
         self._flops_probe = None
         dist.configure(self._config)
         self.flops_profiler_cfg = self._config.flops_profiler_config
@@ -1242,6 +1259,14 @@ class DeepSpeedEngine:
         abstract re-trace, so the collective fingerprints see the same
         schedule the engine compiles)."""
         overlap = self._overlap
+        # ds_sentry online checksum: one extra fused reduction riding the
+        # step (like the grad norm). Resolved at BUILD time so the
+        # absent-block trace is byte-identical (the sdc module is never
+        # imported without its config block).
+        sdc_fold = None
+        sdc = getattr(self, "_sdc", None)
+        if sdc is not None and sdc.checksum_armed:
+            from deepspeed_tpu.resilience.sdc import fold_state as sdc_fold
 
         def step_fn(state: TrainState, batch):
             scale = state.scaler.scale if state.scaler is not None else jnp.float32(1.0)
@@ -1251,6 +1276,9 @@ class DeepSpeedEngine:
                 with overlap.scan_context():
                     mean_loss, grads = self._accumulated_loss_grads(state, batch, gas, scale)
             new_state, metrics = self._apply_grads(state, grads, mean_loss)
+            if sdc_fold is not None:
+                metrics = metrics._replace(checksum=sdc_fold(
+                    (new_state.params, new_state.opt_state)))
             return new_state, metrics
 
         return step_fn
@@ -1585,8 +1613,13 @@ class DeepSpeedEngine:
             from deepspeed_tpu.resilience.consistency import \
                 check_step_agreement
 
+            # ds_sentry: cross the online state checksum through the
+            # agreement round too — dp-replicated STATE, not just the
+            # loss scalar, must agree across hosts
+            extra = (self._sdc.agreement_bytes(self._last_metrics)
+                     if self._sdc is not None else b"")
             check_step_agreement(self._host_step, float(loss),
-                                 rng=self.state.rng)
+                                 rng=self.state.rng, extra=extra)
         return loss
 
     def _run_step_analysis(self, batch, gas):
@@ -1621,6 +1654,12 @@ class DeepSpeedEngine:
     def _train_batch_instrumented(self, batch, gas):
         with _telemetry.get_tracer().span("train_batch",
                                           step=getattr(self, "_host_step", 0)):
+            if self._sdc is not None:
+                # audit-interval steps stash a device-side copy of the
+                # pre-step state + batch so after_step can replay the
+                # exact step against the same compiled program
+                self._sdc.maybe_stash(
+                    getattr(self, "_host_step", 0) + 1, batch, gas)
             if self._nvme_optimizer is not None:
                 metrics = self._train_batch_nvme(batch, gas)
             elif self._onebit:
@@ -1645,6 +1684,20 @@ class DeepSpeedEngine:
             self._post_step(metrics)
             if self._bad_step_sentinel is not None:
                 self._check_bad_step(metrics)
+            from deepspeed_tpu.resilience import chaos as _chaos_mod
+
+            _inj = _chaos_mod.active_injector()
+            if _inj is not None and _inj.bitflip_armed():
+                # chaos `bitflip` fault class: corrupt the post-step state
+                # BEFORE the sdc audit looks at it — exactly the window a
+                # real cosmic-ray flip lands in
+                _flipped = _inj.perturb_state(self.state, self._host_step)
+                if _flipped is not None:
+                    self.state = _flipped
+            if self._sdc is not None:
+                # replay audit + blame; may raise FleetResizeEvent
+                # (quarantine-and-evict) or rewind the engine in place
+                self._sdc.after_step(self._host_step, metrics)
             if self._rewind is not None:
                 # AFTER the sentinel: a step the sentinel flagged (or a
                 # rewound-to step) must not enter the tier-0 ring

@@ -365,8 +365,9 @@ class TestConsistencyGuard:
 
         monkeypatch.setattr(jax, "process_count", lambda: 4)
         monkeypatch.setattr(jax, "process_index", lambda: 0)
-        good = np.frombuffer(bytes.fromhex(cons.step_digest(7, 2.0, b"k")), np.uint8)
-        bad = np.frombuffer(bytes.fromhex(cons.step_digest(7, 2.5, b"k")), np.uint8)
+        ver = bytes([cons.PROTO_VERSION])
+        good = np.frombuffer(ver + bytes.fromhex(cons.step_digest(7, 2.0, b"k")), np.uint8)
+        bad = np.frombuffer(ver + bytes.fromhex(cons.step_digest(7, 2.5, b"k")), np.uint8)
         monkeypatch.setattr(cons, "_gather_rows",
                             lambda d: np.stack([good, good, bad, good]))
         with pytest.raises(cons.DesyncError, match=r"rank\(s\) \[2\]"):
@@ -519,7 +520,8 @@ class TestEngineIntegration:
         calls = []
         real = cons.check_step_agreement
         monkeypatch.setattr(cons, "check_step_agreement",
-                            lambda step, loss, rng=None: calls.append(step) or real(step, loss, rng=rng))
+                            lambda step, loss, rng=None, extra=b"":
+                            calls.append(step) or real(step, loss, rng=rng, extra=extra))
         engine = _engine(watchdog={"enabled": True, "min_step_timeout": 30.0,
                                    "startup_timeout": 300.0,
                                    "consistency_interval": 2})

@@ -520,6 +520,11 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         # of its own timed window, and ds_perf gate gates the resulting
         # goodput_fraction alongside the headline
         ds_config["goodput"] = {}
+        # analytic roofline of the compiled step: every entry hoists
+        # mfu_ceiling + mfu_gap (= ceiling − measured), the number
+        # `ds_perf gate --metric mfu_gap` regresses on. One memoized AOT
+        # compile per program — same cost shape as perf.static_comm.
+        ds_config["roofline"] = {}
     if SMOKE:
         # the CPU dry run also drives the rewind ladder's tier-0 ring
         # (snapshots every step at this size), so a broken snapshot path
@@ -712,15 +717,39 @@ def serving_line(on_tpu: bool, n_dev: int) -> dict:
         config.head_dim * jnp.dtype(config.dtype).itemsize
     bw = get_accelerator().memory_bandwidth()
     mbu = (param_bytes + kv_bytes) / n_dev / (bw * t_step)
-    return _structured({
+    line = {
         "metric": f"{name} serving decode (B={B}, prompt={prompt}, gen={gen}, "
                   f"{n_dev} chip(s), {serve_dtype}, tok/s/chip={tok_s:.0f}, "
                   f"prefill={t_pre1*1e3:.0f}ms, decode MBU={mbu:.3f})",
         "value": round(tok_s, 1),
         "unit": "decode-tok/s/chip",
         "vs_baseline": round(mbu, 4),
-    }, model=name, config={"B": B, "prompt": prompt, "gen": gen,
-                           "dtype": serve_dtype, "n_dev": n_dev})
+    }
+    if PERF:
+        # analytic MBU ceiling of this decode step: the bandwidth-bound
+        # roofline model sized from the SAME KV-census bytes the measured
+        # MBU credits (weights once + live KV per tick), capped by the
+        # chip's compute axis at this batch. mbu_gap = ceiling − measured
+        # is the decode line's roofline attribution (ROADMAP Item 5's
+        # 0.674 debt finally has a ceiling to gap against).
+        try:
+            from deepspeed_tpu.analysis import chips as _chips
+            from deepspeed_tpu.analysis.roofline import decode_mbu_ceiling
+
+            dev = jax.local_devices()[0]
+            chip = _chips.detect_chip_name(
+                getattr(dev, "device_kind", ""), dev.platform)
+            mbu_ceiling = decode_mbu_ceiling(
+                (param_bytes + kv_bytes) / n_dev,
+                flops=2.0 * config.num_params() * B / n_dev, chip=chip)
+            line["mbu"] = round(mbu, 4)
+            line["mbu_ceiling"] = round(mbu_ceiling, 4)
+            line["mbu_gap"] = round(max(0.0, mbu_ceiling - mbu), 4)
+        except Exception as e:
+            print(f"# decode roofline failed: {e}", file=sys.stderr)
+    return _structured(line, model=name,
+                       config={"B": B, "prompt": prompt, "gen": gen,
+                               "dtype": serve_dtype, "n_dev": n_dev})
 
 
 def rlhf_line(on_tpu: bool, n_dev: int) -> dict:

@@ -1,0 +1,107 @@
+"""Per-chip peak tables for the analytic roofline (``ds_roofline``).
+
+One frozen :class:`ChipSpec` per TPU generation — peak matmul FLOP/s
+(bf16 systolic-array number; fp32 halves, same convention as
+``accelerator/tpu_accelerator.py``) and peak HBM bytes/s — plus a
+``cpu-sim`` entry so the simulated CPU meshes every tier-1 test runs on
+get finite MFU/MBU math. The NUMBERS ARE THE SAME DICTS as
+``tpu_accelerator._PEAK_FLOPS`` / ``_PEAK_HBM_BW`` restated without the
+jax import: this module must stay pure stdlib so ``bin/ds_roofline``
+can price a saved ``.hlo`` dump on a machine with no jax at all (the
+``ds_prof`` contract).
+
+Adding a chip = adding one ``ChipSpec`` line here (plus, for live
+detection, the matching entry in ``tpu_accelerator``'s dicts). Keep the
+two in sync — ``tests/unit/test_roofline.py`` cross-checks them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+__all__ = ["ChipSpec", "CHIPS", "ALIASES", "known_chips", "resolve_chip",
+           "detect_chip_name"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Peak envelope of one chip generation (per chip, not per pod)."""
+
+    name: str             # canonical key in CHIPS
+    peak_flops: float     # bf16 matmul peak, FLOP/s
+    hbm_bytes_per_s: float
+    hbm_bytes: int        # HBM capacity, bytes
+    note: str = ""
+
+    def peak_flops_for(self, dtype: Optional[str] = None) -> float:
+        """Peak for a dtype string — fp32 runs the MXU at half rate
+        (same convention as ``TPU_Accelerator.peak_flops``)."""
+        if dtype and str(dtype).lower() in ("f32", "fp32", "float32"):
+            return self.peak_flops / 2.0
+        return self.peak_flops
+
+    def ridge_flops_per_byte(self) -> float:
+        """Arithmetic intensity (FLOPs/byte) above which a region is
+        compute-bound on this chip."""
+        if self.hbm_bytes_per_s <= 0:
+            return float("inf")
+        return self.peak_flops / self.hbm_bytes_per_s
+
+
+_GIB = 1024 ** 3
+
+# Canonical table. FLOPs/BW numbers mirror tpu_accelerator.py exactly.
+CHIPS: Dict[str, ChipSpec] = {
+    "v2": ChipSpec("v2", 45e12, 700e9, 8 * _GIB, "TPU v2 core"),
+    "v3": ChipSpec("v3", 123e12, 900e9, 16 * _GIB, "TPU v3 core"),
+    "v4": ChipSpec("v4", 275e12, 1228e9, 32 * _GIB, "TPU v4"),
+    "v5e": ChipSpec("v5e", 197e12, 819e9, 16 * _GIB, "TPU v5e (lite)"),
+    "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 * _GIB, "TPU v5p"),
+    "v6e": ChipSpec("v6e", 918e12, 1640e9, 32 * _GIB, "TPU v6e (Trillium)"),
+    # nominal envelope for the simulated CPU meshes of tier-1 tests —
+    # keeps MFU/MBU finite, matches tpu_accelerator's "cpu" entry
+    "cpu-sim": ChipSpec("cpu-sim", 1e12, 100e9, 64 * _GIB,
+                        "simulated CPU mesh (nominal)"),
+}
+
+ALIASES: Dict[str, str] = {
+    "v5lite": "v5e",
+    "v5litepod": "v5e",
+    "v5": "v5p",
+    "v6": "v6e",
+    "cpu": "cpu-sim",
+    "cpu_sim": "cpu-sim",
+    "host": "cpu-sim",
+}
+
+
+def known_chips() -> Tuple[str, ...]:
+    return tuple(sorted(CHIPS))
+
+
+def resolve_chip(name: str) -> ChipSpec:
+    """Chip spec for ``name`` (canonical or alias, case-insensitive).
+    Raises ``KeyError`` naming the known chips — the schema cross-field
+    check turns that into a config-time finding."""
+    key = (name or "").strip().lower().replace(" ", "")
+    key = ALIASES.get(key, key)
+    if key not in CHIPS:
+        raise KeyError(
+            f"unknown chip {name!r}; known: {', '.join(known_chips())} "
+            f"(aliases: {', '.join(sorted(ALIASES))})")
+    return CHIPS[key]
+
+
+def detect_chip_name(device_kind: str, platform: str = "") -> str:
+    """Best-effort chip name from a jax ``device.device_kind`` string
+    (e.g. ``"TPU v5 lite"``) — same matching order as
+    ``tpu_accelerator._detect_generation``, but on plain strings so
+    callers need no jax. Falls back to ``cpu-sim``."""
+    kind = (device_kind or "").lower().replace(" ", "")
+    for key in ("v6e", "v6", "v5p", "v5lite", "v5e", "v5", "v4", "v3", "v2"):
+        if key in kind:
+            return ALIASES.get(key, key)
+    if platform and platform.lower() != "cpu":
+        return "v5e"  # unknown TPU-ish platform: the conservative guess
+    return "cpu-sim"

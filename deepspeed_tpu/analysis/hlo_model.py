@@ -15,7 +15,19 @@ need into data:
   output index aliases which flat parameter (the compiled truth behind
   every ``donate_argnums`` promise);
 * the **entry layout** — flat parameter/result shapes, so alias and
-  donation findings can talk in bytes.
+  donation findings can talk in bytes;
+* the **compute regions** (ds_roofline): every dot / convolution /
+  fusion / costed instruction in every non-fused computation, with
+  analytic FLOPs and HBM bytes-accessed. The counting conventions
+  deliberately MATCH XLA's ``HloCostAnalysis`` (what
+  ``compiled.cost_analysis()`` reports) so the regex model and the live
+  compiler agree on the same program: dot = 2·result_elems·contract;
+  elementwise = 1 flop/element; transcendentals (tanh/exp/…) counted
+  separately, NOT as flops; reduce = in_elems − out_elems; while bodies
+  counted ONCE (trip counts are invisible to both sides — ratios like
+  MFU ceilings are invariant to that shared undercount); a fusion's
+  flops are its called computation's, its bytes are its EXTERNAL
+  operands + results (fusion internals never touch HBM).
 
 Everything here is regex-over-text on purpose: the HLO text format is the
 one stable cross-version surface (jax's python bindings for these
@@ -31,8 +43,9 @@ import math
 import re
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["CollectiveOp", "HloModel", "parse_hlo_module",
-           "parse_replica_groups", "shape_bytes", "collective_wire_bytes"]
+__all__ = ["CollectiveOp", "ComputeOp", "HloModel", "parse_hlo_module",
+           "parse_replica_groups", "shape_bytes", "shape_elements",
+           "collective_wire_bytes"]
 
 # HLO primitive bytes per element (pred is byte-packed in practice)
 _DTYPE_BYTES = {
@@ -91,6 +104,62 @@ class CollectiveOp:
         return "{}"
 
 
+# --------------------------------------------------------------- cost model
+# Elementwise opcodes that cost 1 flop per result element in
+# HloCostAnalysis (the probe-calibrated set; add/maximum/multiply/divide
+# and convert verified numerically against compiled.cost_analysis() on
+# cpu jax). convert matters a LOT: a mixed-precision ZeRO-3 step carries
+# millions of bf16<->f32 cast elements, and omitting it put the regex
+# model ~16% under XLA's count on the gpt2 fixture.
+_FLOP1_OPS = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "compare", "negate", "abs", "sign", "floor", "ceil", "remainder",
+    "round-nearest-afz", "round-nearest-even", "clamp", "select",
+    "and", "or", "xor", "not", "shift-left", "shift-right-logical",
+    "shift-right-arithmetic", "is-finite", "clz", "popcnt",
+    "convert", "bitcast-convert", "reduce-precision",
+    "stochastic-convert",
+})
+# Counted as TRANSCENDENTALS per element, never flops (verified:
+# tanh/exp contribute to cost_analysis()['transcendentals'] only).
+_TRANSCENDENTAL_OPS = frozenset({
+    "exponential", "exponential-minus-one", "log", "log-plus-one",
+    "tanh", "logistic", "sqrt", "rsqrt", "cbrt", "sine", "cosine",
+    "tan", "power", "atan2", "erf", "exp", "expm1",
+})
+# Free on both axes: no arithmetic, no HBM traffic of their own (XLA
+# zeroes these in HloCostAnalysis — buffer bookkeeping, or control flow
+# whose bodies are counted as separate computations).
+_ZERO_COST_OPS = frozenset({
+    "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
+    "after-all", "partition-id", "replica-id", "while", "conditional",
+    "call", "opt-barrier", "domain",
+})
+
+_LHS_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_DIM_LABELS_RE = re.compile(r"dim_labels=([0-9a-zA-Z?]+)_([0-9a-zA-Z?]+)->")
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+_COMP_HEADER_RE = re.compile(
+    r"^\s*(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->\s*\S.*\{\s*$")
+
+
+@dataclasses.dataclass
+class ComputeOp:
+    """One costed instruction of a non-fused computation (roofline
+    region): a dot, convolution, fusion, collective, or any other op
+    with nonzero analytic flops / transcendentals / HBM bytes."""
+
+    name: str                 # %instruction name
+    opcode: str               # dot | convolution | fusion | ...
+    computation: str          # enclosing computation (ENTRY, while body…)
+    flops: int = 0            # fusion: its called computation's flops
+    transcendentals: int = 0  # per-element transcendental count
+    bytes: int = 0            # HBM model: operand bytes + result bytes
+    result_bytes: int = 0
+    metadata_op: str = ""     # op_name= from metadata
+    source_line: str = ""     # source_file:source_line
+
+
 @dataclasses.dataclass
 class HloModel:
     """The xray-relevant slices of one compiled HLO module."""
@@ -103,9 +172,26 @@ class HloModel:
     aliases: Dict[int, int] = dataclasses.field(default_factory=dict)
     parameter_bytes: List[int] = dataclasses.field(default_factory=list)
     result_bytes: List[int] = dataclasses.field(default_factory=list)
+    # costed instructions of every NON-fused computation, textual order
+    # (fused computations are rolled into their fusion instruction)
+    compute_ops: List[ComputeOp] = dataclasses.field(default_factory=list)
 
     def aliased_parameters(self) -> set:
         return set(self.aliases.values())
+
+    def total_flops(self) -> int:
+        """HloCostAnalysis-convention module flops (while bodies once,
+        transcendentals excluded) — the number the live
+        ``compiled.cost_analysis()['flops']`` cross-check compares to."""
+        return sum(op.flops for op in self.compute_ops)
+
+    def total_transcendentals(self) -> int:
+        return sum(op.transcendentals for op in self.compute_ops)
+
+    def total_bytes_accessed(self) -> int:
+        """Σ per-instruction (operand + result) bytes — the HBM-traffic
+        model the roofline's memory axis prices."""
+        return sum(op.bytes for op in self.compute_ops)
 
     def comm_bytes_by_kind(self) -> Dict[str, int]:
         """Per-kind WIRE bytes (per participating device, ring model)."""
@@ -139,6 +225,38 @@ def shape_bytes(shape_text: str) -> int:
                     n *= int(d)
         total += n * _DTYPE_BYTES[dtype]
     return total
+
+
+def shape_elements(shape_text: str) -> int:
+    """Total element count of an HLO shape string (tuples summed)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_text):
+        if m.group(1) not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n
+    return total
+
+
+def _shape_dims(text: str) -> List[List[int]]:
+    """Dims of every shape literal in ``text``, in order (``f32[8,64]``
+    -> ``[8, 64]``; scalars -> ``[]``)."""
+    out = []
+    for m in _SHAPE_RE.finditer(text):
+        if m.group(1) not in _DTYPE_BYTES:
+            continue
+        out.append([int(d) for d in m.group(2).split(",") if d])
+    return out
+
+
+def _prod(dims) -> int:
+    n = 1
+    for d in dims:
+        n *= d
+    return n
 
 
 # ---------------------------------------------------------- replica groups
@@ -269,12 +387,86 @@ def _alias_output_index(idx_text: str, result_arity: int) -> Optional[int]:
     return idx[0]
 
 
+def _args_segment(line: str, open_pos: int) -> str:
+    """The operand list between the opcode's ``(`` at ``open_pos`` and
+    its balanced ``)`` — attributes/metadata after it are excluded, so
+    shape-looking text inside ``op_name="…"`` never pollutes operand
+    byte counts."""
+    depth = 0
+    for j in range(open_pos, len(line)):
+        if line[j] == "(":
+            depth += 1
+        elif line[j] == ")":
+            depth -= 1
+            if depth == 0:
+                return line[open_pos + 1:j]
+    return line[open_pos + 1:]
+
+
+def _instr_cost(opcode: str, shape_text: str, args: str, attrs: str):
+    """(flops, transcendentals, bytes, result_bytes) of one instruction
+    under the HloCostAnalysis conventions (module docstring). Fusions
+    return 0 flops here — their called computation is resolved by the
+    caller. Unknown opcodes cost 0 flops but still move their bytes."""
+    if opcode in _ZERO_COST_OPS:
+        return 0, 0, 0, 0
+    if opcode.endswith(_SKIP_SUFFIX):       # async -done: bookkeeping
+        return 0, 0, 0, 0
+    if opcode.endswith("-start"):
+        # async tuple carries operand AND result — count the result only
+        shape_text = _tuple_elements(shape_text)[-1]
+        result_bytes = shape_bytes(shape_text)
+        return 0, 0, result_bytes + shape_bytes(args), result_bytes
+    result_bytes = shape_bytes(shape_text)
+    nbytes = result_bytes + shape_bytes(args)
+    elems = shape_elements(shape_text)
+    if opcode == "dot":
+        contract = 1
+        cm = _LHS_CONTRACT_RE.search(attrs)
+        operand_dims = _shape_dims(args)
+        lhs = operand_dims[0] if operand_dims else []
+        if cm and lhs:
+            for d in (int(x) for x in cm.group(1).split(",") if x):
+                if d < len(lhs):
+                    contract *= lhs[d]
+        elif lhs:                            # unannotated: last dim
+            contract = lhs[-1] if lhs else 1
+        return 2 * elems * contract, 0, nbytes, result_bytes
+    if opcode == "convolution":
+        operand_dims = _shape_dims(args)
+        kernel = operand_dims[1] if len(operand_dims) > 1 else []
+        macs_per_out = _prod(kernel)
+        dm = _DIM_LABELS_RE.search(attrs)
+        if dm and kernel:
+            o_pos = dm.group(2).find("o")
+            if 0 <= o_pos < len(kernel) and kernel[o_pos]:
+                macs_per_out //= kernel[o_pos]
+        return 2 * elems * max(1, macs_per_out), 0, nbytes, result_bytes
+    if opcode in ("reduce", "reduce-window"):
+        in_elems = 0
+        od = _shape_dims(args)
+        if od:
+            in_elems = _prod(od[0])
+        return max(0, in_elems - elems), 0, nbytes, result_bytes
+    if opcode in _TRANSCENDENTAL_OPS:
+        return 0, elems, nbytes, result_bytes
+    if opcode in _FLOP1_OPS:
+        return elems, 0, nbytes, result_bytes
+    return 0, 0, nbytes, result_bytes
+
+
 def parse_hlo_module(text: str) -> HloModel:
     """Parse one compiled HLO module's text into an :class:`HloModel`.
 
     Only the ENTRY computation's collectives are scheduled program order;
     collectives inside fusions/called computations (rare post-scheduling)
-    are still counted, in textual order."""
+    are still counted, in textual order.
+
+    Compute regions: instructions are grouped by enclosing computation;
+    fused computations (targets of a fusion's ``calls=``) contribute
+    their flops to the fusion instruction and NOTHING to bytes — every
+    other computation (ENTRY, while bodies, branches) contributes its
+    instructions as regions directly, counted once."""
     model = HloModel()
     lines = text.splitlines()
     if lines:
@@ -300,11 +492,46 @@ def parse_hlo_module(text: str) -> HloModel:
                     model.aliases[out_idx] = int(entry.group(2))
 
     order = 0
+    current_comp = ""
+    comp_order: List[str] = []
+    # per computation: [(ComputeOp, calls_target_or_None), ...]
+    comp_records: Dict[str, list] = {}
     for line in lines[1:]:
         im = _INSTR_RE.match(line)
         if im is None:
+            hm = _COMP_HEADER_RE.match(line)
+            if hm and " = " not in line:
+                current_comp = hm.group(2)
+                if current_comp not in comp_records:
+                    comp_order.append(current_comp)
+                    comp_records[current_comp] = []
             continue
         name, shape_text, opcode = im.group(1), im.group(2), im.group(3)
+
+        # ---- compute region (roofline) --------------------------------
+        args = _args_segment(line, im.end() - 1)
+        attrs = line[im.end() - 1 + len(args) + 2:]
+        flops, trans, nbytes, rbytes = _instr_cost(
+            opcode, shape_text, args, attrs)
+        calls = None
+        if opcode == "fusion":
+            cm2 = _CALLS_RE.search(attrs)
+            calls = cm2.group(1) if cm2 else None
+        if flops or trans or nbytes or calls:
+            mo2 = _META_OP_RE.search(line)
+            ms2 = _META_SRC_RE.search(line)
+            if current_comp not in comp_records:
+                comp_order.append(current_comp)
+                comp_records[current_comp] = []
+            comp_records[current_comp].append((ComputeOp(
+                name=name, opcode=opcode, computation=current_comp,
+                flops=flops, transcendentals=trans, bytes=nbytes,
+                result_bytes=rbytes,
+                metadata_op=mo2.group(1) if mo2 else "",
+                source_line=(f"{ms2.group(1)}:{ms2.group(2)}"
+                             if ms2 else "")), calls))
+
+        # ---- collectives ----------------------------------------------
         kind = None
         for k in COLLECTIVE_KINDS:
             if opcode == k:
@@ -341,6 +568,30 @@ def parse_hlo_module(text: str) -> HloModel:
             metadata_op=mo.group(1) if mo else "",
             source_line=(f"{ms.group(1)}:{ms.group(2)}" if ms else "")))
         order += 1
+
+    # ---- resolve fusions, assemble regions --------------------------------
+    # Callee computations print before their callers, so one in-order pass
+    # resolves fusion flops; an unresolvable calls= costs 0, never raises.
+    fusion_targets = set()
+    comp_flops: Dict[str, int] = {}
+    comp_trans: Dict[str, int] = {}
+    for comp in comp_order:
+        f = t = 0
+        for op, calls in comp_records[comp]:
+            if calls:
+                fusion_targets.add(calls)
+                op.flops = comp_flops.get(calls, 0)
+                op.transcendentals = comp_trans.get(calls, 0)
+            f += op.flops
+            t += op.transcendentals
+        comp_flops[comp] = f
+        comp_trans[comp] = t
+    for comp in comp_order:
+        if comp in fusion_targets:
+            continue  # rolled into its fusion instruction
+        for op, _calls in comp_records[comp]:
+            if op.flops or op.transcendentals or op.bytes:
+                model.compute_ops.append(op)
     return model
 
 

@@ -55,6 +55,7 @@ def _block_models() -> Dict[str, type]:
         "profiling": C.ProfilingConfig, "perf": C.PerfConfig,
         "serving": C.ServingConfig, "goodput": C.GoodputConfig,
         "overlap": C.OverlapConfig, "wire": C.WireConfig,
+        "roofline": C.RooflineConfig,
         "compression_training": CompressionConfig,
     }
 
@@ -307,6 +308,27 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "(fine for drills and the static_comm_bytes accounting; "
                 "the wall-clock win shows on multi-host fleets)",
                 "wire.secondary_partition vs tpu.ici")
+    roof = cfg.roofline
+    if "roofline" in pd and roof.enabled:
+        chip = (roof.chip or "").strip()
+        if chip and chip != "auto":
+            from deepspeed_tpu.analysis import chips as _chips
+            try:
+                _chips.resolve_chip(chip)
+            except KeyError:
+                add("error",
+                    f"roofline.chip={chip!r} is not in the "
+                    "analysis/chips.py peak table — the pass would raise "
+                    f"at its first report; known: "
+                    f"{', '.join(_chips.known_chips())} (or 'auto')",
+                    "roofline.chip vs analysis/chips.py")
+        if "perf" not in pd:
+            add("warning",
+                "roofline without the perf block: the pass runs and logs "
+                "its report, but mfu_ceiling/mfu_gap never land in a "
+                "ledger entry — `ds_perf gate --metric mfu_gap` will exit "
+                "3 (missing) on every run (add \"perf\": {})",
+                "roofline vs perf")
     rw = cfg.rewind
     if "rewind" in pd and rw.enabled:
         if not cfg.resilience.verify_on_load:

@@ -418,6 +418,16 @@ def main(args=None):
         from deepspeed_tpu.analysis.cli import xray_cli
 
         return xray_cli(args[1:])
+    if args and args[0] == "roofline":
+        # `ds_report roofline report --hlo DUMP | --config X` — the
+        # analytic roofline (per-region FLOPs/bytes, MFU ceilings); the
+        # full tool is `bin/ds_roofline`, which also runs jax-free
+        from deepspeed_tpu.analysis.roofline import roofline_cli
+
+        rest = args[1:]
+        if not rest or rest[0].startswith("-"):
+            rest = ["report"] + rest
+        return roofline_cli(rest)
     line = "-" * 72
     print(line)
     print("deepspeed_tpu environment report")

@@ -165,6 +165,38 @@ def render_sdc_line(gauges: Dict[str, float],
     return "  ".join(parts)
 
 
+def render_roofline_line(gauges: Dict[str, float],
+                         counters: Dict[str, float]) -> Optional[str]:
+    """The ds_roofline status line: the analytic MFU ceiling of the
+    compiled train program vs the measured MFU (when the goodput meter
+    exports one), plus the predicted step time and how much of it is
+    memory-bound. Same contract as :func:`render_sdc_line` — rendered by
+    ``ds_top`` frames and the ``ds_metrics`` footer, pure stdlib so the
+    jax-free CLIs can file-load it. Returns None when the run never
+    armed the roofline block."""
+    if not any(k.startswith("roofline/") for k in gauges):
+        return None
+    parts = ["roofline:"]
+    ceiling = gauges.get("roofline/mfu_ceiling")
+    if ceiling is not None:
+        seg = f"mfu ceiling {ceiling:.3f}"
+        measured = gauges.get("goodput/mfu")
+        if measured is not None:
+            seg += (f" vs measured {measured:.3f} "
+                    f"(gap {max(0.0, ceiling - measured):.3f})")
+        parts.append(seg)
+    pred = gauges.get("roofline/predicted_step_us")
+    if pred is not None:
+        parts.append(f"predicted step {pred / 1e3:.1f}ms")
+    mem = gauges.get("roofline/memory_bound_share")
+    if mem is not None:
+        parts.append(f"{mem:.0%} memory-bound")
+    agree = gauges.get("roofline/flops_vs_xla")
+    if agree is not None:
+        parts.append(f"model/xla flops {agree:.3f}")
+    return "  ".join(parts)
+
+
 class JSONLTailer:
     """Incremental reader of an append-mostly JSONL file.
 

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 from deepspeed_tpu.goodput.tail import (MetricsFollower, labeled_key,
                                         render_resize_line,
                                         render_rewind_line,
+                                        render_roofline_line,
                                         render_sdc_line)
 from deepspeed_tpu.goodput.taxonomy import GOODPUT_BUCKETS
 
@@ -135,6 +136,9 @@ def render_frame(records: List[dict], source: Optional[str] = None,
     sdc = render_sdc_line(g, s["counters"])
     if sdc:
         out.append(sdc)
+    roof = render_roofline_line(g, s["counters"])
+    if roof:
+        out.append(roof)
 
     if s["comm_skew"] is not None:
         ratio, op, p50, mx = s["comm_skew"]

@@ -123,7 +123,8 @@ def exposed_comm_from_events(events: List[dict],
 
 
 def collect(engine, session=None, timed_steps: Optional[int] = None,
-            static_comm: bool = True) -> Dict[str, Any]:
+            static_comm: bool = True, roofline: bool = False
+            ) -> Dict[str, Any]:
     """The full attribution dict for one engine run. ``session`` defaults
     to the live telemetry session; ``timed_steps`` windows the span
     breakdown and the exposed-comm average to the last N steps (the
@@ -217,4 +218,20 @@ def collect(engine, session=None, timed_steps: Optional[int] = None,
                 }
         except Exception as e:
             logger.warning(f"perf attribution: static comm failed: {e}")
+    # ---- roofline: the analytic HLO cost model's ceiling for the same
+    # compiled train program — mfu_ceiling is hoisted by the recorder
+    # and mfu_gap (= ceiling − measured) is what `ds_perf gate --metric
+    # mfu_gap` regresses on. Only when the `roofline` ds_config block is
+    # present (strict no-op contract: the module is never imported
+    # otherwise); failure degrades to absence like everything here.
+    if roofline:
+        try:
+            from deepspeed_tpu.analysis.roofline import roofline_for_engine
+
+            rep = roofline_for_engine(engine)
+            if rep is not None:
+                att["mfu_ceiling"] = round(float(rep.mfu_ceiling), 4)
+                att["roofline"] = rep.summary()
+        except Exception as e:
+            logger.warning(f"perf attribution: roofline failed: {e}")
     return att

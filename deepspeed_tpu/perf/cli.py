@@ -129,6 +129,14 @@ def _sdc_overhead_line(r):
             + (" [REGRESSED]" if r.get("sdc_overhead_regressed") else ""))
 
 
+def _mfu_gap_line(r):
+    if "new_mfu_gap" not in r:
+        return ""
+    return (f"  mfu_gap {r['old_mfu_gap']:.3f} -> "
+            f"{r['new_mfu_gap']:.3f} below ceiling"
+            + (" [REGRESSED]" if r.get("mfu_gap_regressed") else ""))
+
+
 def _cmd_diff(args) -> int:
     old = led.latest_by_series(_load(args.old))
     new = led.latest_by_series(_load(args.new))
@@ -158,7 +166,7 @@ def _cmd_diff(args) -> int:
         print(f"{mark} {r['series']}: {_fmt_val(r['old_value'])} -> "
               f"{_fmt_val(r['new_value'])} ({r['rel_delta']:+.1%})"
               f"{noise}{fp}{_exposed_line(r)}{_static_comm_line(r)}"
-              f"{_sdc_overhead_line(r)}")
+              f"{_sdc_overhead_line(r)}{_mfu_gap_line(r)}")
         if "exposed_comm" in attr_sel and "new_exposed_comm_us" not in r:
             print(f"   {r['series']}: exposed_comm not recorded on both "
                   "sides (needs telemetry-instrumented entries)")
@@ -169,6 +177,10 @@ def _cmd_diff(args) -> int:
         if "sdc_overhead" in attr_sel and "new_sdc_overhead" not in r:
             print(f"   {r['series']}: sdc_overhead not recorded on both "
                   "sides (needs entries measured under the sdc + goodput "
+                  "blocks)")
+        if "mfu_gap" in attr_sel and "new_mfu_gap" not in r:
+            print(f"   {r['series']}: mfu_gap not recorded on both sides "
+                  "(needs MFU entries measured under the roofline + perf "
                   "blocks)")
     return 0
 
@@ -221,6 +233,9 @@ def _cmd_gate(args) -> int:
         if "sdc_overhead" in attr_sel and "new_sdc_overhead" not in r:
             missing.append(f"{k} (sdc_overhead attribution)")
             continue
+        if "mfu_gap" in attr_sel and "new_mfu_gap" not in r:
+            missing.append(f"{k} (mfu_gap attribution)")
+            continue
         checked.append(r)
         if r["verdict"] == "regression" or not r["new_value"] \
                 or r.get("goodput_regressed") \
@@ -229,7 +244,9 @@ def _cmd_gate(args) -> int:
                 or ("static_comm_bytes" in attr_sel
                     and r.get("static_comm_regressed")) \
                 or ("sdc_overhead" in attr_sel
-                    and r.get("sdc_overhead_regressed")):
+                    and r.get("sdc_overhead_regressed")) \
+                or ("mfu_gap" in attr_sel
+                    and r.get("mfu_gap_regressed")):
             failures.append(r)
     if args.json:
         print(json.dumps({"checked": checked, "missing": missing,
@@ -249,7 +266,8 @@ def _cmd_gate(args) -> int:
                          + (" [REGRESSED]" if r.get("goodput_regressed")
                             else ""))
             print(line + _world_tag(r) + _exposed_line(r)
-                  + _static_comm_line(r) + _sdc_overhead_line(r))
+                  + _static_comm_line(r) + _sdc_overhead_line(r)
+                  + _mfu_gap_line(r))
         for k in crashed:
             e = newest[k]
             print(f"FAIL {k}: newest run FAILED "
@@ -330,7 +348,12 @@ def main(argv=None) -> int:
                         "'sdc_overhead' gates on the replay-audit cost as a "
                         "fraction of wall (lower is better; absolute-point "
                         "tolerance + a 0.5-point floor — the sdc sentry's "
-                        "defense must stay under audit_interval⁻¹ of wall)")
+                        "defense must stay under audit_interval⁻¹ of wall). "
+                        "'mfu_gap' gates on the roofline distance (analytic "
+                        "mfu_ceiling − measured MFU, lower is better; "
+                        "absolute-point tolerance + a 2-point floor; "
+                        "entries without the roofline attribution count as "
+                        "missing — exit 3)")
     g.add_argument("--all", action="store_true",
                    help="gate every series the two files share")
     g.add_argument("--allow-missing", action="store_true",

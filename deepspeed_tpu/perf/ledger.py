@@ -116,6 +116,12 @@ def load_baseline(path: str) -> List[Dict[str, Any]]:
     (``tail`` = benched JSON lines, ``parsed`` = the headline), or bare
     JSON-lines text. The driver format marks its ``parsed`` headline with
     ``"headline": True`` so ``gate`` can default to it."""
+    if path.endswith((".jsonl", ".ndjson")):
+        # a perf ledger BY EXTENSION: parse line-wise natively instead of
+        # relying on the whole-text json.loads to fail first — a
+        # single-entry .jsonl is itself valid JSON and would otherwise be
+        # misread as the one-dict case only by luck of ordering
+        return load_entries(path)
     with open(path) as f:
         text = f.read()
     try:
@@ -231,11 +237,18 @@ STATIC_COMM_FLOOR_BYTES = 1 << 20
 # slower — real.
 SDC_OVERHEAD_FLOOR = 0.005
 
+# mfu_gap regression floor (absolute MFU points): the roofline gap is
+# ceiling − measured, already a ratio in [0,1]; growth below two MFU
+# points is CPU-sim noise, growth past it means either the measured MFU
+# dropped or the program's analytic ceiling rose (a layout/fusion change
+# freed headroom nobody collected) — both worth a red gate.
+MFU_GAP_FLOOR = 0.02
+
 # Attribution-level metrics `ds_perf gate/diff --metric` understands in
 # addition to series-key substrings: these select WHAT is compared (the
 # embedded attribution value), not WHICH series.
 ATTRIBUTION_METRICS = ("exposed_comm", "goodput", "static_comm_bytes",
-                       "sdc_overhead")
+                       "sdc_overhead", "mfu_gap")
 
 # Minimum per-side sample count for the t gate to carry a verdict: with
 # fewer, a failed significance test means "underpowered", not "noise",
@@ -406,6 +419,19 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         out["sdc_overhead_regressed"] = (
             (kn - ko) > max(rel_tol * max(ko, SDC_OVERHEAD_FLOOR),
                             SDC_OVERHEAD_FLOOR))
+    # roofline mfu_gap (hoisted top-level, like goodput_fraction): LOWER
+    # is better — the distance between the measured MFU and the analytic
+    # HLO-model ceiling — judged in ABSOLUTE MFU points with a floor
+    # (it is already a ratio). `ds_perf gate --metric mfu_gap` arms it.
+    mo, mn = old.get("mfu_gap"), new.get("mfu_gap")
+    if mo is not None and mn is not None:
+        mo, mn = float(mo), float(mn)
+        out["old_mfu_gap"] = mo
+        out["new_mfu_gap"] = mn
+        out["mfu_gap_delta"] = mn - mo
+        out["mfu_gap_regressed"] = (
+            (mn - mo) > max(rel_tol * max(mo, MFU_GAP_FLOOR),
+                            MFU_GAP_FLOOR))
     go, gn = old.get("goodput_fraction"), new.get("goodput_fraction")
     if go is not None and gn is not None:
         out["old_goodput"] = float(go)

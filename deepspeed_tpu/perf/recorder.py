@@ -136,15 +136,29 @@ class PerfRecorder:
         want_attribution = (self.cfg.attribution if attribution is None
                             else attribution)
         if want_attribution:
+            ecfg = getattr(self.engine, "_config", None)
+            roofline_on = bool(
+                getattr(ecfg, "roofline_present", False)
+                and getattr(getattr(ecfg, "roofline", None), "enabled",
+                            False))
             entry["attribution"] = _attribution.collect(
                 self.engine, session=session, timed_steps=timed_steps,
-                static_comm=getattr(self.cfg, "static_comm", True))
+                static_comm=getattr(self.cfg, "static_comm", True),
+                roofline=roofline_on)
             gf = (entry["attribution"].get("goodput") or {}).get(
                 "goodput_fraction")
             if gf is not None:
                 # hoisted to the top level so ds_perf compare/gate can
                 # treat it as a first-class gated metric
                 entry["goodput_fraction"] = gf
+            mc = entry["attribution"].get("mfu_ceiling")
+            if mc is not None:
+                # hoisted like goodput_fraction; mfu_gap = ceiling −
+                # measured is only defined when the headline IS an MFU
+                entry["mfu_ceiling"] = round(float(mc), 4)
+                if str(unit).strip().upper() == "MFU":
+                    entry["mfu_gap"] = round(
+                        max(0.0, float(mc) - float(value)), 4)
         if extra:
             entry.update(extra)
         path = self.cfg.ledger_path

@@ -134,6 +134,25 @@ def count_jaxpr_flops(fn: Callable, *args, **kwargs) -> Tuple[int, Dict[str, int
     return sum(totals.values()), acc
 
 
+def extract_compiled_cost(compiled) -> Dict[str, float]:
+    """flops / bytes_accessed of an already-compiled executable, from
+    ``compiled.cost_analysis()`` — THE single extraction point shared by
+    :func:`compiled_cost_analysis` (the ThroughputTimer's EstTFLOPs
+    path) and ``analysis/roofline``'s live cross-check, so the two can
+    never disagree on the same program. Degrades to zeros when the
+    backend exposes no cost analysis."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+    except Exception as e:  # pragma: no cover - backend-dependent
+        logger.warning(f"cost_analysis unavailable: {e}")
+        ca = {}
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+            "transcendentals": float(ca.get("transcendentals", 0.0))}
+
+
 def compiled_cost_analysis(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict[str, float]:
     """Exact compiler-side counts: flops, bytes accessed, peak memory.
 
@@ -142,15 +161,7 @@ def compiled_cost_analysis(fn: Callable, *args, static_argnums=(), **kwargs) -> 
     """
     lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args, **kwargs)
     compiled = lowered.compile()
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-    except Exception as e:  # pragma: no cover - backend-dependent
-        logger.warning(f"cost_analysis unavailable: {e}")
-        ca = {}
-    out = {"flops": float(ca.get("flops", 0.0)),
-           "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+    out = extract_compiled_cost(compiled)
     try:
         mem = compiled.memory_analysis()
         if mem is not None:

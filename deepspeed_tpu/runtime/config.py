@@ -625,6 +625,25 @@ class GoodputConfig(DeepSpeedConfigModel):
     tolerance: float = Field(0.05, gt=0.0, le=1.0, description="closure tolerance the acceptance checks hold the ledger to: per-step buckets must sum to within this fraction of the measured step wall window (the partition sums exactly by construction; the tolerance absorbs span-boundary jitter against independently measured step time)")
 
 
+class RooflineConfig(DeepSpeedConfigModel):
+    """Analytic roofline (deepspeed_tpu/analysis/roofline.py +
+    ``bin/ds_roofline``): price the compiled HLO of every PR-12 program
+    against a per-chip peak table (``analysis/chips.py``) — per-region
+    FLOPs / HBM bytes, compute- vs memory-bound verdicts, a predicted
+    step time and ``mfu_ceiling`` — and stamp the result into perf
+    attribution so every ledger entry hoists ``mfu_ceiling`` and
+    ``mfu_gap`` (= ceiling − measured; ``ds_perf gate --metric
+    mfu_gap`` regresses on it, lower is better). The pass runs ONCE
+    after the first train_batch, one AOT compile per program (memoized
+    on the program record). STRICT no-op when the block is absent: the
+    roofline module is never imported, the step path is byte-identical
+    (same contract as ``analysis`` / ``perf`` / ``sdc``). See
+    docs/CONFIG.md 'roofline' section for the chip table."""
+    enabled: bool = Field(True, description="arm the roofline pass (the block being present opts in; set false to keep the block but skip the work)")
+    chip: str = Field("auto", description="chip whose peak table prices the program: one of analysis/chips.py's entries (v2/v3/v4/v5e/v5p/v6e/cpu-sim or an alias); 'auto' detects from the live device kind (cpu-sim on the simulated CPU meshes)")
+    top_k: int = Field(8, ge=1, description="regions shown per program in the rendered 'top-K fusions by predicted time' table (ds_roofline report / the engine's log line); the ledger summary always carries only the single top region")
+
+
 class OverlapConfig(DeepSpeedConfigModel):
     """Overlap engine (deepspeed_tpu/runtime/overlap.py): hide the ZeRO
     collectives behind compute. Restructures the fused train step so the
@@ -882,6 +901,10 @@ class DeepSpeedConfig:
         # lowered step HLO is byte-identical)
         self.sdc = SdcConfig(**pd.get("sdc", {}))
         self.sdc_present = "sdc" in pd
+        # presence matters, same contract again: no block, no roofline
+        # module (never imported; no AOT compiles, no ledger stamps)
+        self.roofline = RooflineConfig(**pd.get("roofline", {}))
+        self.roofline_present = "roofline" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -949,7 +972,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "rewind", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wire", "sdc", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wire", "sdc", "roofline", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

@@ -329,6 +329,9 @@ class DeepSpeedEngine:
                                   and self._config.analysis.enabled)
         self._analysis_graph_done = False
         self._analysis_xray_done = False
+        # ds_roofline: own block, same once-after-first-step timing as xray
+        self._roofline_done = False
+        self._roofline_result = None
         self._analysis_batch_shapes = None
         self._collective_fingerprint = None
         if self._analysis_enabled:
@@ -1608,6 +1611,17 @@ class DeepSpeedEngine:
             from deepspeed_tpu.analysis.xray import engine_xray_analysis
 
             engine_xray_analysis(self)
+        if not self._roofline_done and self._config.roofline_present and \
+                self._config.roofline.enabled:
+            # ds_roofline AFTER the first step, same xray-style timing:
+            # price every compiled program against the chip peak table
+            # (one memoized AOT compile each). STRICT no-op without the
+            # block — the module is never imported (asserted in tests).
+            self._roofline_done = True
+            from deepspeed_tpu.analysis.roofline import \
+                engine_roofline_analysis
+
+            engine_roofline_analysis(self)
         if self._consistency_interval and \
                 self._host_step % self._consistency_interval == 0:
             from deepspeed_tpu.resilience.consistency import \

@@ -93,6 +93,34 @@ class TestLedger:
         led.append_entry(p, _entry())
         assert len(led.load_baseline(p)) == 1
 
+    def test_load_baseline_single_line_jsonl_is_one_entry(self, tmp_path):
+        """A one-entry .jsonl is ALSO valid whole-file JSON — the
+        extension must route it line-wise (one entry), never to the
+        one-dict fallback paths."""
+        p = str(tmp_path / "single.jsonl")
+        led.append_entry(p, _entry(value=0.42))
+        entries = led.load_baseline(p)
+        assert len(entries) == 1 and entries[0]["value"] == 0.42
+        assert led.series_key(entries[0]) == led.series_key(_entry())
+
+    def test_load_baseline_jsonl_skips_torn_line(self, tmp_path):
+        p = str(tmp_path / "torn.ndjson")
+        led.append_entry(p, _entry(value=0.5))
+        led.append_entry(p, _entry(value=0.6))
+        with open(p, "a") as f:
+            f.write('{"metric": "torn by a kill -9')
+        assert [e["value"] for e in led.load_baseline(p)] == [0.5, 0.6]
+
+    def test_gate_accepts_jsonl_baseline(self, tmp_path):
+        """ds_perf gate --baseline ledger.jsonl — the bench.py smoke
+        recipe verbatim."""
+        from deepspeed_tpu.perf.cli import main as perf_main
+
+        p = str(tmp_path / "ledger.jsonl")
+        led.append_entry(p, _entry(samples=[0.5, 0.5, 0.5],
+                                   headline=True, fingerprint="f"))
+        assert perf_main(["gate", "--baseline", p, "--candidate", p]) == 0
+
     def test_real_bench_r05_parses(self):
         entries = led.load_baseline(os.path.join(REPO, "BENCH_r05.json"))
         assert len(entries) >= 8

@@ -50,7 +50,8 @@ def _block_models() -> Dict[str, type]:
         "eigenvalue": C.EigenvalueConfig,
         "progressive_layer_drop": C.PLDConfig,
         "resilience": C.ResilienceConfig, "rewind": C.RewindConfig,
-        "sdc": C.SdcConfig, "watchdog": C.WatchdogConfig,
+        "sdc": C.SdcConfig, "gray": C.GrayConfig,
+        "watchdog": C.WatchdogConfig,
         "telemetry": C.TelemetryConfig, "analysis": C.AnalysisConfig,
         "profiling": C.ProfilingConfig, "perf": C.PerfConfig,
         "serving": C.ServingConfig, "goodput": C.GoodputConfig,
@@ -413,6 +414,26 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "want device-granular blame first; just know the agreement "
                 "round is then a backstop, not the detector",
                 "sdc.audit_interval vs watchdog.consistency_interval")
+    gray = cfg.gray
+    if "gray" in pd and gray.enabled:
+        if not (tel.enabled and tel.output_dir):
+            add("error",
+                "gray without a telemetry output_dir: the fail-slow defense "
+                "is pure observability until it evicts — suspicion/probe "
+                "gauges, gray_warn/gray_verdict trace events and the "
+                "restart_log.jsonl verdict ledger all land in the telemetry "
+                "session, so without one every verdict is unrecordable "
+                "(undiagnosable after the fact); enable the telemetry block "
+                "with an output_dir",
+                "gray vs telemetry.output_dir")
+        if gray.evict and not ("elasticity" in pd and rz.enabled):
+            add("info",
+                "gray.evict without elasticity.resize: a confirmed slow "
+                "device cannot be evicted, so every verdict degrades to "
+                "report-only (recorded + telemetry, fleet untouched) — "
+                "enable the resize block for quarantine-and-evict, or set "
+                "gray.evict: false to make the intent explicit",
+                "gray.evict vs elasticity.resize")
     gp = cfg.goodput
     if "goodput" in pd and gp.enabled and not (tel.enabled and tel.trace):
         add("warning",

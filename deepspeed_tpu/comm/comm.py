@@ -185,8 +185,35 @@ def record_phase_span(op: str, seconds: float, group_desc: str,
     identity as the eager ``timed_op`` spans, so ``ds_prof merge`` aligns
     and skews it across ranks and ``exposed_comm_us_per_step`` prices it."""
     from deepspeed_tpu import telemetry
+    from deepspeed_tpu.resilience import chaos as _chaos
 
+    inj = _chaos.active_injector()
+    if inj is not None and inj.slow_armed():
+        # fail-slow drill: the phase is already timed by the caller, so
+        # the injected excess is slept here (still inside the step's wall
+        # clock) and added to every record of the phase
+        extra = inj.slow_extra_s(seconds)
+        if extra > 0.0:
+            time.sleep(extra)
+            seconds += extra
     registry = telemetry.get_registry()
+    if comms_logger is not None:
+        # phase latencies ride the same recent-window machinery as the
+        # eager ops: skew gauges + rank-local straggler excess cover the
+        # serial ZeRO-3 gather too (ds_gray's evidence must not go blind
+        # when the schedule moves collectives out of the eager wrappers)
+        comms_logger.append(op, op, seconds, int(nbytes))
+        if registry.enabled:
+            registry.gauge("comm/skew",
+                           labels={"op": op, "size": str(int(nbytes))}
+                           ).set(comms_logger.window_skew(op, int(nbytes)))
+        excess = comms_logger.straggler_excess(op, int(nbytes), seconds)
+        if excess > 0.0:
+            telemetry.get_tracer().complete(
+                "straggler_wait", excess * 1e6, cat="straggler", op=op)
+            if registry.enabled:
+                registry.counter("comm/straggler_excess_us").inc(
+                    excess * 1e6)
     if registry.enabled:
         registry.histogram("comm/op_latency_seconds",
                            labels={"op": op, "size": str(int(nbytes))}
@@ -383,6 +410,10 @@ def _busbw_factor(op_name: str, n: int) -> float:
 class CommsLogger:
     STRAGGLER_WINDOW = 64       # recent-latency window per (op, size)
     STRAGGLER_SKEW = 3.0        # max/mean ratio that flags a straggler
+    STRAGGLER_MIN_SAMPLES = 8   # window floor before any rank-local
+                                # straggler excess is stamped — a cold
+                                # window (first steps, post-recompile)
+                                # has no baseline worth trusting
 
     def __init__(self, verbose=False, debug=False, prof_all=True, prof_ops=None):
         self.verbose = verbose
@@ -414,6 +445,14 @@ class CommsLogger:
         if self.verbose:
             log_dist(f"comm op: {record_name} | msg size: {msg_size} | latency(ms): {latency*1000:.2f}", ranks=[0])
 
+    def reset_straggler_windows(self) -> None:
+        """Drop the recent-latency windows (the cumulative comms_dict
+        stays). After an evict restart the windows still hold the old
+        culprit's dragged latencies — a consumer baselining a NEW fleet
+        (ds_gray re-arming on the survivors) must start them empty or the
+        stale tail reads as fresh skew for up to STRAGGLER_WINDOW calls."""
+        self._recent.clear()
+
     def straggler_report(self):
         """Per-(op, size) max-vs-mean latency skew over the recent window.
 
@@ -432,6 +471,34 @@ class CommsLogger:
             rows.append((op, size, len(lats), mean, worst,
                          worst / mean if mean > 0 else 0.0))
         return rows
+
+    def window_skew(self, raw_name, msg_size) -> float:
+        """One key's max-vs-mean skew over the recent window — the
+        ``straggler_report`` row for the just-appended op, O(window), so
+        the comm layer can export it as a live gauge per call."""
+        lats = self._recent.get((raw_name, msg_size))
+        if not lats:
+            return 0.0
+        mean = sum(lats) / len(lats)
+        return max(lats) / mean if mean > 0 else 0.0
+
+    def straggler_excess(self, raw_name, msg_size, latency) -> float:
+        """Rank-local straggler excess: seconds ``latency`` lands beyond
+        the recent FASTEST-HALF mean of this key's window. The trimmed
+        baseline is robust to the slow tail itself (a persistently
+        dragged op does not launder its own excess into the baseline
+        until the whole window has turned over), and the sample floor +
+        2x trigger keep cold windows and ordinary jitter at exactly
+        0.0 — the goodput ``straggler_wait`` bucket must stay empty on a
+        healthy rank."""
+        lats = self._recent.get((raw_name, msg_size))
+        if lats is None or len(lats) < self.STRAGGLER_MIN_SAMPLES:
+            return 0.0
+        fastest = sorted(lats)[:max(1, len(lats) // 2)]
+        baseline = sum(fastest) / len(fastest)
+        if baseline <= 0.0 or latency < 2.0 * baseline:
+            return 0.0
+        return latency - baseline
 
     def log_all(self, print_log=True, show_straggler=False):
         lines = ["Comms summary:"]
@@ -524,6 +591,15 @@ def timed_op(func):
             inj.before("collective", func.__name__)
         result = func(tensor, *args, **kwargs)
         jax.block_until_ready(result)
+        if inj is not None and inj.slow_armed():
+            # `slow_device` fault class: the persistent fail-slow excess
+            # is slept INSIDE the timed window, so the inflated wait
+            # lands in this op's comm span, the comms logger's skew
+            # deque, and the straggler evidence — a fleet blocking on
+            # one slow participant, without a slow chip
+            extra = inj.slow_extra_s(time.perf_counter() - t0)
+            if extra > 0.0:
+                time.sleep(extra)
         latency = time.perf_counter() - t0
         size = _nbytes(tensor)
         group = kwargs.get("group")
@@ -533,6 +609,29 @@ def timed_op(func):
         if comms_logger is not None:
             comms_logger.append(func.__name__, kwargs.get("log_name", func.__name__),
                                 latency, size, n=n)
+            if registry.enabled:
+                # straggler skew as a live gauge (not just log_all print):
+                # ds_gray, ds_top and offline tools read it from
+                # metrics.jsonl as comm/skew{op=,size=}
+                registry.gauge("comm/skew",
+                               labels={"op": func.__name__,
+                                       "size": str(size)}
+                               ).set(comms_logger.window_skew(
+                                   func.__name__, size))
+            excess = comms_logger.straggler_excess(func.__name__, size,
+                                                   latency)
+            if excess > 0.0:
+                # rank-local straggler_wait: the slice of this call beyond
+                # the recent fastest-half baseline, as a cat="straggler"
+                # span nested in the comm span — it outranks exposed_comm
+                # in the taxonomy, so the excess is re-charged to the
+                # straggler, not claimed as ordinary comm
+                telemetry.get_tracer().complete(
+                    "straggler_wait", excess * 1e6, cat="straggler",
+                    op=func.__name__)
+                if registry.enabled:
+                    registry.counter("comm/straggler_excess_us").inc(
+                        excess * 1e6)
         if registry.enabled:
             registry.histogram("comm/op_latency_seconds",
                                labels={"op": func.__name__, "size": str(size)}).observe(latency)

@@ -165,6 +165,58 @@ def render_sdc_line(gauges: Dict[str, float],
     return "  ".join(parts)
 
 
+def render_gray_line(gauges: Dict[str, float],
+                     counters: Dict[str, float]) -> Optional[str]:
+    """The ds_gray status line: the live suspicion score against the
+    blame threshold, the current probe-named suspect, then the fail-slow
+    ledger (probes run, verdicts by blamed device, evictions, warnings).
+    Same contract as :func:`render_sdc_line` — rendered by ``ds_top``
+    frames and the ``ds_metrics`` footer, pure stdlib so the jax-free
+    CLIs can file-load it. Returns None when the run never armed the
+    gray block."""
+    if not any(k.startswith("gray/") for k in gauges) and \
+            not any(k.startswith("gray/") for k in counters):
+        return None
+    parts = ["gray:"]
+    susp = gauges.get("gray/suspicion")
+    if susp is not None:
+        seg = f"suspicion {susp:.2f}"
+        thr = gauges.get("gray/blame_threshold")
+        if thr is not None:
+            seg += f"/{thr:.2f}"
+        parts.append(seg)
+    suspect = gauges.get("gray/suspect_device")
+    if suspect is not None and suspect >= 0:
+        parts.append(f"suspect dev{int(suspect)}")
+    probes = sum(v for k, v in counters.items()
+                 if k.startswith("gray/probes"))
+    if probes:
+        parts.append(f"{int(probes)} probe(s)")
+    verdicts = {k: v for k, v in counters.items()
+                if k.startswith("gray/verdicts")}
+    if verdicts:
+        by_dev = ", ".join(
+            f"{int(v)}x dev{parse_label(k, 'device') or '?'}"
+            for k, v in sorted(verdicts.items()))
+        seg = f"VERDICTS {int(sum(verdicts.values()))} ({by_dev})"
+        vd = gauges.get("gray/last_verdict_device")
+        vs = gauges.get("gray/last_verdict_step")
+        if vd is not None and vs is not None:
+            seg += f", last blamed dev{int(vd)} @step {int(vs)}"
+        parts.append(seg)
+    else:
+        parts.append("no verdicts")
+    ev = sum(v for k, v in counters.items()
+             if k.startswith("gray/evictions"))
+    if ev:
+        parts.append(f"evicted {int(ev)} device(s)")
+    warns = sum(v for k, v in counters.items()
+                if k.startswith("gray/warnings"))
+    if warns:
+        parts.append(f"{int(warns)} warning(s)")
+    return "  ".join(parts)
+
+
 def render_roofline_line(gauges: Dict[str, float],
                          counters: Dict[str, float]) -> Optional[str]:
     """The ds_roofline status line: the analytic MFU ceiling of the

@@ -20,10 +20,17 @@ Buckets (priority order, highest first):
                     definition, and priced ABOVE compute so the seconds
                     it spends inside a ``train_batch`` span are charged
                     to the audit, not claimed as goodput;
+``probe``           ds_gray microprobe execution (cat="probe") — the
+                    fail-slow defense's deliberate off-step confirmation
+                    work; same pricing rationale as ``audit`` and gated
+                    by ``ds_perf gate`` as gray_overhead;
 ``data_wait``       the engine's ``data`` span — host input pipeline;
 ``straggler_wait``  inside a matched collective, time spent waiting for
-                    the last-arriving rank (fleet-level only: needs >= 2
-                    ranks; rank-local ledgers report 0);
+                    the last-arriving rank. Fleet analyses compute it
+                    from matched multi-rank timelines; rank-local runs
+                    get it from the comm layer's cat="straggler" excess
+                    spans (latency beyond the recent fastest-half
+                    baseline, stamped once the window has >= 8 samples);
 ``exposed_comm``    comm spans not overlapped by compute (the same
                     interval math as ``FleetTrace.exposed_comm_us``);
 ``compute``         the remaining time covered by train-phase spans —
@@ -43,8 +50,9 @@ from typing import Dict, List, Optional, Tuple
 # priority order: earlier wins where spans overlap. `restart` and `idle`
 # are computed residually (gaps), never from spans, so they close the
 # partition.
-BUCKETS = ("watchdog_stall", "compile", "checkpoint", "audit", "data_wait",
-           "straggler_wait", "exposed_comm", "compute", "restart", "idle")
+BUCKETS = ("watchdog_stall", "compile", "checkpoint", "audit", "probe",
+           "data_wait", "straggler_wait", "exposed_comm", "compute",
+           "restart", "idle")
 
 GOODPUT_BUCKETS = ("compute",)
 BADPUT_BUCKETS = tuple(b for b in BUCKETS if b not in GOODPUT_BUCKETS)
@@ -52,7 +60,8 @@ BADPUT_BUCKETS = tuple(b for b in BUCKETS if b not in GOODPUT_BUCKETS)
 # span categories / names -> bucket (everything span-classifiable; the
 # residual buckets have no span class on purpose)
 _CAT_BUCKET = {"stall": "watchdog_stall", "compile": "compile",
-               "checkpoint": "checkpoint", "audit": "audit"}
+               "checkpoint": "checkpoint", "audit": "audit",
+               "probe": "probe", "straggler": "straggler_wait"}
 
 # compute evidence: host spans that mean "the step is executing device
 # work (or dispatching it)". train_batch encloses fwd/bwd/step, but the

@@ -18,6 +18,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.goodput.tail import (MetricsFollower, labeled_key,
+                                        render_gray_line,
                                         render_resize_line,
                                         render_rewind_line,
                                         render_roofline_line,
@@ -136,6 +137,9 @@ def render_frame(records: List[dict], source: Optional[str] = None,
     sdc = render_sdc_line(g, s["counters"])
     if sdc:
         out.append(sdc)
+    gray = render_gray_line(g, s["counters"])
+    if gray:
+        out.append(gray)
     roof = render_roofline_line(g, s["counters"])
     if roof:
         out.append(roof)

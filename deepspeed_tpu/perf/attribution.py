@@ -170,6 +170,18 @@ def collect(engine, session=None, timed_steps: Optional[int] = None,
             att["sdc_overhead"] = round(
                 float((gp.get("buckets_us") or {}).get("audit", 0.0)) / wall,
                 5)
+    # ---- gray_overhead: the ds_gray microprobe cost as a fraction of the
+    # timed window's wall — same shape as sdc_overhead, over the `probe`
+    # bucket. An armed defense whose window ran no probe stamps an honest
+    # 0.0, so the ledger records which entries paid for fail-slow cover.
+    if getattr(engine, "_gray", None) is not None and att.get("goodput"):
+        gp = att["goodput"]
+        wall = sum(float(s.get("wall_us") or 0.0)
+                   for s in gp.get("per_step") or [])
+        if wall > 0:
+            att["gray_overhead"] = round(
+                float((gp.get("buckets_us") or {}).get("probe", 0.0)) / wall,
+                5)
     # ---- memory: census buckets + compiled-step accounting
     try:
         res = engine.memory_census()

@@ -129,6 +129,14 @@ def _sdc_overhead_line(r):
             + (" [REGRESSED]" if r.get("sdc_overhead_regressed") else ""))
 
 
+def _gray_overhead_line(r):
+    if "new_gray_overhead" not in r:
+        return ""
+    return (f"  gray_overhead {r['old_gray_overhead']:.2%} -> "
+            f"{r['new_gray_overhead']:.2%} of wall"
+            + (" [REGRESSED]" if r.get("gray_overhead_regressed") else ""))
+
+
 def _mfu_gap_line(r):
     if "new_mfu_gap" not in r:
         return ""
@@ -166,7 +174,8 @@ def _cmd_diff(args) -> int:
         print(f"{mark} {r['series']}: {_fmt_val(r['old_value'])} -> "
               f"{_fmt_val(r['new_value'])} ({r['rel_delta']:+.1%})"
               f"{noise}{fp}{_exposed_line(r)}{_static_comm_line(r)}"
-              f"{_sdc_overhead_line(r)}{_mfu_gap_line(r)}")
+              f"{_sdc_overhead_line(r)}{_gray_overhead_line(r)}"
+              f"{_mfu_gap_line(r)}")
         if "exposed_comm" in attr_sel and "new_exposed_comm_us" not in r:
             print(f"   {r['series']}: exposed_comm not recorded on both "
                   "sides (needs telemetry-instrumented entries)")
@@ -177,6 +186,10 @@ def _cmd_diff(args) -> int:
         if "sdc_overhead" in attr_sel and "new_sdc_overhead" not in r:
             print(f"   {r['series']}: sdc_overhead not recorded on both "
                   "sides (needs entries measured under the sdc + goodput "
+                  "blocks)")
+        if "gray_overhead" in attr_sel and "new_gray_overhead" not in r:
+            print(f"   {r['series']}: gray_overhead not recorded on both "
+                  "sides (needs entries measured under the gray + goodput "
                   "blocks)")
         if "mfu_gap" in attr_sel and "new_mfu_gap" not in r:
             print(f"   {r['series']}: mfu_gap not recorded on both sides "
@@ -233,6 +246,9 @@ def _cmd_gate(args) -> int:
         if "sdc_overhead" in attr_sel and "new_sdc_overhead" not in r:
             missing.append(f"{k} (sdc_overhead attribution)")
             continue
+        if "gray_overhead" in attr_sel and "new_gray_overhead" not in r:
+            missing.append(f"{k} (gray_overhead attribution)")
+            continue
         if "mfu_gap" in attr_sel and "new_mfu_gap" not in r:
             missing.append(f"{k} (mfu_gap attribution)")
             continue
@@ -245,6 +261,8 @@ def _cmd_gate(args) -> int:
                     and r.get("static_comm_regressed")) \
                 or ("sdc_overhead" in attr_sel
                     and r.get("sdc_overhead_regressed")) \
+                or ("gray_overhead" in attr_sel
+                    and r.get("gray_overhead_regressed")) \
                 or ("mfu_gap" in attr_sel
                     and r.get("mfu_gap_regressed")):
             failures.append(r)
@@ -267,7 +285,7 @@ def _cmd_gate(args) -> int:
                             else ""))
             print(line + _world_tag(r) + _exposed_line(r)
                   + _static_comm_line(r) + _sdc_overhead_line(r)
-                  + _mfu_gap_line(r))
+                  + _gray_overhead_line(r) + _mfu_gap_line(r))
         for k in crashed:
             e = newest[k]
             print(f"FAIL {k}: newest run FAILED "
@@ -349,6 +367,11 @@ def main(argv=None) -> int:
                         "fraction of wall (lower is better; absolute-point "
                         "tolerance + a 0.5-point floor — the sdc sentry's "
                         "defense must stay under audit_interval⁻¹ of wall). "
+                        "'gray_overhead' gates on the ds_gray microprobe "
+                        "cost as a fraction of wall (lower is better; "
+                        "absolute-point tolerance + a 0.5-point floor — the "
+                        "fail-slow defense must stay <= 2%% of wall at the "
+                        "default cadence). "
                         "'mfu_gap' gates on the roofline distance (analytic "
                         "mfu_ceiling − measured MFU, lower is better; "
                         "absolute-point tolerance + a 2-point floor; "

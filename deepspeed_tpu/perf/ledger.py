@@ -237,6 +237,13 @@ STATIC_COMM_FLOOR_BYTES = 1 << 20
 # slower — real.
 SDC_OVERHEAD_FLOOR = 0.005
 
+# Gray probe-overhead regression floor (absolute fraction points of
+# wall): the ds_gray contract is "probe cost <= 2% of wall at the
+# default cadence", so half a point of growth is noise on a short
+# window, but a point of sustained growth means probes got materially
+# more expensive (or fire far more often) — real.
+GRAY_OVERHEAD_FLOOR = 0.005
+
 # mfu_gap regression floor (absolute MFU points): the roofline gap is
 # ceiling − measured, already a ratio in [0,1]; growth below two MFU
 # points is CPU-sim noise, growth past it means either the measured MFU
@@ -248,7 +255,7 @@ MFU_GAP_FLOOR = 0.02
 # addition to series-key substrings: these select WHAT is compared (the
 # embedded attribution value), not WHICH series.
 ATTRIBUTION_METRICS = ("exposed_comm", "goodput", "static_comm_bytes",
-                       "sdc_overhead", "mfu_gap")
+                       "sdc_overhead", "gray_overhead", "mfu_gap")
 
 # Minimum per-side sample count for the t gate to carry a verdict: with
 # fewer, a failed significance test means "underpowered", not "noise",
@@ -419,6 +426,22 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         out["sdc_overhead_regressed"] = (
             (kn - ko) > max(rel_tol * max(ko, SDC_OVERHEAD_FLOOR),
                             SDC_OVERHEAD_FLOOR))
+    # gray_overhead rides the same way (stamped from the goodput ledger's
+    # `probe` bucket when ds_gray is armed): LOWER is better — the
+    # wall-fraction the fail-slow microprobes cost — judged in ABSOLUTE
+    # fraction points with a floor. `ds_perf gate --metric gray_overhead`
+    # is the subsystem's self-gate (probe cost <= 2% of wall at the
+    # default cadence).
+    yo = (old.get("attribution") or {}).get("gray_overhead")
+    yn = (new.get("attribution") or {}).get("gray_overhead")
+    if yo is not None and yn is not None:
+        yo, yn = float(yo), float(yn)
+        out["old_gray_overhead"] = yo
+        out["new_gray_overhead"] = yn
+        out["gray_overhead_delta"] = yn - yo
+        out["gray_overhead_regressed"] = (
+            (yn - yo) > max(rel_tol * max(yo, GRAY_OVERHEAD_FLOOR),
+                            GRAY_OVERHEAD_FLOOR))
     # roofline mfu_gap (hoisted top-level, like goodput_fraction): LOWER
     # is better — the distance between the measured MFU and the analytic
     # HLO-model ceiling — judged in ABSOLUTE MFU points with a floor

@@ -84,11 +84,19 @@ def _cmd_merge(args) -> int:
     # warnings too (duplicate collective identities are detected lazily)
     warnings = list(ft.warnings)
     if args.json:
+        rank_cost = ft.rank_cost_summary(align=align)
+        cost_total = sum(rank_cost.values())
         print(json.dumps({
             "ranks": sorted(ft.by_rank),
             "clock_offsets_us": ft.clock_offsets() if align else {},
             "stragglers": [r._asdict() for r in rows],
-            "rank_cost_us": ft.rank_cost_summary(align=align),
+            "rank_cost_us": rank_cost,
+            # each rank's fraction of the total fleet waiting time — the
+            # per-rank blame number a gray-failure hunt sorts by (all
+            # zeros when no cross-rank collective matches were found)
+            "rank_cost_share": {r: (round(c / cost_total, 4)
+                                    if cost_total > 0 else 0.0)
+                                for r, c in rank_cost.items()},
             "critical_path": cp._asdict() if cp else None,
             "exposed_comm_us_per_step": exposed["avg_us_per_step"],
             "exposed_comm_us_by_step": exposed["per_step"],

@@ -61,6 +61,19 @@ without a real TPU fault):
   mode the ds_sentry replay audits exist to catch
   (resilience/sdc.py).
 
+* ``slow_device`` (``slow_from_step``+``slow_device``+``slow_factor``
+  scripted / ``slow_rate`` randomized) — FAIL-SLOW: one simulated
+  device's collective waits are persistently inflated by
+  ``slow_factor`` (the comm layer asks :meth:`slow_extra_s` after
+  timing each eager collective / serial gather phase, and sleeps the
+  excess INSIDE the timed window), so every blocking collective drags
+  at the slow chip's pace — exactly the gray-failure mode ds_gray
+  (resilience/gray.py) exists to blame, probe, and evict.
+  ``slow_kind`` (compute|link|host) picks which microprobe phase the
+  culprit inflates, so probe classification is drillable too. Stands
+  down on its own once the target device is quarantined out of the
+  survivor set — an evicted chip cannot drag survivors.
+
 One fault class targets the STATIC analyzer instead of the runtime:
 ``collective_mismatch`` perturbs this rank's ds_doctor-recorded
 collective sequence (:meth:`ChaosInjector.perturb_collectives`), so the
@@ -124,7 +137,10 @@ class ChaosInjector:
                  collective_mismatch_rank: int = -1,
                  bitflip_at: int = -1, bitflip_rate: float = 0.0,
                  bitflip_target: str = "params", bitflip_device: int = 0,
-                 bitflip_bit: int = 12):
+                 bitflip_bit: int = 12,
+                 slow_from_step: int = -1, slow_device: int = 0,
+                 slow_factor: float = 1.0, slow_rate: float = 0.0,
+                 slow_min_s: float = 0.0, slow_kind: str = "compute"):
         self._rng = random.Random(seed)
         self.seed = seed
         self.source = "manual"      # "config" / "env": who installed it
@@ -157,6 +173,16 @@ class ChaosInjector:
         # dedicated stream (like perturb_collectives): the flip pattern
         # reproduces exactly regardless of what the I/O stream consumed
         self._bitflip_rng = random.Random((seed << 8) ^ 0xB17F11)
+        self.slow_from_step = int(slow_from_step)
+        self.slow_device = int(slow_device)
+        self.slow_factor = float(slow_factor)
+        self.slow_rate = float(slow_rate)
+        self.slow_min_s = float(slow_min_s)
+        self.slow_kind = str(slow_kind)
+        self._slow_logged = False
+        # dedicated stream: the randomized fail-slow draws reproduce
+        # regardless of what the I/O fault stream consumed
+        self._slow_rng = random.Random((seed << 8) ^ 0x510DE7)
         self._counts = defaultdict(int)
         self.log: list = []          # (op, action, path) — what actually fired
 
@@ -180,7 +206,13 @@ class ChaosInjector:
                   bitflip_rate=cfg.bitflip_rate,
                   bitflip_target=cfg.bitflip_target,
                   bitflip_device=cfg.bitflip_device,
-                  bitflip_bit=cfg.bitflip_bit)
+                  bitflip_bit=cfg.bitflip_bit,
+                  slow_from_step=cfg.slow_from_step,
+                  slow_device=cfg.slow_device,
+                  slow_factor=cfg.slow_factor,
+                  slow_rate=cfg.slow_rate,
+                  slow_min_s=cfg.slow_min_s,
+                  slow_kind=cfg.slow_kind)
         inj.source = "config"
         return inj
 
@@ -219,6 +251,8 @@ class ChaosInjector:
                                  self.hang_at, self.delay_at, self.kill_at,
                                  self.preempt_at, self.shrink_at,
                                  self.grow_at)):
+            return True
+        if self.slow_armed() and op in ("collective", "train_step"):
             return True
         return self.hang_rate > 0 or self.preempt_rate > 0
 
@@ -415,6 +449,75 @@ class ChaosInjector:
             f"{self.bitflip_device}, target {self.bitflip_target}, bit "
             f"{bit}, element {elem} (silent: loss stays finite)")
         return jax.tree_util.tree_unflatten(treedef, flat)
+
+    def slow_armed(self) -> bool:
+        """Does the ``slow_device`` fault class aim anywhere? (A factor of
+        1.0 is not slow — the config validator refuses an armed block with
+        ``slow_factor <= 1``, mirroring bitflip's rate-0 rule.)"""
+        return (self.slow_factor > 1.0
+                and (self.slow_from_step >= 0 or self.slow_rate > 0.0))
+
+    def _slow_standdown(self) -> bool:
+        """An evicted chip cannot drag survivors: once the target device
+        is quarantined out of the simulated fleet, the fault stands down
+        (mirrors perturb_state's no-shard skip)."""
+        import sys as _sys
+
+        rz = _sys.modules.get("deepspeed_tpu.elasticity.resize")
+        return (rz is not None
+                and self.slow_device in rz.quarantined_devices())
+
+    def slow_active(self) -> bool:
+        """Is the persistent slowness currently in effect? Scripted mode
+        activates once the step count reaches ``slow_from_step`` and stays
+        on (fail-slow is PERSISTENT, unlike a one-shot flip); randomized
+        mode is per-call (see :meth:`slow_extra_s`)."""
+        if not self.slow_armed() or self._slow_standdown():
+            return False
+        if self.slow_from_step >= 0:
+            return self._counts["train_step"] >= self.slow_from_step
+        return True
+
+    def slow_extra_s(self, base_s: float) -> float:
+        """``slow_device`` fault class: the comm layer calls this after
+        timing each eager collective / serial gather phase with the
+        measured duration; the excess returned is slept INSIDE the timed
+        window, so the inflated wait lands in the comm span, the comms
+        logger's skew deque, and the straggler evidence — exactly like a
+        fleet blocking on one slow participant. ``slow_min_s`` floors the
+        excess so a drill's inflation is decisive even when the clean
+        collective is microseconds."""
+        if not self.slow_active():
+            return 0.0
+        if self.slow_from_step < 0 and self._slow_rng.random() >= self.slow_rate:
+            return 0.0
+        extra = max(float(base_s) * (self.slow_factor - 1.0), self.slow_min_s)
+        if not self._slow_logged:
+            self._slow_logged = True
+            self.log.append(("collective",
+                             f"slow dev{self.slow_device} "
+                             f"x{self.slow_factor:g}", "persistent"))
+            logger.warning(
+                f"chaos: slow_device ACTIVE — device {self.slow_device} "
+                f"collective waits inflated x{self.slow_factor:g} "
+                f"(kind={self.slow_kind})")
+        self._count("collective", "slow")
+        return extra
+
+    def gray_probe_extra_s(self, device_id: int, base_s: float,
+                           phase: str) -> float:
+        """Inflate the culprit device's microprobe phase so ds_gray's
+        probe classification is drillable: ``slow_kind="compute"`` drags
+        the local-matmul phase, ``"link"`` the neighbor transfer, and
+        ``"host"`` both (the probe calls this with phase "compute" or
+        "link")."""
+        if device_id != self.slow_device or not self.slow_active():
+            return 0.0
+        if self.slow_kind != "host" and phase != self.slow_kind:
+            return 0.0
+        extra = max(float(base_s) * (self.slow_factor - 1.0), self.slow_min_s)
+        self._count("probe", "slow")
+        return extra
 
     def perturb_collectives(self, records: list, rank: Optional[int] = None) -> list:
         """``collective_mismatch`` fault class: deterministically perturb ONE

@@ -451,6 +451,12 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
     bitflip_target: str = Field("params", description="which state tree the flip lands in: params | grads | opt_state (grads flips the freshly-updated params — a corrupted gradient manifests there)")
     bitflip_device: int = Field(0, ge=0, description="addressable-device index whose shard/replica takes the flip (replicas are NOT kept coherent — exactly the failure mode)")
     bitflip_bit: int = Field(12, ge=0, le=31, description="bit position in the 32-bit view of the chosen element (default low mantissa: values stay finite so the sentinel cannot trip first)")
+    slow_from_step: int = Field(-1, ge=-1, description="fail-slow drill (ds_gray): from this train step on, persistently inflate slow_device's collective waits by slow_factor — the gray-failure mode that drags every blocking collective; -1 = off")
+    slow_device: int = Field(0, ge=0, description="addressable-device index the fail-slow fault drags (stands down on its own once the device is quarantined out of the survivor set)")
+    slow_factor: float = Field(1.0, ge=0.0, description="collective-wait inflation multiple for the slow device (5.0 = the acceptance drill's decisively-slow chip); must be > 1 when the fault is armed")
+    slow_rate: float = Field(0.0, ge=0.0, le=1.0, description="randomized fail-slow: per-collective probability of inflating the wait (the multi-seed sweep); scripted slow_from_step ignores it")
+    slow_min_s: float = Field(0.0, ge=0.0, description="floor on the injected excess wait (s) — keeps a drill decisive when the clean collective is microseconds")
+    slow_kind: str = Field("compute", description="which microprobe phase the culprit inflates: compute | link | host (host = both) — makes ds_gray's slow-compute/slow-link/slow-host classification drillable")
 
     @model_validator(mode="after")
     def _fleet_drill_targets_set(self):
@@ -475,6 +481,18 @@ class ResilienceChaosConfig(DeepSpeedConfigModel):
             raise ValueError(
                 "resilience.chaos: bitflip_target must be 'params', 'grads' "
                 f"or 'opt_state', got {self.bitflip_target!r}")
+        # an armed fail-slow drill at factor <= 1 is not slow — a typo,
+        # not a drill (bitflip's rate-0 rule, applied to the multiplier)
+        if ((self.slow_from_step >= 0 or self.slow_rate > 0.0)
+                and self.slow_factor <= 1.0):
+            raise ValueError(
+                "resilience.chaos: slow_device fault is armed but "
+                f"slow_factor is {self.slow_factor} — name the inflation "
+                "multiple (> 1.0; 5.0 for the acceptance drill)")
+        if self.slow_kind not in ("compute", "link", "host"):
+            raise ValueError(
+                "resilience.chaos: slow_kind must be 'compute', 'link' or "
+                f"'host', got {self.slow_kind!r}")
         return self
 
 
@@ -817,6 +835,44 @@ class SdcConfig(DeepSpeedConfigModel):
     max_verdicts: int = Field(2, ge=0, description="SDC verdicts tolerated before giving up with SdcError (matches the sentinel's max_rewinds contract)")
 
 
+class GrayConfig(DeepSpeedConfigModel):
+    """ds_gray fail-slow defense (resilience/gray.py). The fault class
+    every other robustness layer ignores: a device that neither dies nor
+    lies but merely gets SLOW — a thermally-throttled chip, a flaky
+    link, a busy host — trips no watchdog and corrupts nothing, yet
+    drags every blocking collective to its pace, capping the whole
+    fleet's throughput. The defense is evidence-fused and probe-
+    confirmed: (1) a per-step suspicion EWMA fed by the comms logger's
+    window-skew straggler report, the goodput ``straggler_wait``
+    fraction, and watchdog near-miss margins, with hysteresis +
+    min-evidence floors so recompiles and one-off GC pauses never
+    false-positive; (2) past the blame threshold, a tiny synchronized
+    microprobe OFF the step path (per-device local matmul + pairwise
+    neighbor transfer) names the culprit and separates slow-compute vs
+    slow-link vs slow-host, priced as the goodput ``probe`` badput
+    bucket and gated by ``ds_perf gate`` as ``gray_overhead``; (3) after
+    ``probe_confirmations`` consecutive probes agree, a ``GrayVerdict``
+    lands in telemetry + restart_log.jsonl and the culprit is evicted
+    via the same TBS-divisibility-stepped fleet shrink ds_sentry uses
+    (``evict: false`` = report-only; ``max_verdicts`` exceeded
+    escalates to GrayError). STRICT no-op when the block is absent: the
+    module is never imported and the lowered step HLO is byte-identical
+    (asserted in tests). See docs/CONFIG.md 'gray' section for the
+    detection-latency-vs-threshold table."""
+    enabled: bool = Field(True, description="arm the fail-slow defense (the block being present opts in; set false to keep the block but skip the work)")
+    suspicion_threshold: float = Field(3.0, gt=1.0, description="comms-logger window skew (max/mean of the recent-latency deque) counted as straggler evidence — the comms logger's own STRAGGLER_SKEW default")
+    blame_threshold: float = Field(0.6, gt=0.0, le=1.0, description="suspicion EWMA level that triggers microprobe confirmation (lower = faster detection, more probes)")
+    warn_threshold: float = Field(0.3, ge=0.0, description="suspicion EWMA level that logs a warning + telemetry event (the observe -> warn rung of the action ladder)")
+    hysteresis: float = Field(0.85, gt=0.0, lt=1.0, description="EWMA decay per step — suspicion s' = h*s + (1-h)*evidence; higher = slower to accuse AND slower to forgive (the false-positive floor)")
+    min_evidence: int = Field(3, ge=1, description="distinct evidence-bearing steps required before any probe — a single recompile spike or GC pause can never reach a probe, let alone a verdict")
+    probe_interval: int = Field(10, gt=0, description="minimum steps between suspicion-triggered microprobes — bounds probe badput even under sustained suspicion")
+    probe_every: int = Field(0, ge=0, description="ALSO probe unconditionally every N steps (0 = suspicion-only) — the bench/CI cadence that prices gray_overhead deterministically")
+    probe_confirmations: int = Field(2, ge=1, description="consecutive probes that must name the SAME device before a verdict — one noisy probe never evicts")
+    probe_size: int = Field(256, ge=8, description="square matmul dimension / transfer payload rows of the microprobe (tiny by design: the probe must cost microseconds)")
+    evict: bool = Field(True, description="on a confirmed verdict, quarantine the culprit and raise the TBS-stepped FleetResizeEvent shrink (needs elasticity.resize armed); false = report-only (verdicts land in telemetry/restart_log but the fleet keeps its drag)")
+    max_verdicts: int = Field(2, ge=0, description="gray verdicts tolerated before giving up with GrayError (matches sdc.max_verdicts / sentinel max_rewinds)")
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Verified checkpoints + recovery policy (resilience/ package). See
     docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
@@ -905,6 +961,11 @@ class DeepSpeedConfig:
         # module (never imported; no AOT compiles, no ledger stamps)
         self.roofline = RooflineConfig(**pd.get("roofline", {}))
         self.roofline_present = "roofline" in pd
+        # presence matters, same contract again: no block, no gray module
+        # (never imported; no probes, no suspicion state, lowered step
+        # HLO byte-identical)
+        self.gray = GrayConfig(**pd.get("gray", {}))
+        self.gray_present = "gray" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -972,7 +1033,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "rewind", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wire", "sdc", "roofline", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wire", "sdc", "roofline", "gray", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

@@ -619,6 +619,18 @@ class DeepSpeedEngine:
             from deepspeed_tpu.resilience.sdc import SdcManager
 
             self._sdc = SdcManager(self, self._config.sdc)
+        # ---- gray failure defense (ds_gray) -------------------------------
+        # fail-slow defense (resilience/gray.py): straggler-skew evidence
+        # fusion, microprobe confirmation (slow-compute/link/host), and
+        # quarantine-and-evict via the same fleet-shrink path as ds_sentry.
+        # STRICT no-op when the ``gray`` block is absent: the module is
+        # never imported, no probes run, and the lowered step HLO is
+        # byte-identical (asserted in tests).
+        self._gray = None
+        if self._config.gray_present and self._config.gray.enabled:
+            from deepspeed_tpu.resilience.gray import GrayManager
+
+            self._gray = GrayManager(self, self._config.gray)
         self._flops_probe = None
         dist.configure(self._config)
         self.flops_profiler_cfg = self._config.flops_profiler_config
@@ -1712,6 +1724,10 @@ class DeepSpeedEngine:
                 # replay audit + blame; may raise FleetResizeEvent
                 # (quarantine-and-evict) or rewind the engine in place
                 self._sdc.after_step(self._host_step, metrics)
+            if self._gray is not None:
+                # fail-slow evidence fusion + microprobe; may raise
+                # FleetResizeEvent (quarantine-and-evict) or GrayError
+                self._gray.after_step(self._host_step, metrics)
             if self._rewind is not None:
                 # AFTER the sentinel: a step the sentinel flagged (or a
                 # rewound-to step) must not enter the tier-0 ring

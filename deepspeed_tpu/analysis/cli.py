@@ -5,7 +5,8 @@ Usage::
     ds_doctor --config ds_config.json [options]
 
 Options:
-    --config PATH          ds_config JSON (required unless --passes selflint)
+    --config PATH          ds_config JSON (required unless --passes names
+                           only selflint / race)
     --model FAMILY         trace a registry family's fwd+bwd graph under the
                            config's compute dtype (gpt2 | llama | moe | bert,
                            or any preset name like gpt2-tiny)
@@ -18,7 +19,8 @@ Options:
                            rank (analysis.collectives.CollectiveRecorder
                            .save); two or more are diffed across ranks
     --passes LIST          comma list of schema,sharding,graph,collectives,
-                           selflint (default: every pass its inputs allow)
+                           race,selflint (default: every pass its inputs
+                           allow)
     --fail-on LEVEL        error | warn | never (default error): exit 2 when
                            findings at/above LEVEL exist
     --world-size N         data-parallel world for batch-triple validation
@@ -39,6 +41,16 @@ donation audit, static comm bytes) — the post-GSPMD layer the trace
 passes cannot see. ``--devices N`` forces N simulated CPU devices (set
 before the jax backend initializes), so an 8-way ZeRO config x-rays on a
 laptop.
+
+Subcommand::
+
+    ds_doctor race [--witness FILE ...] [--allow RULE ...]
+
+host-side concurrency analysis: the static lock-order / blocking-under-
+lock / signal-safety lint over the package (and bin/* + bench.py), plus
+offline analysis of runtime lock-witness logs (``utils.locks
+.save_witness``) — acquisition-order inversions are reported with both
+call sites even when no deadlock ever manifested. Needs no --config.
 """
 
 from __future__ import annotations
@@ -151,10 +163,66 @@ def xray_cli(argv) -> int:
     return 2 if report.should_fail(args.fail_on) else 0
 
 
+def race_cli(argv) -> int:
+    """``ds_doctor race`` — the host-side concurrency report: static
+    lock-order cycles, blocking calls under framework locks, signal-
+    handler safety, and (with ``--witness``) acquisition-order inversions
+    observed at runtime by the instrumented lock factory."""
+    ap = argparse.ArgumentParser(
+        prog="ds_doctor race",
+        description="static lock-order / blocking-under-lock / "
+                    "signal-safety lint over the package, plus offline "
+                    "witness-log inversion analysis")
+    ap.add_argument("--root", default=None,
+                    help="package root to analyze (default: the installed "
+                         "deepspeed_tpu package)")
+    ap.add_argument("--no-scripts", action="store_true",
+                    help="skip bin/* + bench.py (package modules only — "
+                         "the scope the engine-init pass uses)")
+    ap.add_argument("--witness", action="append", default=[],
+                    help="witness JSON from utils.locks.save_witness(); "
+                         "repeatable — edges are unioned across files "
+                         "(ranks), inversions cite both acquire sites")
+    ap.add_argument("--allow", action="append", default=[],
+                    help="suppress 'race/<rule>[:<citation substr>]' "
+                         "(same grammar as the analysis.race_allowlist "
+                         "config knob)")
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warn", "never"])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu.analysis.findings import AnalysisReport
+    from deepspeed_tpu.analysis.race import (lint_race, load_witness,
+                                             witness_findings)
+
+    report = AnalysisReport()
+    report.extend(lint_race(root=args.root,
+                            include_scripts=not args.no_scripts,
+                            allowlist=tuple(args.allow)), "race")
+    if args.witness:
+        edges = []
+        for path in args.witness:
+            try:
+                edges.extend(load_witness(path))
+            except (OSError, ValueError) as e:
+                print(f"ds_doctor race: cannot read witness {path}: {e}",
+                      file=sys.stderr)
+                return 1
+        report.extend(witness_findings(edges), "race")
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render("ds_doctor race"))
+    return 2 if report.should_fail(args.fail_on) else 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "xray":
         return xray_cli(argv[1:])
+    if argv and argv[0] == "race":
+        return race_cli(argv[1:])
     args = _parse(argv)
     from deepspeed_tpu.analysis.doctor import ALL_PASSES, run_doctor
 
@@ -166,9 +234,10 @@ def main(argv=None) -> int:
         print(f"ds_doctor: unknown pass(es) {unknown}; known: {ALL_PASSES}",
               file=sys.stderr)
         return 1
-    if args.config is None and set(passes or ALL_PASSES) != {"selflint"}:
-        print("ds_doctor: --config is required (or --passes selflint)",
-              file=sys.stderr)
+    if args.config is None and \
+            not set(passes or ALL_PASSES) <= {"selflint", "race"}:
+        print("ds_doctor: --config is required (or --passes "
+              "selflint and/or race)", file=sys.stderr)
         return 1
 
     graph = None

@@ -20,15 +20,19 @@ from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
 from deepspeed_tpu.analysis.findings import AnalysisReport, Finding
 
-ALL_PASSES = ("schema", "sharding", "graph", "collectives", "selflint",
-              "xray")
+ALL_PASSES = ("schema", "sharding", "graph", "collectives", "race",
+              "selflint", "xray")
 # what "no --passes given" expands to: every TRACE-ONLY pass. xray is
 # deliberately absent — it AOT-compiles programs (XLA, not a trace), so it
 # runs only when named explicitly (same opt-in contract as the engine's).
-DEFAULT_PASSES = ("schema", "sharding", "graph", "collectives", "selflint")
+DEFAULT_PASSES = ("schema", "sharding", "graph", "collectives", "race",
+                  "selflint")
 # what the engine runs by default (selflint is a CI concern, not a job's;
-# xray costs one AOT compile per program — explicit opt-in only)
-ENGINE_PASSES = ("schema", "sharding", "graph", "collectives")
+# xray costs one AOT compile per program — explicit opt-in only. race IS
+# here: it is AST-over-package host work like the unspecified-jit lint,
+# seconds once per process, and a lock-order cycle is exactly the defect
+# you want before step 0, not after the fleet wedges)
+ENGINE_PASSES = ("schema", "sharding", "graph", "collectives", "race")
 
 
 def _wants(acfg, name: str) -> bool:
@@ -72,6 +76,18 @@ def engine_init_analysis(engine, param_shapes) -> AnalysisReport:
         # must not die at engine init over scripts that never run
         report.extend(lint_unspecified_jit(include_scripts=False),
                       "sharding")
+    if _wants(acfg, "race"):
+        from deepspeed_tpu.analysis.race import lint_race
+
+        # same package-only scope as the jit lint (scripts are CI's
+        # problem), same memoized once-per-process cost
+        report.extend(lint_race(include_scripts=False,
+                                allowlist=tuple(acfg.race_allowlist)),
+                      "race")
+        if acfg.race_witness:
+            from deepspeed_tpu.utils import locks as _locks
+
+            _locks.enable_witness()
     return _finish(report, acfg.fail_on,
                    log=lambda m: log_dist(m, ranks=[0]))
 
@@ -297,7 +313,7 @@ def run_doctor(config: Any,
 
     cfg = None
     schema_findings = []
-    if any(p in passes for p in ("schema", "sharding", "graph")):
+    if any(p in passes for p in ("schema", "sharding", "graph", "race")):
         from deepspeed_tpu.analysis.schema import walk_config
 
         schema_findings, cfg = walk_config(config, world_size=world_size)
@@ -423,6 +439,12 @@ def run_doctor(config: Any,
             skipped("collectives",
                     "needs --collective-log files (one per rank, two or "
                     "more) recorded via analysis.collectives")
+
+    if "race" in passes:
+        from deepspeed_tpu.analysis.race import lint_race
+
+        allow = tuple(cfg.analysis.race_allowlist) if cfg is not None else ()
+        report.extend(lint_race(allowlist=allow), "race")
 
     if "selflint" in passes:
         from deepspeed_tpu.analysis.selflint import lint_package

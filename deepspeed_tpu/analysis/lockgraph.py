@@ -1,0 +1,182 @@
+"""Lock-acquisition order graph — shared by the static ``race/lock-order``
+pass (nodes extracted from the AST) and the runtime witness (nodes observed
+by the instrumented lock factory). Nodes are lock ORDER CLASSES (the stable
+dotted names from utils/locks.py, or synthesized ``module.Class.attr`` ids
+for hand-rolled locks); a directed edge ``A -> B`` means "B was acquired
+while A was held", carrying the first-seen citation for BOTH sides. A cycle
+is the ABBA deadlock shape: every report names every participating call
+site."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class Aliases:
+    """Union-find over lock identities. A lock injected through a
+    constructor (``CircuitBreaker(..., lock=rlock)``) or re-bound
+    (``self._lock = threading.Condition(rlock)``) is the SAME order class
+    as its source — without this, the fixed frontend/breaker shared-RLock
+    pattern reads as two locks and false-positives a cycle."""
+
+    def __init__(self):
+        self._parent: Dict[str, str] = {}
+        self._reentrant: Dict[str, bool] = {}
+
+    def find(self, x: str) -> str:
+        p = self._parent.setdefault(x, x)
+        if p != x:
+            p = self._parent[x] = self.find(p)
+        return p
+
+    def union(self, a: str, b: str) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra == rb:
+            return
+        # deterministic canonical pick: lexicographically smaller root wins
+        # (stable findings across runs)
+        lo, hi = sorted((ra, rb))
+        self._parent[hi] = lo
+        self._reentrant[lo] = (self._reentrant.get(ra, False)
+                               or self._reentrant.get(rb, False))
+
+    def mark_reentrant(self, x: str, reentrant: bool = True) -> None:
+        r = self.find(x)
+        self._reentrant[r] = self._reentrant.get(r, False) or reentrant
+
+    def is_reentrant(self, x: str) -> bool:
+        return self._reentrant.get(self.find(x), False)
+
+
+class LockGraph:
+    def __init__(self):
+        # (src, dst) -> (src_site, dst_site, count); first citations win
+        self.edges: Dict[Tuple[str, str], Tuple[str, str, int]] = {}
+
+    def add_edge(self, src: str, dst: str, src_site: str,
+                 dst_site: str) -> None:
+        cur = self.edges.get((src, dst))
+        if cur is None:
+            self.edges[(src, dst)] = (src_site, dst_site, 1)
+        else:
+            self.edges[(src, dst)] = (cur[0], cur[1], cur[2] + 1)
+
+    def _adj(self) -> Dict[str, List[str]]:
+        adj: Dict[str, List[str]] = {}
+        for (s, d) in self.edges:
+            adj.setdefault(s, []).append(d)
+            adj.setdefault(d, [])
+        for v in adj.values():
+            v.sort()
+        return adj
+
+    def cycles(self) -> List[List[Tuple[str, str, str, str]]]:
+        """Every elementary ordering conflict, as a list of cycles; each
+        cycle is an ordered edge list ``(src, dst, src_site, dst_site)``
+        closing back on its first node. Self-loops (a non-reentrant class
+        acquired under itself) are single-edge cycles. Reported once per
+        strongly-connected component (one representative cycle each — one
+        defect, one finding), deterministically ordered."""
+        adj = self._adj()
+        sccs = _tarjan(adj)
+        out: List[List[Tuple[str, str, str, str]]] = []
+        for comp in sccs:
+            comp_set = set(comp)
+            if len(comp) == 1:
+                n = comp[0]
+                if (n, n) in self.edges:        # self-loop
+                    s_site, d_site, _ = self.edges[(n, n)]
+                    out.append([(n, n, s_site, d_site)])
+                continue
+            cyc = self._representative_cycle(sorted(comp)[0], comp_set, adj)
+            if cyc:
+                out.append(cyc)
+        out.sort(key=lambda c: c[0][:2])
+        return out
+
+    def _representative_cycle(self, start: str, comp: set,
+                              adj) -> Optional[List[Tuple[str, str, str, str]]]:
+        """Shortest cycle through ``start`` inside its SCC (BFS back to
+        start) — for the 2-node ABBA case this is exactly the A->B / B->A
+        edge pair."""
+        from collections import deque
+
+        prev: Dict[str, Optional[str]] = {start: None}
+        q = deque([start])
+        back = None
+        while q and back is None:
+            u = q.popleft()
+            for v in adj.get(u, ()):
+                if v not in comp:
+                    continue
+                if v == start:
+                    back = u
+                    break
+                if v not in prev:
+                    prev[v] = u
+                    q.append(v)
+        if back is None:        # pragma: no cover - SCC guarantees a cycle
+            return None
+        path = [start]
+        node: Optional[str] = back
+        tail: List[str] = []
+        while node is not None and node != start:
+            tail.append(node)
+            node = prev[node]
+        path += list(reversed(tail))
+        edges = []
+        for i, src in enumerate(path):
+            dst = path[(i + 1) % len(path)]
+            s_site, d_site, _ = self.edges[(src, dst)]
+            edges.append((src, dst, s_site, d_site))
+        return edges
+
+
+def _tarjan(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC (the package AST can nest deeper than the
+    recursion limit would like)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack[root] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack[nxt] = True
+                    work.append((nxt, iter(adj[nxt])))
+                    advanced = True
+                    break
+                elif on_stack.get(nxt):
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+    return sccs

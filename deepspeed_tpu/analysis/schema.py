@@ -451,6 +451,27 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
             "off — entries will carry memory/flops attribution only (enable "
             "the telemetry block for the full breakdown)",
             "perf.attribution vs telemetry.trace")
+    ac = cfg.analysis
+    if "analysis" in pd and ac.enabled:
+        if ac.race_witness and not tel.enabled:
+            add("warning",
+                "analysis.race_witness records lock-acquisition order for "
+                "the race pass's inversion report and the SIGUSR1 "
+                "lock-holders table, but telemetry is off — the witness "
+                "still records (and ds_doctor race --witness reads saved "
+                "logs), you just lose the correlated trace/series view; "
+                "enable the telemetry block",
+                "analysis.race_witness vs telemetry.enabled")
+        for entry in ac.race_allowlist:
+            rule = str(entry).split(":", 1)[0]
+            known = ("race/lock-order", "race/blocking-under-lock",
+                     "race/signal-unsafe", "race/witness-inversion")
+            if rule not in known:
+                add("warning",
+                    f"analysis.race_allowlist entry {entry!r} names unknown "
+                    f"rule {rule!r} — it suppresses nothing; known rules: "
+                    f"{', '.join(known)}",
+                    "analysis.race_allowlist")
 
 
 def walk_config(pd: dict, world_size: Optional[int] = None

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.parallel.topology import (ALL_AXES, DP_AXES, build_mesh)
+from deepspeed_tpu.utils import locks as _locks
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 # jax.shard_map graduated from jax.experimental in 0.5; the shared compat
@@ -423,25 +424,30 @@ class CommsLogger:
         self.comms_dict = {}
         # (raw_name, msg_size) -> deque of the last STRAGGLER_WINDOW latencies
         self._recent = {}
+        # timed ops fire from checkpoint-I/O / serving / watchdog threads
+        # while the main thread reads log_all/straggler_report: every
+        # multi-field comms_dict/_recent update is one critical section
+        self._lock = _locks.make_lock("comm.logger")
 
     def append(self, raw_name, record_name, latency, msg_size, n=1):
-        entry = self.comms_dict.setdefault(raw_name, {})
-        # per-size record: [count, latencies, algo GB/s, bus GB/s] — same
-        # 4-slot layout as the reference's comms_dict
-        sizes = entry.setdefault(msg_size, [0, [], [], []])
-        sizes[0] += 1
-        sizes[1].append(latency)
-        if latency > 0:
-            algbw = msg_size / latency / 1e9
-            sizes[2].append(algbw)
-            sizes[3].append(algbw * _busbw_factor(raw_name, n))
-        key = (raw_name, msg_size)
-        recent = self._recent.get(key)
-        if recent is None:
-            from collections import deque
+        with self._lock:
+            entry = self.comms_dict.setdefault(raw_name, {})
+            # per-size record: [count, latencies, algo GB/s, bus GB/s] — same
+            # 4-slot layout as the reference's comms_dict
+            sizes = entry.setdefault(msg_size, [0, [], [], []])
+            sizes[0] += 1
+            sizes[1].append(latency)
+            if latency > 0:
+                algbw = msg_size / latency / 1e9
+                sizes[2].append(algbw)
+                sizes[3].append(algbw * _busbw_factor(raw_name, n))
+            key = (raw_name, msg_size)
+            recent = self._recent.get(key)
+            if recent is None:
+                from collections import deque
 
-            self._recent[key] = recent = deque(maxlen=self.STRAGGLER_WINDOW)
-        recent.append(latency)
+                self._recent[key] = recent = deque(maxlen=self.STRAGGLER_WINDOW)
+            recent.append(latency)
         if self.verbose:
             log_dist(f"comm op: {record_name} | msg size: {msg_size} | latency(ms): {latency*1000:.2f}", ranks=[0])
 
@@ -451,7 +457,8 @@ class CommsLogger:
         culprit's dragged latencies — a consumer baselining a NEW fleet
         (ds_gray re-arming on the survivors) must start them empty or the
         stale tail reads as fresh skew for up to STRAGGLER_WINDOW calls."""
-        self._recent.clear()
+        with self._lock:
+            self._recent.clear()
 
     def straggler_report(self):
         """Per-(op, size) max-vs-mean latency skew over the recent window.
@@ -463,7 +470,9 @@ class CommsLogger:
         without adding barriers. Returns [(op, size, n, mean, max, skew)].
         """
         rows = []
-        for (op, size), lats in sorted(self._recent.items()):
+        with self._lock:
+            snap = {k: list(v) for k, v in self._recent.items()}
+        for (op, size), lats in sorted(snap.items()):
             if not lats:
                 continue
             mean = sum(lats) / len(lats)
@@ -476,7 +485,8 @@ class CommsLogger:
         """One key's max-vs-mean skew over the recent window — the
         ``straggler_report`` row for the just-appended op, O(window), so
         the comm layer can export it as a live gauge per call."""
-        lats = self._recent.get((raw_name, msg_size))
+        with self._lock:
+            lats = list(self._recent.get((raw_name, msg_size)) or ())
         if not lats:
             return 0.0
         mean = sum(lats) / len(lats)
@@ -491,8 +501,9 @@ class CommsLogger:
         2x trigger keep cold windows and ordinary jitter at exactly
         0.0 — the goodput ``straggler_wait`` bucket must stay empty on a
         healthy rank."""
-        lats = self._recent.get((raw_name, msg_size))
-        if lats is None or len(lats) < self.STRAGGLER_MIN_SAMPLES:
+        with self._lock:
+            lats = list(self._recent.get((raw_name, msg_size)) or ())
+        if len(lats) < self.STRAGGLER_MIN_SAMPLES:
             return 0.0
         fastest = sorted(lats)[:max(1, len(lats) // 2)]
         baseline = sum(fastest) / len(fastest)
@@ -502,7 +513,11 @@ class CommsLogger:
 
     def log_all(self, print_log=True, show_straggler=False):
         lines = ["Comms summary:"]
-        for op, per_size in self.comms_dict.items():
+        with self._lock:
+            snap = {op: {size: (rec[0], list(rec[1]), list(rec[2]), list(rec[3]))
+                         for size, rec in per_size.items()}
+                    for op, per_size in self.comms_dict.items()}
+        for op, per_size in snap.items():
             for size, (count, lats, bws, busbws) in sorted(per_size.items()):
                 avg_lat = sum(lats) / max(1, len(lats))
                 avg_bw = sum(bws) / max(1, len(bws)) if bws else 0.0

@@ -401,6 +401,13 @@ def main(args=None):
         from deepspeed_tpu.analysis.cli import doctor_section
 
         return doctor_section(args[1:])
+    if args and args[0] == "race":
+        # `ds_report race [--witness F]` — the host-side concurrency
+        # report (static lock-order / blocking / signal lint + witness
+        # inversions); the full tool is `ds_doctor race`
+        from deepspeed_tpu.analysis.cli import race_cli
+
+        return race_cli(args[1:])
     if args and args[0] == "goodput":
         # `ds_report goodput <telemetry_dir>` — the LATEST session's
         # goodput bucket table (job-level cross-restart stitching is

@@ -20,17 +20,17 @@ import hashlib
 import os
 import subprocess
 import tempfile
-import threading
 from typing import Optional
 
 import numpy as np
 
+from deepspeed_tpu.utils import locks as _locks
 from deepspeed_tpu.utils.logging import logger
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
                     "csrc", "aio", "ds_aio.cpp")
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = _locks.make_lock("aio.lib")
 
 
 def _build_lib() -> str:

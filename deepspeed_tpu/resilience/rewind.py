@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.utils import locks as _locks
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 from deepspeed_tpu.runtime.checkpoint_engine.engine import (  # noqa: F401
@@ -97,16 +98,22 @@ class RamSnapshot:
 # engine-level users driving trains without an agent own the same
 # hygiene via clear_ram_snapshots().
 _RING: List[RamSnapshot] = []
+# capture runs on the train loop while the emergency-flush / SDC-condemn
+# paths walk the ring from watchdog and agent threads: append+trim and
+# every walk are critical sections
+_RING_LOCK = _locks.make_lock("rewind.ring")
 
 
 def ram_snapshots() -> List[RamSnapshot]:
     """The live tier-0 ring, oldest-first (read-only view)."""
-    return list(_RING)
+    with _RING_LOCK:
+        return list(_RING)
 
 
 def clear_ram_snapshots() -> None:
     """Drop the tier-0 ring (tests / an operator abandoning a run)."""
-    _RING.clear()
+    with _RING_LOCK:
+        _RING.clear()
 
 
 def _registry():
@@ -201,25 +208,28 @@ class RewindManager:
             ckpt_dir=os.path.abspath(ckpt_dir) if ckpt_dir else None)
         if self.checksummer is not None:
             snap.checksum = self.checksummer(snap.flat)
-        _RING.append(snap)
-        del _RING[:-int(self.cfg.keep)]
+        with _RING_LOCK:
+            _RING.append(snap)
+            del _RING[:-int(self.cfg.keep)]
+            held = len(_RING)
+            nbytes = sum(s.nbytes for s in _RING)
         reg = _registry()
         reg.counter("rewind/snapshots_taken").inc()
         reg.gauge("rewind/ram_snapshot_step").set(float(snap.step))
-        reg.gauge("rewind/ram_snapshots_held").set(float(len(_RING)))
-        reg.gauge("rewind/ram_bytes").set(float(sum(s.nbytes for s in _RING)))
+        reg.gauge("rewind/ram_snapshots_held").set(float(held))
+        reg.gauge("rewind/ram_bytes").set(float(nbytes))
         return snap
 
     def newest(self) -> Optional[RamSnapshot]:
         """Newest non-poisoned ring entry (the emergency flush must never
         persist a snapshot an SDC verdict condemned)."""
-        for snap in reversed(_RING):
+        for snap in reversed(ram_snapshots()):
             if not snap.poisoned:
                 return snap
         return None
 
     def has_ram_snapshot(self) -> bool:
-        return self.active and bool(_RING)
+        return self.active and bool(ram_snapshots())
 
     # ------------------------------------------------------------ restore
     def _snapshot_mismatch(self, snap: RamSnapshot) -> Optional[str]:
@@ -260,7 +270,7 @@ class RewindManager:
             return None
         eng = self.engine
         for_dir = os.path.abspath(for_dir) if for_dir else None
-        for snap in reversed(_RING):
+        for snap in reversed(ram_snapshots()):
             if snap.poisoned:
                 logger.warning(
                     f"rewind: RAM snapshot @step {snap.step} is marked "

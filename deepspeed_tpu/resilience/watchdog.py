@@ -43,6 +43,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from deepspeed_tpu.utils import locks as _locks
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -84,10 +85,19 @@ def dump_all_stacks(path: Optional[str] = None, reason: str = "") -> None:
     raises: the dump is diagnostic garnish on an abort already underway."""
     path = path or _default_dump_path
     banner = f"\n==== watchdog stack dump ({reason or 'requested'}) ====\n"
+    # a live wedge names its holder: which instrumented lock is held, by
+    # which thread, since when — the stack dump says where threads ARE,
+    # this says what they are waiting FOR
+    try:
+        holders = _locks.format_lock_holders() + "\n"
+    except Exception as e:  # pragma: no cover - diagnostic path
+        holders = f"lock holders: unavailable ({e})\n"
     try:
         sys.stderr.write(banner)
         sys.stderr.flush()
         faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        sys.stderr.write(holders)
+        sys.stderr.flush()
     except Exception as e:  # pragma: no cover - diagnostic path
         logger.warning(f"watchdog: stderr stack dump failed: {e}")
     if path:
@@ -96,6 +106,7 @@ def dump_all_stacks(path: Optional[str] = None, reason: str = "") -> None:
                 f.write(banner)
                 f.flush()
                 faulthandler.dump_traceback(file=f, all_threads=True)
+                f.write(holders)
         except Exception as e:  # pragma: no cover - diagnostic path
             logger.warning(f"watchdog: stack dump to {path} failed: {e}")
 
@@ -170,7 +181,10 @@ def run_with_deadline(fn: Callable, timeout: float, name: str = "op",
         finally:
             done.set()
 
-    t = threading.Thread(target=worker, name=f"ds-deadline-{name}", daemon=True)
+    # expect_join=False: a worker wedged past its deadline is DISOWNED by
+    # design — the leak sentinel must not count it against teardown
+    t = _locks.spawn_thread(worker, name=f"ds-deadline-{name}",
+                            owner="watchdog", daemon=True, expect_join=False)
     t.start()
     if not done.wait(timeout):
         _count_timeout("deadline", stall_s=timeout if stall_span else None)
@@ -227,7 +241,7 @@ class StepWatchdog:
         self.trips = 0
         self.last_trip_reason = ""
         self._durations: deque = deque(maxlen=int(window))
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("watchdog.step")
         self._armed_tid: Optional[int] = None
         self._armed_at = 0.0
         self._deadline = 0.0
@@ -313,8 +327,9 @@ class StepWatchdog:
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._monitor, name=f"ds-watchdog-{self.name}", daemon=True)
+            self._thread = _locks.spawn_thread(
+                self._monitor, name=f"ds-watchdog-{self.name}",
+                owner="watchdog", daemon=True)
             self._thread.start()
 
     def _monitor(self) -> None:

@@ -226,8 +226,10 @@ def _get_async_checkpointer():
 
 import threading as _threading  # noqa: E402
 
+from deepspeed_tpu.utils import locks as _locks  # noqa: E402
+
 _pending_latest_threads: list = []
-_pending_lock = _threading.Lock()
+_pending_lock = _locks.make_lock("checkpoint.pending")
 
 
 def register_pending_save(thread) -> None:
@@ -426,8 +428,6 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             # background write commits (orbax's atomic rename): otherwise a
             # crash mid-write strands a restart on a tag whose state/ never
             # materialized
-            import threading
-
             def _deferred():
                 try:
                     _get_async_checkpointer().wait_until_finished()
@@ -437,9 +437,10 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                                  f"({e}); 'latest' was not advanced and the tag "
                                  "may not verify")
 
-            t = threading.Thread(target=_deferred, daemon=True)
+            t = _locks.spawn_thread(_deferred, name=f"ds-ckpt-finalize-{tag}",
+                                    owner="checkpoint", daemon=True)
             t.start()
-            _pending_latest_threads.append(t)
+            register_pending_save(t)    # lock-guarded, unlike a bare append
         else:
             _finalize()
     log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])

@@ -558,11 +558,13 @@ class AnalysisConfig(DeepSpeedConfigModel):
     'analysis' section for the rule table."""
     enabled: bool = Field(True, description="run the analyzer at engine init + first train_batch (the block being present opts in; set false to keep the block but skip the work)")
     fail_on: str = Field("error", description="'error' aborts init/step-0 on any error finding; 'warn' also on warnings; 'never' reports only")
-    passes: list = Field([], description="subset of (schema, sharding, graph, collectives, xray) to run; empty = the first four (selflint is a CI pass, not an engine pass; xray — the post-GSPMD compiled-HLO analyzer — costs one AOT compile per program and runs after the FIRST train_batch, so it must be named explicitly)")
+    passes: list = Field([], description="subset of (schema, sharding, graph, collectives, race, xray) to run; empty = schema+sharding+graph+collectives+race (selflint is a CI pass, not an engine pass; xray — the post-GSPMD compiled-HLO analyzer — costs one AOT compile per program and runs after the FIRST train_batch, so it must be named explicitly)")
     record_collectives: bool = Field(True, description="record this rank's static collective sequence during the step trace and cross-check it against the other ranks")
     min_promote_elements: int = Field(65536, gt=0, description="dtype-promotion lint fires only for matmuls with an operand at least this large (scalar/loss-path fp32 math is fine)")
     min_replicated_elements: int = Field(100_000, gt=0, description="sharding lint ignores leaves smaller than this (small leaves are intentionally kept whole)")
     min_donate_bytes: int = Field(64 << 20, gt=0, description="donation lint ignores undonated args smaller than this")
+    race_witness: bool = Field(False, description="enable the runtime lock witness: the instrumented lock factory records per-thread acquisition order and the race pass flags order inversions even without a manifest deadlock (~ns per acquire; pairs with telemetry for the SIGUSR1 lock-holders table)")
+    race_allowlist: list = Field([], description="race findings to suppress, entries 'race/<rule>[:<citation substring>]' — prefer in-code '# race-allow: <rule> — <why>' comments, which the lint verifies carry a justification")
 
     @field_validator("fail_on")
     @classmethod
@@ -575,8 +577,8 @@ class AnalysisConfig(DeepSpeedConfigModel):
     @field_validator("passes")
     @classmethod
     def _passes_known(cls, v):
-        known = ("schema", "sharding", "graph", "collectives", "selflint",
-                 "xray")
+        known = ("schema", "sharding", "graph", "collectives", "race",
+                 "selflint", "xray")
         bad = [p for p in v if p not in known]
         if bad:
             raise ValueError(f"analysis.passes: unknown pass(es) {bad}; "

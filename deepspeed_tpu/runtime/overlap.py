@@ -63,6 +63,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.runtime.zero.partition import (ShardingPlan, _axes_of,
                                                   _spec_tuple)
+from deepspeed_tpu.utils import locks as _locks
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 # ---------------------------------------------------------------------------
@@ -662,7 +663,7 @@ class AsyncSnapshotter:
     def __init__(self, engine):
         self.engine = engine
         self._copy = None
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("overlap.snapshotter")
 
     def _device_copy(self, state):
         if self._copy is None:
@@ -708,7 +709,14 @@ class AsyncSnapshotter:
         with self._lock:
             # one in-flight snapshot at a time: a second save while the
             # first still writes would double the resident copy AND race
-            # the 'latest' advance ordering
+            # the 'latest' advance ordering. Deliberately blocking inside
+            # the lock: the drain IS the serialization the lock exists for
+            # (callers are the step loop + at-exit paths, never
+            # latency-critical), and no pending committer ever takes
+            # overlap.snapshotter — a leaf lock, no cycle possible
+            # (wait_for_pending_saves joins outside its own lock and skips
+            # the current thread).
+            # race-allow: blocking-under-lock — leaf-lock drain is the point
             ckpt.wait_for_pending_saves()
             snap = self._device_copy(eng.state)
             # host-side progress facts captured NOW, not when the
@@ -730,8 +738,9 @@ class AsyncSnapshotter:
                         f"async checkpoint snapshot {tag}: background save "
                         f"failed ({e}); 'latest' was not advanced")
 
-            t = threading.Thread(target=_commit, daemon=True,
-                                 name=f"ds-snapshot-{tag}")
+            t = _locks.spawn_thread(_commit, daemon=True,
+                                    name=f"ds-ckpt-snapshot-{tag}",
+                                    owner="checkpoint")
             ckpt.register_pending_save(t)
             t.start()
         return True

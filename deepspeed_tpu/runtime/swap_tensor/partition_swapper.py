@@ -17,11 +17,11 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.utils import locks as _locks
 from deepspeed_tpu.utils.logging import logger
 
 MIN_AIO_BYTES = 1024 * 1024
@@ -75,7 +75,7 @@ class AsyncTensorSwapper:
         self._manifest: Dict[str, Tuple[tuple, np.dtype]] = {}
         self._buffers: Dict[str, SwapBuffer] = {}
         self._pending: Dict[str, str] = {}  # name -> "r" | "w"
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("swap.partition")
         self._swap_out_bytes = 0
         self._swap_in_bytes = 0
 

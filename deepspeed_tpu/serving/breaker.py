@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Callable, Optional, Tuple
 
+from deepspeed_tpu.utils import locks as _locks
 from deepspeed_tpu.utils.logging import logger
 
 CLOSED = "closed"
@@ -48,7 +49,7 @@ class CircuitBreaker:
         # mutate under one lock — two locks here would be an ABBA deadlock
         # between submit (front-end → breaker) and the worker's
         # record_failure → on_transition shed (breaker → front-end)
-        self._lock = lock if lock is not None else threading.RLock()
+        self._lock = lock if lock is not None else _locks.make_rlock("serving.breaker")
         self._state = CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0

@@ -42,6 +42,7 @@ from deepspeed_tpu.resilience.watchdog import WatchdogTimeout, run_with_deadline
 from deepspeed_tpu.serving.admission import (Request, ShedError,
                                              resolve_capacity)
 from deepspeed_tpu.serving.breaker import CLOSED, OPEN, CircuitBreaker
+from deepspeed_tpu.utils import locks as _locks
 from deepspeed_tpu.utils.logging import logger
 
 STATUS_FILE = "serving_status.json"
@@ -80,8 +81,8 @@ class ServingFrontEnd:
         self.engine = engine
         self.cfg = cfg
         self.agent = agent
-        rlock = threading.RLock()       # ONE lock for queue + breaker state
-        self._lock = threading.Condition(rlock)
+        rlock = _locks.make_rlock("serving.frontend")  # ONE lock: queue + breaker
+        self._lock = _locks.make_condition("serving.frontend", rlock)
         self._queue: collections.deque = collections.deque()
         self._in_flight: Optional[Request] = None
         self.capacity, self.capacity_detail = resolve_capacity(engine, cfg)
@@ -130,8 +131,9 @@ class ServingFrontEnd:
         with self._lock:
             if self._worker is not None and self._worker.is_alive():
                 return self
-            self._worker = threading.Thread(target=self._serve_loop,
-                                            name="ds-serve-worker", daemon=True)
+            self._worker = _locks.spawn_thread(self._serve_loop,
+                                               name="ds-serve-worker",
+                                               owner="serving", daemon=True)
             self._worker.start()
             if self._state == ServerState.STARTING:
                 self._transition(ServerState.READY)
@@ -294,6 +296,12 @@ class ServingFrontEnd:
             self._transition(ServerState.READY)
 
     # ----------------------------------------------------------------- drain
+    @_locks.signal_safe("runs on the main thread (Python delivers signals "
+                        "there); the shared serving.frontend RLock is "
+                        "reentrant, so interrupting a lock-holding submit() "
+                        "re-enters instead of deadlocking, and the handler "
+                        "only flips flags + sheds the queue — the worker "
+                        "does the actual draining")
     def begin_drain(self, reason: str = "signal") -> None:
         """Stop admission, shed the queue, deadline-cap the in-flight
         request at ``drain_grace_s``, then die. Idempotent."""

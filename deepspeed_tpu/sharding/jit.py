@@ -26,7 +26,6 @@ from __future__ import annotations
 import dataclasses
 import inspect
 import os
-import threading
 import weakref
 from typing import Any, Dict, Optional, Tuple
 
@@ -95,7 +94,9 @@ class ProgramRecord:
         return self.jitted is not None and self.abstract_args is not None
 
 
-_LOCK = threading.Lock()
+from deepspeed_tpu.utils import locks as _locks
+
+_LOCK = _locks.make_lock("sharding.programs")
 _PROGRAMS: Dict[str, ProgramRecord] = {}
 
 

@@ -17,9 +17,10 @@ p50/p90/p99 over unbounded streams.
 from __future__ import annotations
 
 import random
-import threading
 import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils import locks as _locks
 
 
 def _labels_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
@@ -38,7 +39,7 @@ class Counter:
         self.name = name
         self.labels = dict(labels or {})
         self.value = 0.0
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("telemetry.counter")
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
@@ -95,7 +96,7 @@ class Histogram:
         self._rng = random.Random(zlib.crc32(name.encode("utf-8")))
         # observe() is a multi-field update (count/sum/buckets/reservoir);
         # interleaved cross-thread observes would desync count from buckets
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("telemetry.histogram")
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -159,7 +160,7 @@ class MetricsRegistry:
         self.default_max_samples = default_max_samples
         self.default_bounds = list(default_bounds) if default_bounds else None
         self._metrics: Dict[tuple, object] = {}
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("telemetry.registry")
 
     def _get(self, kind: str, name: str, labels, factory):
         key = (kind, name, _labels_key(labels))

@@ -61,6 +61,30 @@ def _reset_comm():
     _sjit.reset_program_table()
 
 
+@pytest.fixture(autouse=True)
+def _witness_chaos(request):
+    """Every chaos-marked drill runs under the runtime lock witness: the
+    fault-injection suite is where framework threads contend hardest, so
+    an acquisition-order inversion introduced by a refactor surfaces HERE
+    as a failed teardown assert — with both acquire sites named — instead
+    of as a once-a-month fleet wedge. Tests that deliberately manufacture
+    inversions reset the witness themselves before returning."""
+    if "chaos" not in request.keywords:
+        yield
+        return
+    from deepspeed_tpu.analysis.race import witness_findings
+    from deepspeed_tpu.utils import locks as _locks
+
+    _locks.enable_witness(reset=True)
+    try:
+        yield
+        findings = witness_findings()
+        assert not findings, "\n".join(f.message for f in findings)
+    finally:
+        _locks.disable_witness()
+        _locks.reset_witness()
+
+
 @pytest.fixture
 def mesh8():
     from deepspeed_tpu.parallel.topology import build_mesh

@@ -1,0 +1,581 @@
+"""ds_race — host-side concurrency analysis tests.
+
+Three layers under test, mirroring deepspeed_tpu/analysis/race.py:
+
+* the STATIC pass — lock-graph extraction over fixture trees (the seeded
+  ABBA is the reverted PR-7 frontend/breaker deadlock, and it must fire
+  with BOTH call sites named), the fixed shared-RLock shape staying
+  clean, blocking-under-lock, signal-handler safety, and the
+  ``# race-allow`` suppression contract (a suppression without a
+  justification is itself a finding);
+* the RUNTIME witness — the instrumented lock factory records per-thread
+  acquisition order, and the offline pass flags an inversion two threads
+  exercised in sequence (no deadlock ever manifested — that is the
+  point);
+* the LIFECYCLE registry — spawn_thread/leaked_threads, the
+  disowned-by-design exemption, and the lock-holders table the SIGUSR1
+  stack dump carries.
+
+Plus the wiring pins: the repo itself lints to ZERO race findings
+(tier-1), the config knobs round-trip the schema pass with did-you-mean
+and cross-field checks, and ``bin/ds_doctor race`` exits 2 on findings.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from deepspeed_tpu.analysis.lockgraph import Aliases, LockGraph
+from deepspeed_tpu.analysis.race import (RULE_ALLOW, RULE_BLOCKING,
+                                         RULE_ORDER, RULE_SIGNAL,
+                                         RULE_WITNESS, lint_race,
+                                         load_witness, witness_findings)
+from deepspeed_tpu.utils import locks as _locks
+
+pytestmark = pytest.mark.race
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _write(root, name, src):
+    path = os.path.join(str(root), name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(src))
+    return path
+
+
+# --------------------------------------------------------------- lockgraph
+class TestLockGraph:
+    def test_aliases_union_find_and_reentrancy(self):
+        al = Aliases()
+        al.mark_reentrant("b")
+        al.union("a", "b")
+        assert al.find("a") == al.find("b") == "a"  # lexicographic canon
+        # reentrancy propagates through the union, in both directions
+        assert al.is_reentrant("a") and al.is_reentrant("b")
+        al.union("c", "a")
+        assert al.is_reentrant("c")
+
+    def test_two_node_cycle_cites_both_edges(self):
+        g = LockGraph()
+        g.add_edge("A", "B", "x.py:10", "x.py:11")
+        g.add_edge("B", "A", "y.py:20", "y.py:21")
+        cycles = g.cycles()
+        assert len(cycles) == 1
+        edges = {(s, d) for s, d, _, _ in cycles[0]}
+        assert edges == {("A", "B"), ("B", "A")}
+        sites = {site for e in cycles[0] for site in e[2:]}
+        assert {"x.py:11", "y.py:21"} <= sites
+
+    def test_self_loop_is_a_single_edge_cycle(self):
+        g = LockGraph()
+        g.add_edge("L", "L", "m.py:5", "m.py:9")
+        assert g.cycles() == [[("L", "L", "m.py:5", "m.py:9")]]
+
+    def test_dag_has_no_cycles_and_first_citation_wins(self):
+        g = LockGraph()
+        g.add_edge("A", "B", "a.py:1", "a.py:2")
+        g.add_edge("A", "B", "b.py:7", "b.py:8")   # later sighting
+        g.add_edge("B", "C", "a.py:3", "a.py:4")
+        assert g.cycles() == []
+        assert g.edges[("A", "B")] == ("a.py:1", "a.py:2", 2)
+
+
+# ------------------------------------------------------------- static pass
+ABBA_BREAKER = """
+    import threading
+
+
+    class CircuitBreaker:
+        def __init__(self, on_transition=None):
+            self._lock = threading.RLock()
+            self._on_transition = on_transition
+
+        def admits(self):
+            with self._lock:
+                return True
+
+        def record_failure(self):
+            with self._lock:
+                if self._on_transition is not None:
+                    self._on_transition()
+"""
+
+ABBA_FRONTEND = """
+    import threading
+
+    from breaker import CircuitBreaker
+
+
+    class Front:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self.breaker = CircuitBreaker(on_transition=self._on_breaker)
+
+        def submit(self):
+            with self._lock:
+                return self.breaker.admits()
+
+        def _on_breaker(self):
+            with self._lock:
+                pass
+"""
+
+
+class TestStaticPass:
+    def test_seeded_abba_fires_with_both_sites(self, tmp_path):
+        """The reverted PR-7 deadlock: submit holds the frontend lock and
+        enters the breaker; the breaker's transition callback re-enters
+        the frontend lock. Two locks, both orders — the static pass must
+        name BOTH acquire sites without ever running the code."""
+        _write(tmp_path, "breaker.py", ABBA_BREAKER)
+        _write(tmp_path, "frontend.py", ABBA_FRONTEND)
+        findings = lint_race(root=str(tmp_path))
+        order = [f for f in findings if f.rule == RULE_ORDER]
+        assert len(order) == 1, [f.message for f in findings]
+        msg = order[0].message
+        assert "frontend.py" in msg and "breaker.py" in msg
+
+    def test_fixed_shared_lock_shape_is_clean(self, tmp_path):
+        """The actual PR-7 fix — ONE shared RLock injected into the
+        breaker — must read as one reentrant order class, not a cycle."""
+        _write(tmp_path, "breaker.py", """
+            import threading
+
+
+            class CircuitBreaker:
+                def __init__(self, on_transition=None, lock=None):
+                    self._lock = lock if lock is not None else threading.RLock()
+                    self._on_transition = on_transition
+
+                def admits(self):
+                    with self._lock:
+                        return True
+
+                def record_failure(self):
+                    with self._lock:
+                        if self._on_transition is not None:
+                            self._on_transition()
+        """)
+        _write(tmp_path, "frontend.py", """
+            import threading
+
+            from breaker import CircuitBreaker
+
+
+            class Front:
+                def __init__(self):
+                    rlock = threading.RLock()
+                    self._lock = threading.Condition(rlock)
+                    self.breaker = CircuitBreaker(
+                        on_transition=self._on_breaker, lock=rlock)
+
+                def submit(self):
+                    with self._lock:
+                        return self.breaker.admits()
+
+                def _on_breaker(self):
+                    with self._lock:
+                        pass
+        """)
+        assert lint_race(root=str(tmp_path)) == []
+
+    def test_blocking_under_lock_and_allow_contract(self, tmp_path):
+        _write(tmp_path, "a.py", """
+            import threading
+            import time
+
+
+            class Snap:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1.0)
+
+                def allowed(self):
+                    with self._lock:
+                        # race-allow: blocking-under-lock — test fixture
+                        time.sleep(1.0)
+        """)
+        findings = lint_race(root=str(tmp_path))
+        blocking = [f for f in findings if f.rule == RULE_BLOCKING]
+        assert len(blocking) == 1
+        assert "time.sleep" in blocking[0].message
+        assert "a.py:12" in blocking[0].citation
+
+    def test_allow_without_justification_is_a_finding(self, tmp_path):
+        _write(tmp_path, "a.py", """
+            import threading
+            import time
+
+            _L = threading.Lock()
+
+
+            def f():
+                with _L:
+                    # race-allow: blocking-under-lock
+                    time.sleep(1.0)
+        """)
+        findings = lint_race(root=str(tmp_path))
+        assert any(f.rule == RULE_ALLOW and "no justification" in f.message
+                   for f in findings)
+        # the unjustified comment does NOT suppress
+        assert any(f.rule == RULE_BLOCKING for f in findings)
+
+    def test_allow_with_unknown_rule_is_a_finding(self, tmp_path):
+        _write(tmp_path, "a.py", """
+            # race-allow: not-a-rule — whatever
+            X = 1
+        """)
+        findings = lint_race(root=str(tmp_path))
+        assert any(f.rule == RULE_ALLOW and "unknown rule" in f.message
+                   for f in findings)
+
+    def test_signal_handler_rules(self, tmp_path):
+        _write(tmp_path, "handlers.py", """
+            import signal
+            import threading
+
+            from deepspeed_tpu.utils import locks
+
+            _flag = False
+            _L = threading.Lock()
+
+
+            def _drain():
+                pass
+
+
+            @locks.signal_safe("flag flip only; test fixture")
+            def _safe_drain():
+                pass
+
+
+            def install_bad():
+                def _h(signum, frame):
+                    _drain()
+                signal.signal(signal.SIGTERM, _h)
+
+
+            def install_locking():
+                def _h(signum, frame):
+                    with _L:
+                        pass
+                signal.signal(signal.SIGTERM, _h)
+
+
+            def install_good():
+                def _h(signum, frame):
+                    global _flag
+                    _flag = True
+                    _safe_drain()
+                signal.signal(signal.SIGTERM, _h)
+        """)
+        findings = lint_race(root=str(tmp_path))
+        sig = [f for f in findings if f.rule == RULE_SIGNAL]
+        msgs = "\n".join(f.message for f in sig)
+        assert any("_drain" in f.message and "install_bad" not in f.citation
+                   for f in sig)
+        assert "acquires lock" in msgs
+        # the flag + @signal_safe handler produced nothing
+        assert not any("_safe_drain" in m for m in msgs.splitlines())
+
+    def test_signal_safe_without_justification_is_a_finding(self, tmp_path):
+        _write(tmp_path, "a.py", """
+            from deepspeed_tpu.utils import locks
+
+
+            @locks.signal_safe("")
+            def f():
+                pass
+        """)
+        findings = lint_race(root=str(tmp_path))
+        assert any(f.rule == RULE_ALLOW and "signal_safe" in f.message
+                   for f in findings)
+
+    def test_allowlist_suppresses_and_flags_unknown(self, tmp_path):
+        _write(tmp_path, "breaker.py", ABBA_BREAKER)
+        _write(tmp_path, "frontend.py", ABBA_FRONTEND)
+        out = lint_race(root=str(tmp_path),
+                        allowlist=("race/lock-order:frontend.py",))
+        assert not any(f.rule == RULE_ORDER for f in out)
+        out2 = lint_race(root=str(tmp_path),
+                         allowlist=("race/not-a-rule",))
+        assert any(f.rule == RULE_ALLOW and "unknown rule" in f.message
+                   for f in out2)
+
+    def test_repo_tree_has_zero_findings(self):
+        """THE tier-1 assert: the framework's own lock discipline is
+        clean — every deliberate exception carries a verified in-code
+        justification. A refactor that introduces a lock-order cycle, a
+        blocking call under a framework lock, or an unsafe signal handler
+        fails HERE, with both call sites named, before it ships."""
+        assert lint_race() == []
+
+
+# ---------------------------------------------------------- runtime witness
+class TestWitness:
+    def setup_method(self):
+        _locks.enable_witness(reset=True)
+
+    def teardown_method(self):
+        _locks.disable_witness()
+        _locks.reset_witness()
+
+    def test_abba_inversion_caught_without_deadlock(self):
+        """Two threads exercise A->B and B->A in SEQUENCE (events make the
+        schedule deterministic — nothing ever deadlocks), yet the unioned
+        order graph holds both edges and the offline pass names both
+        acquire sites."""
+        a = _locks.make_lock("test.wit.a")
+        b = _locks.make_lock("test.wit.b")
+        first_done = threading.Event()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+            first_done.set()
+
+        def t2():
+            first_done.wait(5.0)
+            with b:
+                with a:
+                    pass
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start(); th2.start()
+        th1.join(5.0); th2.join(5.0)
+        findings = witness_findings()
+        wit = [f for f in findings if f.rule == RULE_WITNESS]
+        assert len(wit) == 1
+        msg = wit[0].message
+        assert "test.wit.a" in msg and "test.wit.b" in msg
+        assert "test_race.py" in msg      # the acquire sites are cited
+
+    def test_consistent_order_is_clean(self):
+        a = _locks.make_lock("test.wit.c")
+        b = _locks.make_lock("test.wit.d")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert witness_findings() == []
+
+    def test_reentrant_self_nesting_is_not_an_inversion(self):
+        r = _locks.make_rlock("test.wit.r")
+        with r:
+            with r:
+                pass
+        assert witness_findings() == []
+
+    def test_condition_shares_its_rlock_order_class(self):
+        """The serving-frontend shape: a Condition over an injected
+        witness rlock is the SAME order class — wait/notify nesting under
+        the shared lock must not read as two locks."""
+        rlock = _locks.make_rlock("test.wit.front")
+        cond = _locks.make_condition("test.wit.front", rlock)
+        with cond:
+            with rlock:
+                pass
+        assert witness_findings() == []
+
+    def test_save_load_roundtrip(self, tmp_path):
+        a = _locks.make_lock("test.wit.s1")
+        b = _locks.make_lock("test.wit.s2")
+        with a:
+            with b:
+                pass
+        path = str(tmp_path / "wit.json")
+        _locks.save_witness(path)
+        edges = load_witness(path)
+        assert any(e["src"] == "test.wit.s1" and e["dst"] == "test.wit.s2"
+                   for e in edges)
+        # a second rank observing the reverse order: union -> inversion
+        edges.append({"src": "test.wit.s2", "dst": "test.wit.s1",
+                      "count": 1, "src_site": "other_rank.py:1",
+                      "dst_site": "other_rank.py:2"})
+        wit = witness_findings(edges)
+        assert len(wit) == 1 and wit[0].rule == RULE_WITNESS
+
+    def test_witness_off_records_nothing(self):
+        _locks.disable_witness()
+        _locks.reset_witness()
+        a = _locks.make_lock("test.wit.off1")
+        b = _locks.make_lock("test.wit.off2")
+        with a:
+            with b:
+                pass
+        assert _locks.witness_edges() == []
+
+
+# -------------------------------------------------------- thread lifecycle
+class TestThreadLifecycle:
+    def test_spawned_thread_is_registered_and_joins_clean(self):
+        done = threading.Event()
+        t = _locks.spawn_thread(done.wait, name="ds-test-worker",
+                                owner="test", args=(5.0,))
+        t.start()
+        assert any(r.name == "ds-test-worker" and r.owner == "test"
+                   for r in _locks.live_framework_threads())
+        done.set()
+        assert _locks.leaked_threads(timeout=5.0, owner="test") == []
+
+    def test_leak_sentinel_names_the_survivor(self):
+        stop = threading.Event()
+        t = _locks.spawn_thread(stop.wait, name="ds-test-leaker",
+                                owner="test", args=(30.0,))
+        t.start()
+        try:
+            leaked = _locks.leaked_threads(timeout=0.05, owner="test")
+            assert [r.name for r in leaked] == ["ds-test-leaker"]
+        finally:
+            stop.set()
+            t.join(5.0)
+
+    def test_disowned_by_design_is_exempt(self):
+        stop = threading.Event()
+        t = _locks.spawn_thread(stop.wait, name="ds-test-disowned",
+                                owner="test", expect_join=False, args=(30.0,))
+        t.start()
+        try:
+            assert _locks.leaked_threads(timeout=0.05, owner="test") == []
+        finally:
+            stop.set()
+            t.join(5.0)
+
+    def test_lock_holders_table_in_stack_dump(self, tmp_path):
+        """The watchdog SIGUSR1 dump gains the current-lock-holders table:
+        'which thread holds what, acquired where' is exactly the question
+        a wedged-fleet stack dump exists to answer."""
+        from deepspeed_tpu.resilience.watchdog import dump_all_stacks
+
+        lk = _locks.make_lock("test.holders")
+        path = str(tmp_path / "dump.txt")
+        with lk:
+            holders = _locks.current_lock_holders()
+            assert any(h["lock"] == "test.holders" for h in holders)
+            dump_all_stacks(path, reason="test")
+        with open(path) as f:
+            text = f.read()
+        assert "test.holders" in text
+        assert threading.current_thread().name in text
+
+
+# --------------------------------------------------------- config + schema
+class TestConfigKnobs:
+    def test_race_pass_is_known_and_default(self):
+        from deepspeed_tpu.analysis.doctor import (ALL_PASSES,
+                                                   DEFAULT_PASSES,
+                                                   ENGINE_PASSES)
+        from deepspeed_tpu.runtime.config import AnalysisConfig
+
+        assert "race" in ALL_PASSES
+        assert "race" in DEFAULT_PASSES
+        assert "race" in ENGINE_PASSES
+        assert AnalysisConfig(passes=["race"]).passes == ["race"]
+        with pytest.raises(ValueError, match="unknown pass"):
+            AnalysisConfig(passes=["rage"])
+
+    def test_knob_typo_gets_did_you_mean(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, _ = walk_config(
+            {"train_batch_size": 8, "analysis": {"race_witnes": True}},
+            world_size=8)
+        msg = "\n".join(f.message for f in findings)
+        assert "race_witnes" in msg and "race_witness" in msg
+
+    def test_witness_without_telemetry_cross_field(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, cfg = walk_config(
+            {"train_batch_size": 8, "analysis": {"race_witness": True}},
+            world_size=8)
+        assert cfg is not None and cfg.analysis.race_witness
+        assert any(f.rule == "config/cross-field"
+                   and "race_witness" in f.message for f in findings)
+
+    def test_allowlist_unknown_rule_cross_field(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, _ = walk_config(
+            {"train_batch_size": 8,
+             "analysis": {"race_allowlist": ["race/bogus:thing"]}},
+            world_size=8)
+        assert any(f.rule == "config/cross-field"
+                   and "race/bogus" in f.message for f in findings)
+
+    def test_run_doctor_race_pass(self):
+        from deepspeed_tpu.analysis.doctor import run_doctor
+
+        rep = run_doctor({"train_batch_size": 8}, passes=("race",),
+                         world_size=8)
+        assert [f for f in rep.findings if f.pass_name == "race"] == []
+
+
+# ------------------------------------------------------------------- CLI
+class TestCLI:
+    def _doctor(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_doctor"), *args],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    def test_race_needs_no_config_and_repo_is_clean(self):
+        proc = self._doctor("race")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "race" in proc.stdout
+
+    def test_seeded_abba_exits_2_naming_both_sites(self, tmp_path):
+        _write(tmp_path, "breaker.py", ABBA_BREAKER)
+        _write(tmp_path, "frontend.py", ABBA_FRONTEND)
+        proc = self._doctor("race", "--root", str(tmp_path))
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "frontend.py" in proc.stdout and "breaker.py" in proc.stdout
+        # ...and --allow suppresses it back to a clean exit
+        proc2 = self._doctor("race", "--root", str(tmp_path),
+                             "--allow", "race/lock-order")
+        assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+
+    def test_witness_file_inversion_exits_2(self, tmp_path):
+        path = str(tmp_path / "wit.json")
+        with open(path, "w") as f:
+            json.dump({"version": 1, "edges": [
+                {"src": "A", "dst": "B", "count": 1,
+                 "src_site": "x.py:1", "dst_site": "x.py:2"},
+                {"src": "B", "dst": "A", "count": 1,
+                 "src_site": "y.py:3", "dst_site": "y.py:4"},
+            ]}, f)
+        proc = self._doctor("race", "--witness", path)
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "witness" in proc.stdout
+        assert "x.py:2" in proc.stdout and "y.py:4" in proc.stdout
+
+    def test_json_output(self):
+        proc = self._doctor("race", "--json")
+        assert proc.returncode == 0
+        payload = json.loads(proc.stdout)
+        assert payload["counts"]["error"] == 0
+
+    def test_race_passes_flag_without_config(self):
+        proc = self._doctor("--passes", "race")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_ds_report_race_section(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_report"), "race"],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "race" in proc.stdout

@@ -267,6 +267,11 @@ class TestShrinkDrill:
         assert out["status"] == "complete"
         assert out["final_step"] == 10
         assert out["restarts"] == 1
+        # thread-lifecycle sentinel: after the drill's agent + engine
+        # teardown, every framework thread that promised a join must be
+        # gone (disowned-by-design deadline workers are exempt by record)
+        from deepspeed_tpu.utils import locks as _locks
+        assert _locks.leaked_threads(timeout=10.0) == []
         # resumed resharded: the live engine's dp mesh spans 6 survivors
         assert dict(agent.engine.mesh.shape)["data"] == 6
         drill = out["restart_log"][0]

@@ -40,7 +40,11 @@ prices the hidden-collectives win from the two ledger entries. `--sdc`
 BENCH_SDC_INTERVAL steps, default 2) and ASSERTS the recorded entry
 prices the defense: an `audit` goodput bucket plus an `sdc_overhead`
 attribution below audit_interval^-1 of wall — the number `ds_perf gate
---metric sdc_overhead` then regresses on.
+--metric sdc_overhead` then regresses on. `--blackbox` (BENCH_BLACKBOX=1;
+default ON under --smoke) arms the ds_blackbox `blackbox` flight-recorder
+block and ASSERTS the entry prices it: a `blackbox_overhead` attribution
+under 0.5% of wall plus zero incident bundles on the clean run — the
+number `ds_perf gate --metric blackbox_overhead` then regresses on.
 
 Env knobs: BENCH_MODEL, BENCH_BS (per-chip microbatch), BENCH_SEQ,
 BENCH_STEPS, BENCH_GAS, BENCH_REMAT (none|full|dots|attn|attn_mlp; default
@@ -214,6 +218,19 @@ if "--sdc" in sys.argv[1:]:
 # Unset = no block (strict no-op: the gray module is never imported).
 if "--gray" in sys.argv[1:]:
     os.environ["BENCH_GRAY"] = "1"
+# --blackbox (or BENCH_BLACKBOX=1; DEFAULT ON under --smoke): arm the
+# ds_blackbox `blackbox` block on every engine-backed line — the
+# always-on flight recorder whose ring append rides the step path. The
+# line then asserts its own ledger entry carries a `blackbox_overhead`
+# attribution under the 0.5%-of-wall budget (the contract `ds_perf gate
+# --metric blackbox_overhead` holds in CI): "always-on" is only
+# defensible if it is effectively free, so the smoke prices it on every
+# run. BENCH_BLACKBOX=0 opts out (strict no-op: the blackbox module is
+# never imported).
+if "--blackbox" in sys.argv[1:]:
+    os.environ["BENCH_BLACKBOX"] = "1"
+if SMOKE:
+    os.environ.setdefault("BENCH_BLACKBOX", "1")
 
 import jax
 import numpy as np
@@ -509,6 +526,14 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
         ds_config["gray"] = {"probe_every": gray_every,
                              "probe_confirmations": 1_000_000,
                              "evict": False}
+    blackbox_on = os.environ.get("BENCH_BLACKBOX", "0") == "1" and PERF
+    if blackbox_on:
+        # ds_blackbox: the always-on flight recorder — no chaos, no
+        # triggers expected on a clean bench; the block is armed purely
+        # so the entry PRICES the per-step ring cost (blackbox_overhead)
+        # and the clean run proves zero bundles. Needs the PERF telemetry
+        # session for its output dir, hence the `and PERF` gate above.
+        ds_config["blackbox"] = {}
     if gas > 1:
         # bf16 accumulator: gas>1 must not add a resident fp32 grad tree on
         # top of the full optimizer state (16G HBM budget)
@@ -610,6 +635,7 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
                         "wire": wire_mode or None,
                         "sdc": sdc_interval if sdc_on else None,
                         "gray": gray_every if gray_on else None,
+                        "blackbox": blackbox_on or None,
                         "flash_block": getattr(config, "flash_block", None)},
                 extra={"vs_baseline": line["vs_baseline"],
                        "tok_per_sec_chip": round(tok_per_sec_chip, 1),
@@ -685,6 +711,32 @@ def run_one(model_name: str, on_tpu: bool, n_dev: int) -> dict:
             print(f"# gray: probe overhead {100.0 * go:.2f}% of wall "
                   f"(budget {100.0 * budget:.1f}% at probe_every="
                   f"{gray_every})", file=sys.stderr)
+        if blackbox_on:
+            # the blackbox acceptance — OUTSIDE the best-effort try
+            # above: a missing attribution must FAIL the bench, not
+            # print a note. The entry must PRICE the always-on flight
+            # recorder: a blackbox_overhead attribution under the
+            # 0.5%-of-wall contract (`ds_perf gate --metric
+            # blackbox_overhead` regresses on it), and a clean run must
+            # write ZERO incident bundles.
+            att = line.get("attribution") or {}
+            bo = att.get("blackbox_overhead")
+            assert bo is not None, (
+                "blackbox armed but the ledger entry carries no "
+                "blackbox_overhead attribution (telemetry/goodput "
+                "missing, or perf_record failed above)")
+            budget = 0.005
+            assert bo < budget, (
+                f"blackbox_overhead {bo:.5f} exceeds the {budget:.3f} "
+                "(0.5%-of-wall) budget — the always-on flight recorder "
+                "costs more than the ds_blackbox contract allows")
+            rec = getattr(engine, "_blackbox", None)
+            assert rec is not None and rec.bundles_written == 0, (
+                "clean bench run wrote incident bundle(s) — a "
+                "severity>=error event fired with no fault injected")
+            print(f"# blackbox: recorder overhead {100.0 * bo:.3f}% of "
+                  f"wall (budget {100.0 * budget:.1f}%), 0 bundles",
+                  file=sys.stderr)
 
     # free this preset's device memory before the next ladder entry (the
     # north-star evidence step otherwise inherits a chip full of dead

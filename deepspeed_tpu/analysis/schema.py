@@ -56,7 +56,7 @@ def _block_models() -> Dict[str, type]:
         "profiling": C.ProfilingConfig, "perf": C.PerfConfig,
         "serving": C.ServingConfig, "goodput": C.GoodputConfig,
         "overlap": C.OverlapConfig, "wire": C.WireConfig,
-        "roofline": C.RooflineConfig,
+        "roofline": C.RooflineConfig, "blackbox": C.BlackboxConfig,
         "compression_training": CompressionConfig,
     }
 
@@ -434,6 +434,28 @@ def _cross_field(cfg, pd: dict, findings: List[Finding]) -> None:
                 "enable the resize block for quarantine-and-evict, or set "
                 "gray.evict: false to make the intent explicit",
                 "gray.evict vs elasticity.resize")
+    bb = cfg.blackbox
+    if "blackbox" in pd and bb.enabled:
+        if not (tel.enabled and tel.output_dir) and not bb.output_dir:
+            add("error",
+                "blackbox without anywhere to land a bundle: the flight "
+                "recorder's ring lives in RAM, but a trigger (severity>="
+                f"{bb.trigger_severity}, SIGUSR1, `ds_incident snap`) must "
+                "write incidents/<ts>_<trigger>/ somewhere — and with no "
+                "telemetry output_dir there are also no metrics/trace tails "
+                "or restart_log to bundle, so the forensics are empty; "
+                "enable the telemetry block with an output_dir (or set "
+                "blackbox.output_dir for a bare events-only recorder)",
+                "blackbox vs telemetry.output_dir")
+        elif not (tel.enabled and tel.output_dir):
+            add("warning",
+                "blackbox.output_dir without the telemetry block: bundles "
+                "will carry the event ring, stacks and env report, but no "
+                "metrics/trace tails and no restart_log slice — `ds_incident "
+                "report` degrades to wall-clock alignment with no goodput "
+                "cost; enable telemetry with an output_dir for the full "
+                "forensic record",
+                "blackbox.output_dir vs telemetry")
     gp = cfg.goodput
     if "goodput" in pd and gp.enabled and not (tel.enabled and tel.trace):
         add("warning",

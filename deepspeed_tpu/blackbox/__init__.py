@@ -1,0 +1,96 @@
+"""ds_blackbox — always-on flight recorder + incident bundle dumps.
+
+STRICT no-op contract: this package is imported ONLY when the ds_config has
+a ``blackbox`` block with ``enabled: true``.  Producers all over the
+framework (SDC/gray verdicts, watchdog, elastic agent, breaker, front-end,
+chaos, sentinel rewinds) emit into the recorder through the established
+strict-no-op idiom::
+
+    bb = sys.modules.get("deepspeed_tpu.blackbox")
+    if bb is not None:
+        bb.record("gray_verdict", "error", {...}, step=step)
+
+so an unconfigured run never pays an import, and the lowered HLO is
+byte-identical whether the block is absent OR armed (everything here is
+host-side).
+
+Module surface:
+  configure(cfg, rank=0)  — arm the recorder from a BlackboxConfig
+  deconfigure()           — tear down (config-source symmetry, like telemetry)
+  get_recorder()          — the live FlightRecorder or None
+  record(kind, severity, payload, step=None) — append one envelope event
+  snap(reason)            — force an incident bundle right now
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.telemetry.events import SCHEMA_VERSION  # noqa: F401 (re-export)
+
+from .recorder import FlightRecorder
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_source: Optional[str] = None
+
+
+def configure(cfg, rank: int = 0, source: str = "config") -> Optional[FlightRecorder]:
+    """Arm the flight recorder from a ``BlackboxConfig``.
+
+    Mirrors ``telemetry.configure`` semantics: a new config-sourced recorder
+    replaces a previous config-sourced one (fresh engine in the same
+    process, e.g. after an elastic restart); returns None when disabled.
+    """
+    global _recorder, _recorder_source
+    if cfg is None or not getattr(cfg, "enabled", False):
+        return None
+    if _recorder is not None and _recorder_source == "config":
+        _recorder.close()
+        _recorder = None
+    rec = FlightRecorder(cfg, rank=rank)
+    _recorder = rec
+    _recorder_source = source
+    return rec
+
+
+def install_recorder(rec: FlightRecorder, source: str = "manual") -> None:
+    """Install an externally-built recorder (tests)."""
+    global _recorder, _recorder_source
+    if _recorder is not None:
+        _recorder.close()
+    _recorder = rec
+    _recorder_source = source
+
+
+def deconfigure() -> None:
+    """Tear down the live recorder, if any."""
+    global _recorder, _recorder_source
+    if _recorder is not None:
+        _recorder.close()
+    _recorder = None
+    _recorder_source = None
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def record(
+    kind: str,
+    severity: str,
+    payload: Optional[Dict[str, Any]] = None,
+    step: Optional[int] = None,
+) -> Optional[Dict[str, Any]]:
+    """Append one event to the live recorder; no-op (None) when unarmed."""
+    rec = _recorder
+    if rec is None:
+        return None
+    return rec.record(kind, severity, payload, step=step)
+
+
+def snap(reason: str = "manual") -> Optional[str]:
+    """Force an incident bundle dump now; returns the bundle dir or None."""
+    rec = _recorder
+    if rec is None:
+        return None
+    return rec.dump(trigger=reason, force=True)

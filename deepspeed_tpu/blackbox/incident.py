@@ -1,0 +1,594 @@
+"""Cross-rank incident merge + first-cause forensics (``ds_incident``).
+
+Stdlib-only at import time (the ``bin/ds_incident`` shim file-loads this
+module on machines without jax); anything heavier — ``ds_prof``'s clock
+alignment, the goodput ledger — is imported lazily inside functions.
+
+Degradation contract (mirrors the ``ds_prof merge`` matrix): torn JSONL
+tails, missing ranks, overlapping sessions, two bundles claiming one rank,
+and schema-version mismatches all WARN LOUDLY and degrade — the timeline is
+never fabricated, and alignment falls back from collective-matched clock
+offsets to raw epoch anchors when the evidence is not there.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+# Keep in sync with deepspeed_tpu.telemetry.events.SCHEMA_VERSION — duplicated
+# here (with a cross-check in tests) so this module imports without the
+# package on a bare responder laptop.
+SCHEMA_VERSION = 1
+
+_SEVERITY_RANK = {"debug": 0, "info": 1, "warning": 2, "error": 3,
+                  "critical": 4}
+
+
+def _sev(s: Any) -> int:
+    return _SEVERITY_RANK.get(str(s).lower(), -1)
+
+
+# --------------------------------------------------------------- discovery
+
+def discover_bundles(paths: List[str], warnings: List[str]) -> List[str]:
+    """Expand user-supplied paths into bundle dirs (have manifest.json).
+
+    Accepts: a bundle dir itself, an ``incidents/`` dir, or a telemetry
+    output dir containing ``incidents/``.
+    """
+    out: List[str] = []
+    seen = set()
+
+    def _add(d: str) -> None:
+        real = os.path.realpath(d)
+        if real in seen:
+            return
+        seen.add(real)
+        out.append(d)
+
+    for p in paths:
+        if not os.path.isdir(p):
+            warnings.append(f"{p}: not a directory — skipped")
+            continue
+        if os.path.isfile(os.path.join(p, "manifest.json")):
+            _add(p)
+            continue
+        roots = []
+        if os.path.basename(os.path.normpath(p)) == "incidents":
+            roots.append(p)
+        elif os.path.isdir(os.path.join(p, "incidents")):
+            roots.append(os.path.join(p, "incidents"))
+        else:
+            warnings.append(f"{p}: no incident bundles found under it")
+            continue
+        for root in roots:
+            for name in sorted(os.listdir(root)):
+                d = os.path.join(root, name)
+                if name.endswith(".tmp"):
+                    warnings.append(
+                        f"{d}: half-written bundle (.tmp) — skipped")
+                    continue
+                if os.path.isdir(d) and os.path.isfile(
+                        os.path.join(d, "manifest.json")):
+                    _add(d)
+    return out
+
+
+def _read_jsonl(path: str, label: str,
+                warnings: List[str]) -> List[Dict[str, Any]]:
+    """Tolerant JSONL reader: torn/garbled lines are counted, not fatal."""
+    if not os.path.isfile(path):
+        return []
+    records: List[Dict[str, Any]] = []
+    torn = 0
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    torn += 1
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+                else:
+                    torn += 1
+    except OSError as e:
+        warnings.append(f"{label}: unreadable ({e})")
+        return []
+    if torn:
+        warnings.append(
+            f"{label}: {torn} torn/unparseable line(s) dropped — the tail "
+            "was cut mid-record (crash during write?)")
+    return records
+
+
+def load_bundle(d: str, warnings: List[str]) -> Optional[Dict[str, Any]]:
+    """Load one bundle dir; returns None (with a warning) if unusable."""
+    label = os.path.basename(os.path.normpath(d))
+    try:
+        with open(os.path.join(d, "manifest.json"), "r",
+                  encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        warnings.append(f"{label}: unreadable manifest ({e}) — bundle skipped")
+        return None
+    sv = manifest.get("schema_version")
+    if sv != SCHEMA_VERSION:
+        warnings.append(
+            f"{label}: bundle schema_version={sv!r} != reader's "
+            f"{SCHEMA_VERSION} — mixed-version fleet? fields may be missing")
+    events = _read_jsonl(os.path.join(d, "events.jsonl"),
+                         f"{label}/events.jsonl", warnings)
+    bad_sv = sum(1 for ev in events
+                 if ev.get("schema_version") not in (None, SCHEMA_VERSION))
+    if bad_sv:
+        warnings.append(
+            f"{label}: {bad_sv} event(s) carry a foreign schema_version — "
+            "merging anyway, but payloads may not parse as expected")
+    return {
+        "dir": d,
+        "label": label,
+        "manifest": manifest,
+        "rank": manifest.get("rank"),
+        "anchor": manifest.get("clock_anchor") or {},
+        "events": events,
+        "step_tail": _read_jsonl(os.path.join(d, "step_tail.jsonl"),
+                                 f"{label}/step_tail.jsonl", warnings),
+        "metrics_tail": _read_jsonl(os.path.join(d, "metrics_tail.jsonl"),
+                                    f"{label}/metrics_tail.jsonl", warnings),
+        "trace_tail": _read_jsonl(os.path.join(d, "trace_tail.jsonl"),
+                                  f"{label}/trace_tail.jsonl", warnings),
+        "restart": _read_jsonl(os.path.join(d, "restart_log.jsonl"),
+                               f"{label}/restart_log.jsonl", warnings),
+    }
+
+
+# --------------------------------------------------------------- alignment
+
+def _clock_offsets_s(bundles: List[Dict[str, Any]],
+                     warnings: List[str]) -> Tuple[Dict[int, float], str]:
+    """Per-rank clock offsets (seconds) for causal ordering.
+
+    Reuses ``ds_prof merge`` alignment: matched collective end-times from
+    the bundles' trace tails.  Falls back to raw epoch anchors (offset 0)
+    when fewer than two ranks have matchable collectives — stated in the
+    returned mode string, never silently.
+    """
+    per_rank_events: Dict[int, List[dict]] = {}
+    for b in bundles:
+        rank = b["rank"]
+        if rank is None:
+            continue
+        spans = [ev for ev in b["trace_tail"]
+                 if "_clock_anchor" not in ev and "ts" in ev]
+        if spans:
+            per_rank_events.setdefault(int(rank), []).extend(spans)
+    if len(per_rank_events) < 2:
+        return {}, "wall-clock (single rank or no trace tails)"
+    try:
+        from deepspeed_tpu.profiling.aggregate import FleetTrace
+    except ImportError:
+        warnings.append("clock alignment unavailable (profiling module not "
+                        "importable) — falling back to wall-clock ordering")
+        return {}, "wall-clock (no alignment module)"
+    ft = FleetTrace()
+    for rank, evs in per_rank_events.items():
+        ft.add_rank(rank, evs)
+    offsets_us = ft.clock_offsets()
+    for w in ft.warnings:
+        warnings.append(f"alignment: {w}")
+    if all(v == 0.0 for v in offsets_us.values()):
+        # 0 for every rank is the estimator's "no evidence" answer (no
+        # matched collectives) — say so instead of claiming alignment.
+        return {}, "wall-clock (no matched collectives in trace tails)"
+    return ({r: v / 1e6 for r, v in offsets_us.items()},
+            "collective-aligned (ds_prof clock offsets)")
+
+
+# ------------------------------------------------------------------- merge
+
+def merge_bundles(bundles: List[Dict[str, Any]],
+                  warnings: List[str]) -> Dict[str, Any]:
+    """Merge per-rank bundles into one causally-ordered timeline."""
+    by_rank: Dict[Any, List[Dict[str, Any]]] = {}
+    for b in bundles:
+        by_rank.setdefault(b["rank"], []).append(b)
+    for rank, group in sorted(by_rank.items(),
+                              key=lambda kv: (kv[0] is None, kv[0])):
+        if rank is None:
+            warnings.append(
+                f"{len(group)} bundle(s) carry no rank in their manifest — "
+                "their events merge unaligned")
+        elif len(group) > 1:
+            warnings.append(
+                f"rank {rank} claimed by {len(group)} bundles "
+                f"({', '.join(g['label'] for g in group)}) — events "
+                "deduplicated by event_id; if these are different runs the "
+                "timeline may interleave unrelated sessions")
+            fps = {g["manifest"].get("config_fingerprint") for g in group}
+            if len(fps) > 1:
+                warnings.append(
+                    f"rank {rank}: bundles disagree on config_fingerprint "
+                    f"— almost certainly different runs; trust nothing "
+                    "across them")
+    # Overlapping sessions: same rank, event time-ranges that overlap but
+    # come from bundles with different anchors.
+    for rank, group in by_rank.items():
+        if rank is None or len(group) < 2:
+            continue
+        spans = []
+        for g in group:
+            ts = [ev.get("ts") for ev in g["events"]
+                  if isinstance(ev.get("ts"), (int, float))]
+            if ts:
+                spans.append((min(ts), max(ts), g["label"]))
+        spans.sort()
+        for a, b2 in zip(spans, spans[1:]):
+            if b2[0] < a[1]:
+                warnings.append(
+                    f"rank {rank}: bundles {a[2]} and {b2[2]} overlap in "
+                    "time — overlapping sessions, ordering between them is "
+                    "not trustworthy")
+
+    # Missing ranks, judged against the widest world_size any bundle saw.
+    worlds = [b["manifest"].get("world_size") for b in bundles
+              if isinstance(b["manifest"].get("world_size"), int)]
+    ranks_present = sorted({b["rank"] for b in bundles
+                            if b["rank"] is not None})
+    if worlds and ranks_present:
+        world = max(worlds)
+        missing = sorted(set(range(world)) - set(ranks_present))
+        if missing:
+            warnings.append(
+                f"missing bundle(s) for rank(s) {missing} of world_size "
+                f"{world} — a dead rank leaves a hole, not a silent lane; "
+                "first-cause covers only the ranks present")
+
+    offsets, align_mode = _clock_offsets_s(bundles, warnings)
+
+    merged: List[Dict[str, Any]] = []
+    seen_ids = set()
+    for b in bundles:
+        off = offsets.get(b["rank"], 0.0) if b["rank"] is not None else 0.0
+        for ev in b["events"]:
+            eid = ev.get("event_id")
+            if eid is not None and eid in seen_ids:
+                continue
+            if eid is not None:
+                seen_ids.add(eid)
+            ts = ev.get("ts")
+            rec = dict(ev)
+            rec["_bundle"] = b["label"]
+            rec["_rank"] = ev.get("rank", b["rank"])
+            rec["_ts_aligned"] = (float(ts) - off
+                                  if isinstance(ts, (int, float)) else None)
+            merged.append(rec)
+    dropped = [e for e in merged if e["_ts_aligned"] is None]
+    if dropped:
+        warnings.append(
+            f"{len(dropped)} event(s) carry no usable timestamp — appended "
+            "at the end of the timeline, unordered")
+    merged.sort(key=lambda e: (e["_ts_aligned"] is None,
+                               e["_ts_aligned"] or 0.0,
+                               e.get("_rank") if isinstance(
+                                   e.get("_rank"), int) else 1 << 30))
+    return {"timeline": merged, "align_mode": align_mode,
+            "offsets_s": offsets, "ranks": ranks_present}
+
+
+# ------------------------------------------------------------- first cause
+
+_VERDICT_KINDS = ("sdc_verdict", "gray_verdict")
+
+
+def first_cause(merged: Dict[str, Any],
+                bundles: List[Dict[str, Any]],
+                warnings: List[str]) -> Optional[Dict[str, Any]]:
+    """Earliest-anomaly heuristic, strongest evidence first:
+
+    1. the earliest blaming verdict (SDC/gray name a device);
+    2. the earliest severity>=error event;
+    3. restart evidence (earliest restart record);
+    4. skew gauges from the metric tails (max |value| wins).
+    """
+    timeline = merged["timeline"]
+    for ev in timeline:
+        if ev.get("kind") in _VERDICT_KINDS:
+            p = ev.get("payload") or {}
+            return {"rank": ev.get("_rank"), "device": p.get("device"),
+                    "kind": ev.get("kind"), "step": ev.get("step"),
+                    "ts": ev.get("_ts_aligned"),
+                    "why": f"earliest blaming verdict "
+                           f"({ev.get('kind')} {p.get('kind', '?')})"}
+    for ev in timeline:
+        if _sev(ev.get("severity")) >= _sev("error"):
+            return {"rank": ev.get("_rank"), "device": None,
+                    "kind": ev.get("kind"), "step": ev.get("step"),
+                    "ts": ev.get("_ts_aligned"),
+                    "why": "earliest severity>=error event"}
+    restarts = []
+    for b in bundles:
+        for rec in b["restart"]:
+            ts = rec.get("ts")
+            if isinstance(ts, (int, float)):
+                restarts.append((ts, b["rank"], rec))
+    if restarts:
+        restarts.sort(key=lambda t: t[0])
+        ts, rank, rec = restarts[0]
+        return {"rank": rank, "device": None,
+                "kind": rec.get("kind", "restart"),
+                "step": rec.get("step"), "ts": ts,
+                "why": "earliest restart record (no in-ring error evidence)"}
+    best = None
+    for b in bundles:
+        for rec in b["metrics_tail"]:
+            name = str(rec.get("name", ""))
+            if "skew" not in name:
+                continue
+            v = rec.get("value")
+            if isinstance(v, (int, float)) and (
+                    best is None or abs(v) > abs(best[0])):
+                best = (v, b["rank"], name)
+    if best is not None:
+        return {"rank": best[1], "device": None, "kind": best[2],
+                "step": None, "ts": None,
+                "why": f"largest skew gauge |{best[0]:.4g}| "
+                       "(weak evidence: no verdicts, errors, or restarts)"}
+    warnings.append("no first-cause evidence found (no verdicts, errors, "
+                    "restarts, or skew gauges) — refusing to guess")
+    return None
+
+
+# ------------------------------------------------------------------- cost
+
+def _recovery_from_restarts(bundles: List[Dict[str, Any]]
+                            ) -> Optional[Dict[str, Any]]:
+    for b in bundles:
+        for rec in reversed(b["restart"]):
+            rc = rec.get("recovery")
+            if isinstance(rc, dict) and rc.get("tier"):
+                return rc
+    return None
+
+
+def incident_cost(bundles: List[Dict[str, Any]],
+                  warnings: List[str]) -> Dict[str, Any]:
+    """Goodput cost of the incident: fleet-seconds of restart downtime.
+
+    Prefers the full goodput ledger (session traces + restart_log from the
+    telemetry dirs the bundles live under); degrades to summing the restart
+    records captured inside the bundles.
+    """
+    out: Dict[str, Any] = {"fleet_seconds": None, "source": None,
+                           "recovery": _recovery_from_restarts(bundles)}
+    tel_dirs = sorted({os.path.dirname(os.path.dirname(
+        os.path.normpath(b["dir"]))) for b in bundles})
+    try:
+        from deepspeed_tpu.goodput.report import (build_job_report,
+                                                  find_session_traces,
+                                                  load_restart_log)
+        traces = find_session_traces(tel_dirs)
+        if traces:
+            rep = build_job_report(traces, load_restart_log(tel_dirs))
+            buckets = rep.get("fleet_seconds", {}) or rep.get("buckets", {})
+            restart_s = None
+            if isinstance(buckets, dict):
+                restart_s = buckets.get("restart")
+            if restart_s is not None:
+                out["fleet_seconds"] = round(float(restart_s), 3)
+                out["source"] = "goodput ledger (session traces)"
+            if out["recovery"] is None:
+                recs = rep.get("recoveries") or []
+                if recs:
+                    out["recovery"] = recs[-1]
+            return out
+    except Exception as e:  # noqa: BLE001 - degrade, never die
+        warnings.append(f"goodput ledger unavailable for cost ({e}) — "
+                        "falling back to bundle restart records")
+    total = 0.0
+    n = 0
+    for b in bundles:
+        for rec in b["restart"]:
+            for key in ("backoff_s",):
+                v = rec.get(key)
+                if isinstance(v, (int, float)):
+                    total += v
+                    n += 1
+            rc = rec.get("recovery") or {}
+            for key in ("restore_s", "reshard_s"):
+                v = rc.get(key) if isinstance(rc, dict) else None
+                if isinstance(v, (int, float)):
+                    total += v
+    if n or total:
+        out["fleet_seconds"] = round(total, 3)
+        out["source"] = "bundle restart records (lower bound)"
+    return out
+
+
+# ------------------------------------------------------------------ report
+
+def build_report(paths: List[str]) -> Dict[str, Any]:
+    warnings: List[str] = []
+    dirs = discover_bundles(paths, warnings)
+    bundles = [b for d in dirs
+               if (b := load_bundle(d, warnings)) is not None]
+    if not bundles:
+        return {"bundles": [], "warnings": warnings}
+    merged = merge_bundles(bundles, warnings)
+    cause = first_cause(merged, bundles, warnings)
+    cost = incident_cost(bundles, warnings)
+    triggers = [(b["manifest"].get("ts"), b["manifest"].get("trigger"),
+                 b["label"], b["rank"]) for b in bundles]
+    triggers.sort(key=lambda t: (t[0] is None, t[0]))
+    return {
+        "bundles": [{"dir": b["dir"], "label": b["label"],
+                     "rank": b["rank"],
+                     "trigger": b["manifest"].get("trigger"),
+                     "events": len(b["events"])} for b in bundles],
+        "ranks": merged["ranks"],
+        "align_mode": merged["align_mode"],
+        "offsets_s": merged["offsets_s"],
+        "trigger": {"kind": triggers[0][1], "bundle": triggers[0][2],
+                    "rank": triggers[0][3]} if triggers else None,
+        "timeline": merged["timeline"],
+        "first_cause": cause,
+        "cost": cost,
+        "warnings": warnings,
+    }
+
+
+def _fmt_payload(p: Any, width: int = 72) -> str:
+    try:
+        s = json.dumps(p, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        s = str(p)
+    return s if len(s) <= width else s[:width - 3] + "..."
+
+
+def render_report(report: Dict[str, Any], max_events: int = 60) -> str:
+    lines: List[str] = []
+    bundles = report.get("bundles", [])
+    if not bundles:
+        lines.append("ds_incident: no incident bundles found")
+        for w in report.get("warnings", []):
+            lines.append(f"  warning: {w}")
+        return "\n".join(lines)
+    lines.append(f"incident report — {len(bundles)} bundle(s), "
+                 f"rank(s) {report.get('ranks', [])}")
+    trig = report.get("trigger")
+    if trig:
+        lines.append(f"trigger: {trig['kind']} "
+                     f"(bundle {trig['bundle']}, rank {trig['rank']})")
+    cause = report.get("first_cause")
+    if cause:
+        where = f"rank {cause.get('rank')}"
+        if cause.get("device") is not None:
+            where += f" device {cause['device']}"
+        at = f" at step {cause['step']}" if cause.get("step") is not None \
+            else ""
+        lines.append(f"first cause: {where} — {cause.get('kind')}{at} "
+                     f"[{cause.get('why')}]")
+    else:
+        lines.append("first cause: undetermined (see warnings)")
+    cost = report.get("cost") or {}
+    rec = cost.get("recovery") or {}
+    if rec:
+        bits = [f"tier={rec.get('tier')}"]
+        if rec.get("resize"):
+            rs = rec["resize"]
+            if isinstance(rs, dict):
+                bits.append(f"resize {rs.get('from')}->{rs.get('to')}")
+            else:
+                bits.append(f"resize {rs}")
+        if rec.get("steps_lost") is not None:
+            bits.append(f"steps_lost={rec.get('steps_lost')}")
+        lines.append("recovery: " + ", ".join(bits))
+    if cost.get("fleet_seconds") is not None:
+        lines.append(f"cost: {cost['fleet_seconds']} fleet-seconds of "
+                     f"restart downtime [{cost.get('source')}]")
+    else:
+        lines.append("cost: unknown (no session traces or restart records)")
+    timeline = report.get("timeline", [])
+    lines.append(f"timeline ({len(timeline)} events, "
+                 f"{report.get('align_mode')}):")
+    t0 = next((e["_ts_aligned"] for e in timeline
+               if e.get("_ts_aligned") is not None), None)
+    shown = timeline if len(timeline) <= max_events else \
+        timeline[:max_events // 2] + [None] + timeline[-max_events // 2:]
+    for ev in shown:
+        if ev is None:
+            lines.append(f"  ... {len(timeline) - max_events} more ...")
+            continue
+        ts = ev.get("_ts_aligned")
+        rel = f"+{ts - t0:9.3f}s" if ts is not None and t0 is not None \
+            else "      ?.???s"
+        step = f" step={ev.get('step')}" if ev.get("step") is not None else ""
+        lines.append(
+            f"  {rel} rank{ev.get('_rank')} "
+            f"{str(ev.get('severity', '?')).upper():8s} "
+            f"{ev.get('kind')}{step} {_fmt_payload(ev.get('payload'))}")
+    for w in report.get("warnings", []):
+        lines.append(f"warning: {w}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------- CLI
+
+def _cmd_report(args: List[str]) -> int:
+    as_json = "--json" in args
+    paths = [a for a in args if not a.startswith("--")]
+    if not paths:
+        print("usage: ds_incident report DIR... [--json]")
+        return 2
+    report = build_report(paths)
+    if as_json:
+        slim = dict(report)
+        print(json.dumps(slim, indent=1, default=str))
+    else:
+        print(render_report(report))
+    return 0 if report.get("bundles") else 1
+
+
+def _cmd_list(args: List[str]) -> int:
+    warnings: List[str] = []
+    dirs = discover_bundles(args or ["."], warnings)
+    for d in dirs:
+        b = load_bundle(d, warnings)
+        if b is None:
+            continue
+        m = b["manifest"]
+        print(f"{b['label']}: trigger={m.get('trigger')} rank={b['rank']} "
+              f"events={len(b['events'])} ts={m.get('ts')}")
+    for w in warnings:
+        print(f"warning: {w}")
+    return 0 if dirs else 1
+
+
+def _cmd_snap(args: List[str]) -> int:
+    import signal as _signal
+    pid = None
+    if "--pid" in args:
+        try:
+            pid = int(args[args.index("--pid") + 1])
+        except (IndexError, ValueError):
+            print("usage: ds_incident snap --pid PID")
+            return 2
+    if pid is None:
+        print("usage: ds_incident snap --pid PID   "
+              "(sends SIGUSR1; the blackbox recorder in that process dumps "
+              "stacks + an incident bundle)")
+        return 2
+    if not hasattr(_signal, "SIGUSR1"):
+        print("ds_incident snap: SIGUSR1 unavailable on this platform")
+        return 1
+    os.kill(pid, _signal.SIGUSR1)
+    print(f"sent SIGUSR1 to pid {pid}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import sys as _sys
+    argv = list(_sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: ds_incident {report DIR... [--json] | list [DIR] | "
+              "snap --pid PID}")
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "report":
+        return _cmd_report(rest)
+    if cmd == "list":
+        return _cmd_list(rest)
+    if cmd == "snap":
+        return _cmd_snap(rest)
+    print(f"ds_incident: unknown command {cmd!r}")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

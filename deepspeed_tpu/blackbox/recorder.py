@@ -1,0 +1,289 @@
+"""Flight recorder: bounded in-memory ring of incident events.
+
+Everything here is off the step path: producers call :meth:`record` only
+when something noteworthy happens (a verdict, a timeout, a transition), and
+the per-step hook :meth:`on_step` is a single deque append under a lock.
+The ring is snapshotted-then-released before any bundle I/O — no file write
+ever happens while the ring lock is held (PR-19 locks discipline).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.telemetry.events import make_event, severity_rank
+from deepspeed_tpu.utils import locks as _locks
+from deepspeed_tpu.utils.logging import logger
+
+
+class FlightRecorder:
+    """Bounded ring of envelope events + rolling step tail + bundle trigger.
+
+    One recorder per process; armed from the ``blackbox`` ds_config block.
+    Severity >= ``trigger_severity`` (default "error") events trigger an
+    incident bundle dump, rate-limited by ``min_trigger_interval_s``.
+    """
+
+    def __init__(self, cfg, rank: int = 0):
+        self.cfg = cfg
+        self.rank = int(rank)
+        # Clock anchor: epoch + monotonic captured back-to-back (the PR-8
+        # trace-anchor idiom).  Event wall timestamps are derived from the
+        # monotonic clock so they order correctly even if NTP steps the
+        # wall clock mid-run; the anchor lets ds_incident align ranks.
+        self._t0 = time.perf_counter()
+        self.epoch0 = time.time()
+        # RLock: producers emit from signal-handler context (the serving
+        # front-end's begin_drain) — a handler interrupting this thread's
+        # own append must re-enter, not self-deadlock
+        self._lock = _locks.make_rlock("blackbox.ring")
+        self._ring: deque = deque(maxlen=max(1, int(cfg.ring_size)))
+        self._step_tail: deque = deque(maxlen=max(1, int(cfg.metric_tail)))
+        self.last_step: Optional[int] = None
+        self.events_total = 0
+        self.errors_total = 0
+        self.bundles_written = 0
+        self.last_trigger: Optional[str] = None
+        self.last_bundle_dir: Optional[str] = None
+        self._overhead_us = 0.0
+        self._steps_seen = 0
+        # Stamped by the engine at wiring time (best-effort identity for the
+        # bundle manifest; ds_incident warns on cross-rank mismatches).
+        self.config_fingerprint: Optional[str] = None
+        self.world_size: Optional[int] = None
+        self._last_bundle_mono: Optional[float] = None
+        self._trigger_rank = severity_rank(getattr(cfg, "trigger_severity", "error"))
+        self._closed = False
+        self._signal_event = threading.Event()
+        self._signal_thread = None
+        self._prev_sigusr1 = None
+        if getattr(cfg, "signal_snap", True):
+            self._arm_signal()
+
+    # ---------------------------------------------------------------- clock
+
+    def now(self) -> Dict[str, float]:
+        """Paired (epoch, monotonic) stamp derived from the anchor."""
+        mono = time.perf_counter()
+        return {"ts": self.epoch0 + (mono - self._t0), "mono": mono}
+
+    def clock_anchor(self) -> Dict[str, float]:
+        return {"epoch_s": self.epoch0, "monotonic_s": self._t0}
+
+    # ------------------------------------------------------------ recording
+
+    def record(
+        self,
+        kind: str,
+        severity: str,
+        payload: Optional[Dict[str, Any]] = None,
+        step: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Append one envelope event; may trigger a bundle dump (off-lock)."""
+        t_in = time.perf_counter()
+        stamp = self.now()
+        ev = make_event(
+            kind, severity, payload,
+            step=step if step is not None else self.last_step,
+            rank=self.rank, ts=stamp["ts"], mono=stamp["mono"],
+        )
+        sev_rank = severity_rank(severity)
+        with self._lock:
+            self._ring.append(ev)
+            self.events_total += 1
+            if sev_rank >= severity_rank("error"):
+                self.errors_total += 1
+            should_dump = (
+                sev_rank >= self._trigger_rank
+                and not self._closed
+                and self._bundle_allowed_locked()
+            )
+            if should_dump:
+                # Claim the rate-limit slot while still under the lock so
+                # concurrent error events race for at most one bundle.
+                self._last_bundle_mono = time.perf_counter()
+        self._count_metrics(kind, severity)
+        self._overhead_us += (time.perf_counter() - t_in) * 1e6
+        if should_dump:
+            # Bundle I/O is deliberately outside the ring lock AND outside
+            # the overhead accounting window: overhead measures the always-on
+            # append cost, not the (rare, already-in-trouble) dump cost.
+            self.dump(trigger=kind, _preclaimed=True)
+        return ev
+
+    def on_step(self, step: int, wall_s: Optional[float] = None) -> None:
+        """Per-step tail sample — one locked deque append, nothing else."""
+        t_in = time.perf_counter()
+        stamp_ts = self.epoch0 + (t_in - self._t0)
+        with self._lock:
+            self.last_step = int(step)
+            self._steps_seen += 1
+            self._step_tail.append(
+                {"step": int(step), "ts": round(stamp_ts, 6),
+                 "wall_s": round(wall_s, 6) if wall_s is not None else None})
+        self._overhead_us += (time.perf_counter() - t_in) * 1e6
+
+    def _count_metrics(self, kind: str, severity: str) -> None:
+        try:
+            from deepspeed_tpu import telemetry
+            reg = telemetry.get_registry()
+            reg.counter("blackbox/events", labels={"severity": severity}).inc()
+            reg.gauge("blackbox/ring_fill").set(len(self._ring))
+        except Exception:  # noqa: BLE001 - metrics must never break recording
+            pass
+
+    # ------------------------------------------------------------ snapshots
+
+    def ring_snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def step_tail_snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._step_tail)
+
+    def overhead_us(self) -> float:
+        return self._overhead_us
+
+    def steps_seen(self) -> int:
+        return self._steps_seen
+
+    # -------------------------------------------------------------- bundles
+
+    def _bundle_allowed_locked(self) -> bool:
+        if self._last_bundle_mono is None:
+            return True
+        gap = time.perf_counter() - self._last_bundle_mono
+        return gap >= float(getattr(self.cfg, "min_trigger_interval_s", 30.0))
+
+    def output_dir(self) -> Optional[str]:
+        base = getattr(self.cfg, "output_dir", None)
+        if base:
+            return base
+        try:
+            from deepspeed_tpu import telemetry
+            sess = telemetry.get_session()
+            if sess is not None and getattr(sess, "output_dir", None):
+                return sess.output_dir
+        except Exception:  # noqa: BLE001
+            pass
+        return None
+
+    def dump(self, trigger: str, force: bool = False,
+             _preclaimed: bool = False) -> Optional[str]:
+        """Write an incident bundle; returns its directory, or None.
+
+        ``force`` bypasses the rate limit (SIGUSR1 / ``ds_incident snap``).
+        """
+        if not _preclaimed:
+            with self._lock:
+                if not force and not self._bundle_allowed_locked():
+                    logger.warning(
+                        "blackbox: bundle for trigger %r suppressed by "
+                        "min_trigger_interval_s=%.1f", trigger,
+                        getattr(self.cfg, "min_trigger_interval_s", 30.0))
+                    return None
+                if force and self._last_bundle_mono is not None and \
+                        time.perf_counter() - self._last_bundle_mono < 2.0:
+                    # debounce: one SIGUSR1 can reach both the elastic
+                    # agent's chained handler and ours — one bundle, not two
+                    return None
+                self._last_bundle_mono = time.perf_counter()
+        base = self.output_dir()
+        if base is None:
+            logger.warning(
+                "blackbox: trigger %r but no output dir (set blackbox."
+                "output_dir or telemetry.output_dir); bundle dropped", trigger)
+            return None
+        from . import bundle as _bundle
+        try:
+            path = _bundle.write_bundle(self, trigger, base)
+        except Exception as e:  # noqa: BLE001 - forensics must not kill training
+            logger.warning("blackbox: bundle write for trigger %r failed: %s",
+                           trigger, e)
+            return None
+        if path is not None:
+            self.bundles_written += 1
+            self.last_trigger = trigger
+            self.last_bundle_dir = path
+            try:
+                from deepspeed_tpu import telemetry
+                telemetry.get_registry().counter(
+                    "blackbox/bundles", labels={"trigger": trigger}).inc()
+            except Exception:  # noqa: BLE001
+                pass
+            logger.warning("blackbox: incident bundle written: %s "
+                           "(trigger=%s)", path, trigger)
+            _bundle.prune_bundles(os.path.join(base, "incidents"),
+                                  int(getattr(self.cfg, "max_bundles", 8)))
+        return path
+
+    # -------------------------------------------------------------- signals
+
+    def _arm_signal(self) -> None:
+        """Route SIGUSR1 → bundle snap, via a sentinel thread.
+
+        The handler itself only sets a ``threading.Event`` (async-signal
+        safe); all I/O — stack dump + bundle write — happens on the
+        ``ds-blackbox-signal`` sentinel thread.
+        """
+        if threading.current_thread() is not threading.main_thread():
+            return
+        if not hasattr(signal, "SIGUSR1"):
+            return
+
+        @_locks.signal_safe("sets an Event; I/O deferred to sentinel thread")
+        def _handler(signum, frame):
+            self._signal_event.set()
+            # prev is the previously REGISTERED SIGUSR1 handler (vetted at
+            # its own registration); chaining preserves the elastic agent's
+            # stack dump instead of silently dropping it
+            prev = self._prev_sigusr1
+            # race-allow: signal-unsafe — callable() is a pure C builtin predicate, no Python re-entry
+            if callable(prev):
+                # race-allow: signal-unsafe — chaining the handler that was installed before ours
+                prev(signum, frame)
+
+        try:
+            self._prev_sigusr1 = signal.signal(signal.SIGUSR1, _handler)
+        except (ValueError, OSError):
+            return
+        self._signal_thread = _locks.spawn_thread(
+            self._signal_loop, name="ds-blackbox-signal", owner="blackbox",
+            daemon=True, expect_join=True)
+        self._signal_thread.start()
+
+    def _signal_loop(self) -> None:
+        while not self._closed:
+            if not self._signal_event.wait(timeout=0.25):
+                continue
+            self._signal_event.clear()
+            if self._closed:
+                break
+            try:
+                from deepspeed_tpu.resilience import watchdog as _wd
+                _wd.dump_all_stacks(None, reason="SIGUSR1 (blackbox snap)")
+            except Exception:  # noqa: BLE001
+                pass
+            self.dump(trigger="sigusr1", force=True)
+
+    # ---------------------------------------------------------------- close
+
+    def close(self) -> None:
+        self._closed = True
+        if self._signal_thread is not None:
+            self._signal_event.set()
+            self._signal_thread.join(timeout=2.0)
+            self._signal_thread = None
+        if self._prev_sigusr1 is not None:
+            try:
+                if threading.current_thread() is threading.main_thread():
+                    signal.signal(signal.SIGUSR1, self._prev_sigusr1)
+            except (ValueError, OSError):
+                pass
+            self._prev_sigusr1 = None

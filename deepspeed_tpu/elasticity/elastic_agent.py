@@ -42,11 +42,13 @@ from __future__ import annotations
 import os
 import signal
 import sys
+import threading
 import time
 from typing import Any, Callable, Iterable, Optional
 
 from deepspeed_tpu.resilience.manifest import find_restorable_tag, verify_tag
 from deepspeed_tpu.resilience.retry import RestartBackoff
+from deepspeed_tpu.utils import locks as _locks
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -88,6 +90,8 @@ class DSElasticAgent:
         # the failure record awaiting its recovery stamp (tier/steps_lost
         # land after the NEXT successful bring-up restores)
         self._pending_restart_record = None
+        self._dump_event = threading.Event()
+        self._dump_thread = None
         if install_signal_handlers:
             self._install_handlers()
             self._install_stack_dump_signal()
@@ -102,20 +106,55 @@ class DSElasticAgent:
                                "outside the main thread")
                 return
 
-    @staticmethod
-    def _install_stack_dump_signal():
-        """SIGUSR1 → faulthandler all-thread stack dump to stderr: operators
-        inspect a live wedged process (``kill -USR1 <pid>``) without killing
-        it. ``chain=True`` keeps any user handler working."""
+    def _install_stack_dump_signal(self):
+        """SIGUSR1 → all-thread stack dump: operators inspect a live wedged
+        process (``kill -USR1 <pid>``) without killing it.
+
+        Two layers, in chain order: a Python-level handler that only sets an
+        Event (async-signal safe) whose sentinel thread APPENDS the dump to
+        the watchdog's default dump file — the telemetry dir, so incident
+        bundles and remote debugging capture it — and pokes the blackbox
+        recorder for an on-demand bundle; then ``faulthandler.register``
+        (``chain=True`` back to the Python handler), whose C-level dump to
+        stderr still fires even when the main thread is wedged inside one C
+        call and no Python handler could ever run."""
         import faulthandler
 
         if not hasattr(signal, "SIGUSR1"):      # pragma: no cover - windows
             return
+
+        @_locks.signal_safe("sets an Event; file I/O deferred to the "
+                            "ds-elastic-sigusr1 sentinel thread")
+        def _handler(signum, frame):
+            self._dump_event.set()
+
         try:
+            signal.signal(signal.SIGUSR1, _handler)
             faulthandler.register(signal.SIGUSR1, all_threads=True, chain=True)
         except (ValueError, OSError, RuntimeError) as e:
             logger.warning(f"elastic agent: cannot register SIGUSR1 stack-dump "
                            f"handler: {e}")
+            return
+        self._dump_thread = _locks.spawn_thread(
+            self._stack_dump_loop, name="ds-elastic-sigusr1", owner="elastic",
+            daemon=True, expect_join=False)
+        self._dump_thread.start()
+
+    def _stack_dump_loop(self):
+        """Sentinel for the SIGUSR1 file dump (daemon; dies with the
+        process — the agent has no teardown hook and needs none)."""
+        from deepspeed_tpu.resilience.watchdog import dump_all_stacks
+
+        while True:
+            self._dump_event.wait()
+            self._dump_event.clear()
+            # stderr already got the faulthandler C-level dump; this pass
+            # appends to the default dump file (the telemetry dir when an
+            # engine is up) and snapshots an incident bundle if armed
+            dump_all_stacks(None, reason="SIGUSR1", to_stderr=False)
+            bb = sys.modules.get("deepspeed_tpu.blackbox")
+            if bb is not None:
+                bb.snap("sigusr1")
 
     def _on_preempt(self, signum, frame):
         logger.warning(f"elastic agent: received signal {signum} — will "
@@ -379,6 +418,11 @@ class DSElasticAgent:
                              "post-event world; the snapshot ladder "
                              "reshards the TrainState onto the survivors",
                              ranks=[0])
+                    bb = sys.modules.get("deepspeed_tpu.blackbox")
+                    if bb is not None:
+                        bb.record("fleet_resize", "warning",
+                                  {"kind": e.kind, "from": e.from_world,
+                                   "to": e.to_world})
                 if jax.process_count() > 1:
                     # a host-LOCAL failure cannot be healed by an in-process
                     # restart on one controller: the surviving hosts keep
@@ -400,7 +444,12 @@ class DSElasticAgent:
                     self._persist_restart_record(self._pending_restart_record)
                     self._pending_restart_record = None
                 delay = self.restart_backoff.next_delay()
-                record = {
+                from deepspeed_tpu.telemetry.events import stamp_envelope
+
+                # schema_version + event_id ride every restart record so
+                # ds_incident merges mixed-version fleets loudly instead
+                # of mis-parsing them
+                record = stamp_envelope({
                     "restart": self.restart_count,
                     "error": f"{type(e).__name__}: {e}",
                     "step": int(self.engine.state.step) if self.engine is not None else None,
@@ -409,8 +458,15 @@ class DSElasticAgent:
                     # to the inter-session gap it explains (the sessions'
                     # clock anchors put the gap on the same epoch axis)
                     "ts": time.time(),
-                }
+                }, kind="restart", severity="error")
                 self.restart_log.append(record)
+                bb = sys.modules.get("deepspeed_tpu.blackbox")
+                if bb is not None:
+                    bb.record("restart", "error",
+                              {"restart": self.restart_count,
+                               "error": record["error"],
+                               "backoff_s": record["backoff_s"]},
+                              step=record["step"])
                 # persistence is DEFERRED to the next successful bring-up
                 # (_stamp_recovery), so the on-disk record carries the
                 # recovery's {tier, snapshot_step, steps_lost, restore_s};

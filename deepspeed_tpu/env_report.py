@@ -425,6 +425,16 @@ def main(args=None):
         from deepspeed_tpu.analysis.cli import xray_cli
 
         return xray_cli(args[1:])
+    if args and args[0] == "incident":
+        # `ds_report incident <bundle_or_telemetry_dir>...` — the merged
+        # cross-rank incident timeline with first-cause attribution; the
+        # full tool is `bin/ds_incident`, which also runs jax-free
+        from deepspeed_tpu.blackbox.incident import main as incident_main
+
+        rest = args[1:]
+        if not rest or rest[0].startswith("-") or os.path.exists(rest[0]):
+            rest = ["report"] + rest
+        return incident_main(rest)
     if args and args[0] == "roofline":
         # `ds_report roofline report --hlo DUMP | --config X` — the
         # analytic roofline (per-region FLOPs/bytes, MFU ceilings); the

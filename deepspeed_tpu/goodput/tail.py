@@ -249,6 +249,47 @@ def render_roofline_line(gauges: Dict[str, float],
     return "  ".join(parts)
 
 
+def render_incident_line(gauges: Dict[str, float],
+                         counters: Dict[str, float]) -> Optional[str]:
+    """The ds_blackbox status line: flight-recorder event totals by
+    severity, ring fill, and the incident-bundle ledger with the last
+    trigger kind. Same contract as :func:`render_sdc_line` — rendered by
+    ``ds_top`` frames and the ``ds_metrics`` footer, pure stdlib so the
+    jax-free CLIs can file-load it. Returns None when the run never
+    armed the blackbox block."""
+    if not any(k.startswith("blackbox/") for k in gauges) and \
+            not any(k.startswith("blackbox/") for k in counters):
+        return None
+    parts = ["incident:"]
+    events = {k: v for k, v in counters.items()
+              if k.startswith("blackbox/events")}
+    total = int(sum(events.values()))
+    errors = int(sum(v for k, v in events.items()
+                     if parse_label(k, "severity") in ("error", "critical")))
+    seg = f"{total} event(s)"
+    if errors:
+        seg += f" ({errors} error)"
+    parts.append(seg)
+    fill = gauges.get("blackbox/ring_fill")
+    if fill is not None:
+        parts.append(f"ring {int(fill)}")
+    bundles = {k: v for k, v in counters.items()
+               if k.startswith("blackbox/bundles")}
+    nb = int(sum(bundles.values()))
+    if nb:
+        seg = f"BUNDLES {nb}"
+        # the trigger label of the (alphabetically last-touched) series
+        # is the best stdlib guess at the latest trigger; exact ordering
+        # lives in the bundles themselves
+        triggers = sorted({parse_label(k, "trigger") or "?"
+                           for k in bundles})
+        seg += " (" + ", ".join(triggers) + ")"
+        parts.append(seg)
+    else:
+        parts.append("no bundles")
+    return "  ".join(parts)
+
+
 class JSONLTailer:
     """Incremental reader of an append-mostly JSONL file.
 

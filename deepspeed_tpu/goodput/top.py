@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.goodput.tail import (MetricsFollower, labeled_key,
                                         render_gray_line,
+                                        render_incident_line,
                                         render_resize_line,
                                         render_rewind_line,
                                         render_roofline_line,
@@ -143,6 +144,9 @@ def render_frame(records: List[dict], source: Optional[str] = None,
     roof = render_roofline_line(g, s["counters"])
     if roof:
         out.append(roof)
+    inc = render_incident_line(g, s["counters"])
+    if inc:
+        out.append(inc)
 
     if s["comm_skew"] is not None:
         ratio, op, p50, mx = s["comm_skew"]

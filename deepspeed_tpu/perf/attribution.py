@@ -182,6 +182,40 @@ def collect(engine, session=None, timed_steps: Optional[int] = None,
             att["gray_overhead"] = round(
                 float((gp.get("buckets_us") or {}).get("probe", 0.0)) / wall,
                 5)
+    # ---- blackbox_overhead: the flight recorder's host-side append cost
+    # as a fraction of the mean step wall — the number `ds_perf gate
+    # --metric blackbox_overhead` regresses on. Measured by the recorder
+    # itself (record()/on_step() append time; bundle-dump I/O is outside
+    # the window — a dump is an incident, not a per-step tax). An armed
+    # recorder that saw no events stamps an honest ~0.0, so the ledger
+    # records that always-on costs (almost) nothing.
+    bb = getattr(engine, "_blackbox", None)
+    if bb is not None:
+        try:
+            steps_seen = bb.steps_seen()
+            if steps_seen > 0:
+                per_step_us = bb.overhead_us() / steps_seen
+                wall_us = None
+                gp = att.get("goodput")
+                if gp:
+                    per = gp.get("per_step") or []
+                    walls = [float(s.get("wall_us") or 0.0) for s in per]
+                    walls = [w for w in walls if w > 0]
+                    if walls:
+                        wall_us = sum(walls) / len(walls)
+                if wall_us is None and events:
+                    # no goodput ledger: fall back to the tracer's own
+                    # train_batch spans for the mean step wall
+                    durs = [float(ev["dur"]) for ev in events
+                            if ev.get("ph") == "X" and "dur" in ev
+                            and ev.get("name") == "train_batch"]
+                    if durs:
+                        wall_us = sum(durs) / len(durs)
+                if wall_us and wall_us > 0:
+                    att["blackbox_overhead"] = round(per_step_us / wall_us, 7)
+        except Exception as e:
+            logger.warning(
+                f"perf attribution: blackbox overhead failed: {e}")
     # ---- memory: census buckets + compiled-step accounting
     try:
         res = engine.memory_census()

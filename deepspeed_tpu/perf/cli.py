@@ -137,6 +137,15 @@ def _gray_overhead_line(r):
             + (" [REGRESSED]" if r.get("gray_overhead_regressed") else ""))
 
 
+def _blackbox_overhead_line(r):
+    if "new_blackbox_overhead" not in r:
+        return ""
+    return (f"  blackbox_overhead {r['old_blackbox_overhead']:.3%} -> "
+            f"{r['new_blackbox_overhead']:.3%} of wall"
+            + (" [REGRESSED]" if r.get("blackbox_overhead_regressed")
+               else ""))
+
+
 def _mfu_gap_line(r):
     if "new_mfu_gap" not in r:
         return ""
@@ -175,7 +184,7 @@ def _cmd_diff(args) -> int:
               f"{_fmt_val(r['new_value'])} ({r['rel_delta']:+.1%})"
               f"{noise}{fp}{_exposed_line(r)}{_static_comm_line(r)}"
               f"{_sdc_overhead_line(r)}{_gray_overhead_line(r)}"
-              f"{_mfu_gap_line(r)}")
+              f"{_blackbox_overhead_line(r)}{_mfu_gap_line(r)}")
         if "exposed_comm" in attr_sel and "new_exposed_comm_us" not in r:
             print(f"   {r['series']}: exposed_comm not recorded on both "
                   "sides (needs telemetry-instrumented entries)")
@@ -191,6 +200,11 @@ def _cmd_diff(args) -> int:
             print(f"   {r['series']}: gray_overhead not recorded on both "
                   "sides (needs entries measured under the gray + goodput "
                   "blocks)")
+        if "blackbox_overhead" in attr_sel \
+                and "new_blackbox_overhead" not in r:
+            print(f"   {r['series']}: blackbox_overhead not recorded on "
+                  "both sides (needs entries measured under the blackbox "
+                  "block with telemetry tracing or the goodput ledger)")
         if "mfu_gap" in attr_sel and "new_mfu_gap" not in r:
             print(f"   {r['series']}: mfu_gap not recorded on both sides "
                   "(needs MFU entries measured under the roofline + perf "
@@ -249,6 +263,10 @@ def _cmd_gate(args) -> int:
         if "gray_overhead" in attr_sel and "new_gray_overhead" not in r:
             missing.append(f"{k} (gray_overhead attribution)")
             continue
+        if "blackbox_overhead" in attr_sel \
+                and "new_blackbox_overhead" not in r:
+            missing.append(f"{k} (blackbox_overhead attribution)")
+            continue
         if "mfu_gap" in attr_sel and "new_mfu_gap" not in r:
             missing.append(f"{k} (mfu_gap attribution)")
             continue
@@ -263,6 +281,8 @@ def _cmd_gate(args) -> int:
                     and r.get("sdc_overhead_regressed")) \
                 or ("gray_overhead" in attr_sel
                     and r.get("gray_overhead_regressed")) \
+                or ("blackbox_overhead" in attr_sel
+                    and r.get("blackbox_overhead_regressed")) \
                 or ("mfu_gap" in attr_sel
                     and r.get("mfu_gap_regressed")):
             failures.append(r)
@@ -285,7 +305,8 @@ def _cmd_gate(args) -> int:
                             else ""))
             print(line + _world_tag(r) + _exposed_line(r)
                   + _static_comm_line(r) + _sdc_overhead_line(r)
-                  + _gray_overhead_line(r) + _mfu_gap_line(r))
+                  + _gray_overhead_line(r) + _blackbox_overhead_line(r)
+                  + _mfu_gap_line(r))
         for k in crashed:
             e = newest[k]
             print(f"FAIL {k}: newest run FAILED "
@@ -372,6 +393,10 @@ def main(argv=None) -> int:
                         "absolute-point tolerance + a 0.5-point floor — the "
                         "fail-slow defense must stay <= 2%% of wall at the "
                         "default cadence). "
+                        "'blackbox_overhead' gates on the flight recorder's "
+                        "ring-append cost as a fraction of wall (lower is "
+                        "better; absolute-point tolerance + a 0.5-point "
+                        "floor — always-on must stay effectively free). "
                         "'mfu_gap' gates on the roofline distance (analytic "
                         "mfu_ceiling − measured MFU, lower is better; "
                         "absolute-point tolerance + a 2-point floor; "

@@ -244,6 +244,14 @@ SDC_OVERHEAD_FLOOR = 0.005
 # more expensive (or fire far more often) — real.
 GRAY_OVERHEAD_FLOOR = 0.005
 
+# Blackbox recorder-overhead regression floor (absolute fraction points
+# of wall): the ds_blackbox contract is "always-on costs (nearly)
+# nothing" — the ring append is a deque.append under a lock, so the
+# honest number is well under half a percent of step wall. A sustained
+# half-point of growth means the recorder grew work on the step path
+# (or producers started flooding the ring) — real.
+BLACKBOX_OVERHEAD_FLOOR = 0.005
+
 # mfu_gap regression floor (absolute MFU points): the roofline gap is
 # ceiling − measured, already a ratio in [0,1]; growth below two MFU
 # points is CPU-sim noise, growth past it means either the measured MFU
@@ -255,7 +263,8 @@ MFU_GAP_FLOOR = 0.02
 # addition to series-key substrings: these select WHAT is compared (the
 # embedded attribution value), not WHICH series.
 ATTRIBUTION_METRICS = ("exposed_comm", "goodput", "static_comm_bytes",
-                       "sdc_overhead", "gray_overhead", "mfu_gap")
+                       "sdc_overhead", "gray_overhead", "blackbox_overhead",
+                       "mfu_gap")
 
 # Minimum per-side sample count for the t gate to carry a verdict: with
 # fewer, a failed significance test means "underpowered", not "noise",
@@ -442,6 +451,22 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         out["gray_overhead_regressed"] = (
             (yn - yo) > max(rel_tol * max(yo, GRAY_OVERHEAD_FLOOR),
                             GRAY_OVERHEAD_FLOOR))
+    # blackbox_overhead rides the same way (the flight recorder's own
+    # append-time accounting when ds_blackbox is armed): LOWER is better
+    # — the wall-fraction the always-on ring costs — judged in ABSOLUTE
+    # fraction points with a floor. `ds_perf gate --metric
+    # blackbox_overhead` is the subsystem's self-gate (recorder cost
+    # <= ~0.5% of wall, i.e. "always-on is effectively free").
+    bo = (old.get("attribution") or {}).get("blackbox_overhead")
+    bn = (new.get("attribution") or {}).get("blackbox_overhead")
+    if bo is not None and bn is not None:
+        bo, bn = float(bo), float(bn)
+        out["old_blackbox_overhead"] = bo
+        out["new_blackbox_overhead"] = bn
+        out["blackbox_overhead_delta"] = bn - bo
+        out["blackbox_overhead_regressed"] = (
+            (bn - bo) > max(rel_tol * max(bo, BLACKBOX_OVERHEAD_FLOOR),
+                            BLACKBOX_OVERHEAD_FLOOR))
     # roofline mfu_gap (hoisted top-level, like goodput_fraction): LOWER
     # is better — the distance between the measured MFU and the analytic
     # HLO-model ceiling — judged in ABSOLUTE MFU points with a floor

@@ -257,10 +257,18 @@ class ChaosInjector:
         return self.hang_rate > 0 or self.preempt_rate > 0
 
     def _count(self, op: str, action: str):
+        import sys
+
         from deepspeed_tpu import telemetry
 
         telemetry.get_registry().counter(
             "resilience/chaos_injections", labels={"op": op, "action": action}).inc()
+        bb = sys.modules.get("deepspeed_tpu.blackbox")
+        if bb is not None:
+            # chaos is self-inflicted: context for the timeline, never an
+            # error-severity trigger of its own
+            bb.record("chaos_injection", "warning",
+                      {"op": op, "action": action})
 
     def _hang(self, op: str, n: int, path: str):
         """Interruptible stall: sleep in POLL-sized slices so an async

@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -96,9 +97,13 @@ class GrayVerdict:
     evidence: Dict[str, Any] = field(default_factory=dict)
 
     def to_record(self) -> dict:
-        return {"event": "gray_verdict", "step": int(self.step),
-                "device": int(self.device), "kind": self.kind,
-                "evidence": self.evidence, "wall_ts": time.time()}
+        from deepspeed_tpu.telemetry.events import stamp_envelope
+
+        return stamp_envelope(
+            {"event": "gray_verdict", "step": int(self.step),
+             "device": int(self.device), "kind": self.kind,
+             "evidence": self.evidence, "wall_ts": time.time()},
+            kind="gray_verdict", severity="error")
 
 
 def classify_probe(compute_us: Dict[int, float], link_us: Dict[int, float],
@@ -416,6 +421,12 @@ class GrayManager:
         reg.gauge("gray/last_verdict_device").set(float(device))
         _tracer().instant("gray_verdict", cat="resilience", step=step,
                           device=device, kind=kind)
+        _bb = sys.modules.get("deepspeed_tpu.blackbox")
+        if _bb is not None:
+            _bb.record("gray_verdict", "error",
+                       {"device": int(device), "kind": kind,
+                        "suspicion": evidence.get("suspicion"),
+                        "verdicts": self.verdicts}, step=step)
         logger.error(
             f"gray: VERDICT at step {step} — device {device} confirmed "
             f"{kind} by {len(evidence.get('probes', []))} probe(s) after "

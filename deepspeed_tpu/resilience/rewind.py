@@ -367,6 +367,13 @@ class RewindManager:
         _telemetry.get_tracer().instant(
             "rewind_recovery", cat="resilience",
             **{k: v for k, v in info.items() if v is not None})
+        import sys
+
+        bb = sys.modules.get("deepspeed_tpu.blackbox")
+        if bb is not None:
+            bb.record("rewind_recovery", "info",
+                      {k: v for k, v in info.items() if v is not None},
+                      step=info.get("snapshot_step"))
 
     # ---------------------------------------------------------- emergency
     def emergency_save(self, save_dir: str) -> Optional[str]:

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -92,9 +93,13 @@ class SdcVerdict:
     evidence: Dict[str, Any] = field(default_factory=dict)
 
     def to_record(self) -> dict:
-        return {"event": "sdc_verdict", "step": int(self.step),
-                "device": int(self.device), "evidence": self.evidence,
-                "wall_ts": time.time()}
+        from deepspeed_tpu.telemetry.events import stamp_envelope
+
+        return stamp_envelope(
+            {"event": "sdc_verdict", "step": int(self.step),
+             "device": int(self.device), "evidence": self.evidence,
+             "wall_ts": time.time()},
+            kind="sdc_verdict", severity="error")
 
 
 # ------------------------------------------------------------------ folds
@@ -349,6 +354,12 @@ class SdcManager:
         _tracer().instant("sdc_verdict", cat="resilience", step=step,
                           device=device,
                           suspects=evidence.get("suspect_devices"))
+        _bb = sys.modules.get("deepspeed_tpu.blackbox")
+        if _bb is not None:
+            _bb.record("sdc_verdict", "error",
+                       {"device": int(device), "kind": "corruption",
+                        "suspects": evidence.get("suspect_devices"),
+                        "verdicts": self.verdicts}, step=step)
         logger.error(
             f"sdc: VERDICT at step {step} — replay audit diverged on "
             f"device(s) {evidence.get('suspect_devices')}; bisection blames "

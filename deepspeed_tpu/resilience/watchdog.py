@@ -78,11 +78,14 @@ def clear_config_dump_path() -> None:
         _default_dump_path_source = None
 
 
-def dump_all_stacks(path: Optional[str] = None, reason: str = "") -> None:
+def dump_all_stacks(path: Optional[str] = None, reason: str = "",
+                    to_stderr: bool = True) -> None:
     """faulthandler dump of every thread's stack — to ``path`` (appended,
     so repeated dumps of one incident stay together; defaults to the
-    engine-installed ``stack_dump_file``) plus stderr always. Never
-    raises: the dump is diagnostic garnish on an abort already underway."""
+    engine-installed ``stack_dump_file``) plus stderr (suppressible with
+    ``to_stderr=False`` for callers whose signal path already produced a
+    stderr dump). Never raises: the dump is diagnostic garnish on an
+    abort already underway."""
     path = path or _default_dump_path
     banner = f"\n==== watchdog stack dump ({reason or 'requested'}) ====\n"
     # a live wedge names its holder: which instrumented lock is held, by
@@ -92,14 +95,15 @@ def dump_all_stacks(path: Optional[str] = None, reason: str = "") -> None:
         holders = _locks.format_lock_holders() + "\n"
     except Exception as e:  # pragma: no cover - diagnostic path
         holders = f"lock holders: unavailable ({e})\n"
-    try:
-        sys.stderr.write(banner)
-        sys.stderr.flush()
-        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
-        sys.stderr.write(holders)
-        sys.stderr.flush()
-    except Exception as e:  # pragma: no cover - diagnostic path
-        logger.warning(f"watchdog: stderr stack dump failed: {e}")
+    if to_stderr:
+        try:
+            sys.stderr.write(banner)
+            sys.stderr.flush()
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+            sys.stderr.write(holders)
+            sys.stderr.flush()
+        except Exception as e:  # pragma: no cover - diagnostic path
+            logger.warning(f"watchdog: stderr stack dump failed: {e}")
     if path:
         try:
             with open(path, "a") as f:
@@ -145,6 +149,10 @@ def _count_timeout(kind: str, stall_s: Optional[float] = None) -> None:
         "resilience/watchdog_timeouts", labels={"kind": kind}).inc()
     telemetry.get_tracer().instant("watchdog_timeout", cat="resilience",
                                    kind=kind)
+    _bb = sys.modules.get("deepspeed_tpu.blackbox")
+    if _bb is not None:
+        _bb.record("watchdog_timeout", "error",
+                   {"kind": kind, "stall_s": stall_s})
     if stall_s is not None and stall_s > 0:
         # the stall itself as a complete span ending NOW: the goodput
         # ledger charges this window to `watchdog_stall` instead of

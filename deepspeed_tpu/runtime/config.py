@@ -875,6 +875,36 @@ class GrayConfig(DeepSpeedConfigModel):
     max_verdicts: int = Field(2, ge=0, description="gray verdicts tolerated before giving up with GrayError (matches sdc.max_verdicts / sentinel max_rewinds)")
 
 
+class BlackboxConfig(DeepSpeedConfigModel):
+    """ds_blackbox always-on flight recorder + incident forensics
+    (blackbox/ package). A bounded in-memory ring of structured incident
+    events — every failure detector (SDC/gray verdicts, watchdog
+    timeouts, breaker transitions, shed/drain, fleet resizes, sentinel
+    rewinds, chaos injections, restart records) emits one
+    ``{ts, step, rank, kind, severity, payload, schema_version}``
+    envelope — plus a rolling per-step tail, all off the step path. Any
+    severity >= ``trigger_severity`` event (or SIGUSR1 /
+    ``ds_incident snap``) atomically dumps an ``incidents/<ts>_<trigger>/``
+    bundle (event ring, metrics/trace tails incl. rotated sessions,
+    restart_log slice, config fingerprint, env report, held-locks table +
+    faulthandler stacks) under a hard size budget; ``bin/ds_incident
+    report`` merges per-rank bundles on clock anchors into one
+    first-cause timeline. STRICT no-op when the block is absent: the
+    module is never imported, and the lowered HLO is byte-identical
+    whether absent or armed (host-side only; both asserted in tests).
+    See docs/CONFIG.md 'blackbox' section for the bundle layout table."""
+    enabled: bool = Field(True, description="arm the flight recorder (the block being present opts in; set false to keep the block but skip the work)")
+    ring_size: int = Field(512, ge=1, description="bounded event ring capacity — oldest envelope events are overwritten; size it to cover the longest anomaly lead-up worth forensics")
+    metric_tail: int = Field(256, ge=1, description="rolling per-step samples (step, ts, wall_s) kept for the bundle's step_tail.jsonl — the recorder's own recent-history heartbeat")
+    span_tail: int = Field(256, ge=1, description="recent trace spans captured per session (live tracer + rotated trace.session<N>.json) into the bundle's trace_tail.jsonl")
+    max_bundle_mb: float = Field(16.0, gt=0.0, description="hard byte budget per incident bundle — tails are capped to shares of it and the biggest artifact is emptied (noted in the manifest) rather than exceed it")
+    max_bundles: int = Field(8, ge=1, description="incident bundles kept under incidents/ — oldest pruned first, so a crash-looping fleet cannot fill the disk")
+    min_trigger_interval_s: float = Field(30.0, ge=0.0, description="rate limit between trigger-driven bundle dumps (SIGUSR1/snap bypass it) — an error storm yields one bundle, not hundreds")
+    trigger_severity: str = Field("error", description="minimum event severity (debug/info/warning/error/critical) that triggers an automatic bundle dump")
+    signal_snap: bool = Field(True, description="install a SIGUSR1 handler that dumps stacks + an incident bundle on demand (the ds_incident snap path); handler defers all I/O to a sentinel thread")
+    output_dir: Optional[str] = Field(None, description="where incidents/ lands; defaults to telemetry.output_dir (the doctor schema pass errors when neither is set)")
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Verified checkpoints + recovery policy (resilience/ package). See
     docs/CONFIG.md 'resilience' section for the recovery-semantics table."""
@@ -968,6 +998,10 @@ class DeepSpeedConfig:
         # HLO byte-identical)
         self.gray = GrayConfig(**pd.get("gray", {}))
         self.gray_present = "gray" in pd
+        # presence matters, same contract again: no block, no blackbox
+        # module (never imported; no ring, no signal handler, no bundles)
+        self.blackbox = BlackboxConfig(**pd.get("blackbox", {}))
+        self.blackbox_present = "blackbox" in pd
         self.hybrid_engine = HybridEngineConfig(**pd.get("hybrid_engine", {}))
         self.gradient_compression = GradientCompressionConfig(**pd.get("gradient_compression", {}))
         self.compression_config = pd.get("compression_training", {})
@@ -1035,7 +1069,7 @@ class DeepSpeedConfig:
         "elasticity", "hybrid_engine", "gradient_compression",
         "compression_training", "sparse_attention", "data_efficiency",
         "autotuning", "optimizer", "scheduler", "gradient_clipping", "resilience", "rewind", "watchdog", "analysis",
-        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wire", "sdc", "roofline", "gray", "wall_clock_breakdown", "memory_breakdown",
+        "steps_per_print", "telemetry", "profiling", "perf", "serving", "goodput", "overlap", "wire", "sdc", "roofline", "gray", "blackbox", "wall_clock_breakdown", "memory_breakdown",
         "dump_state", "seed", "eigenvalue", "progressive_layer_drop",
         "train_batch_size", "train_micro_batch_size_per_gpu",
         "train_micro_batch_size_per_chip", "gradient_accumulation_steps",

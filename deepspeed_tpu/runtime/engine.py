@@ -21,6 +21,7 @@ from __future__ import annotations
 import inspect
 import math
 import os
+import sys
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -559,6 +560,21 @@ class DeepSpeedEngine:
         # (comm timed_op, resilience counters) see the noop registry
         self.telemetry = _telemetry.configure(self._config.telemetry,
                                               monitor=self.monitor)
+        # Watchdog stack dumps used to be stderr-only unless the user set an
+        # explicit stack_dump_file; route them into the telemetry dir by
+        # default so incident bundles (and remote debugging) can capture
+        # them. An explicit watchdog.stack_dump_file still wins (it was
+        # installed above and this branch is skipped).
+        if (self._watchdog is not None
+                and not self._config.watchdog.stack_dump_file):
+            sess = _telemetry.get_session()
+            if sess is not None and sess.output_dir:
+                from deepspeed_tpu.resilience.watchdog import \
+                    set_default_dump_path
+
+                set_default_dump_path(
+                    os.path.join(sess.output_dir, "stacks.txt"),
+                    source="config")
         # ---- memory profiler (ds_prof) -----------------------------------
         # HBM live-buffer census + executable accounting + leak sentinel
         # (profiling/memory.py), sampled every profiling.sample_interval
@@ -631,6 +647,43 @@ class DeepSpeedEngine:
             from deepspeed_tpu.resilience.gray import GrayManager
 
             self._gray = GrayManager(self, self._config.gray)
+        # ---- blackbox flight recorder (ds_blackbox) ------------------------
+        # always-on incident forensics (blackbox/): bounded event ring fed
+        # by every failure detector through one envelope schema, trigger →
+        # atomic incidents/<ts>_<trigger>/ bundle dumps, merged cross-rank
+        # by bin/ds_incident. STRICT no-op when the ``blackbox`` block is
+        # absent: the module is never imported, and the lowered HLO is
+        # byte-identical whether absent or armed (host-side only; both
+        # asserted in tests). Producers emit via
+        # sys.modules.get("deepspeed_tpu.blackbox") so an unarmed run
+        # never even pays the import.
+        self._blackbox = None
+        if self._config.blackbox_present and self._config.blackbox.enabled:
+            from deepspeed_tpu import blackbox as _blackbox_mod
+
+            self._blackbox = _blackbox_mod.configure(
+                self._config.blackbox, rank=dist.get_rank())
+            if self._blackbox is not None:
+                # the startup-consistency hash when the watchdog agreement
+                # ran, else the same config_fingerprint the perf ledger
+                # stamps — ds_incident merge refuses to mix bundles whose
+                # fingerprints disagree (different runs, not one incident)
+                fp = getattr(self, "_config_fingerprint", None)
+                if fp is None:
+                    try:
+                        from deepspeed_tpu.resilience.consistency import \
+                            config_fingerprint
+                        fp = config_fingerprint(
+                            self._config.to_dict(),
+                            mesh=getattr(self, "mesh", None))
+                    except Exception:
+                        fp = None
+                self._blackbox.config_fingerprint = fp
+                # bundles are per-PROCESS (one recorder per host process),
+                # so the merge's missing-rank denominator is the process
+                # count, not the device count — an 8-device single-process
+                # sim writes exactly one bundle and that is complete
+                self._blackbox.world_size = jax.process_count()
         self._flops_probe = None
         dist.configure(self._config)
         self.flops_profiler_cfg = self._config.flops_profiler_config
@@ -1732,6 +1785,10 @@ class DeepSpeedEngine:
                 # AFTER the sentinel: a step the sentinel flagged (or a
                 # rewound-to step) must not enter the tier-0 ring
                 self._rewind.maybe_snapshot(self._host_step, metrics)
+            if self._blackbox is not None:
+                # flight-recorder heartbeat: one locked deque append — the
+                # rolling step tail every incident bundle ships
+                self._blackbox.on_step(self._host_step)
             # the timer stop syncs on the loss, so the enclosing span's
             # duration covers the device step, not just its dispatch
             self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics.loss)
@@ -2149,6 +2206,13 @@ class DeepSpeedEngine:
             "resilience/sentinel_rewinds", labels={"tier": tier}).inc()
         _telemetry.get_tracer().instant("sentinel_rewind", cat="resilience",
                                         reason=reason, tier=tier)
+        _bb = sys.modules.get("deepspeed_tpu.blackbox")
+        if _bb is not None:
+            _bb.record("sentinel_rewind", "error",
+                       {"reason": reason, "tier": tier,
+                        "rewind": self._sentinel_rewinds,
+                        "max_rewinds": sentinel.max_rewinds},
+                       step=getattr(self, "_host_step", None))
         sentinel.reset()
 
     # ------------------------------------------------------------ accessors

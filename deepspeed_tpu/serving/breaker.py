@@ -22,6 +22,7 @@ Thread-safe; the clock is injectable for deterministic tests.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Callable, Optional, Tuple
@@ -81,6 +82,13 @@ class CircuitBreaker:
         self.transitions.append((frm, to, self._clock()))
         logger.warning(f"serving circuit breaker: {frm} -> {to} "
                        f"(consecutive_failures={self._consecutive_failures})")
+        bb = sys.modules.get("deepspeed_tpu.blackbox")
+        if bb is not None:
+            # tripping OPEN is the incident; recovery transitions are context
+            bb.record("breaker_transition",
+                      "error" if to == OPEN else "info",
+                      {"from": frm, "to": to,
+                       "consecutive_failures": self._consecutive_failures})
         if self.on_transition is not None:
             try:
                 self.on_transition(frm, to)

@@ -29,6 +29,7 @@ import collections
 import json
 import os
 import signal
+import sys
 import threading
 import time
 import uuid
@@ -149,6 +150,15 @@ class ServingFrontEnd:
             self._set_state_gauge()
             logger.info(f"serving state: {frm} -> {to}"
                         + (f" ({self._drain_reason})" if to == ServerState.DRAINING else ""))
+            bb = sys.modules.get("deepspeed_tpu.blackbox")
+            if bb is not None:
+                degraded = to in (ServerState.DRAINING, ServerState.DEGRADED,
+                                  ServerState.DEAD)
+                bb.record("serving_transition",
+                          "warning" if degraded else "info",
+                          {"from": frm, "to": to,
+                           "reason": self._drain_reason
+                           if to == ServerState.DRAINING else None})
         self._write_status()
 
     @property
@@ -268,6 +278,9 @@ class ServingFrontEnd:
 
     def _shed_count(self, reason: str) -> None:
         self._count("shed", labels={"reason": reason})
+        bb = sys.modules.get("deepspeed_tpu.blackbox")
+        if bb is not None:
+            bb.record("shed", "warning", {"reason": reason})
 
     def _resolve_shed(self, req: Request, reason: str,
                       retry_after_s: float = 0.0) -> None:
@@ -278,6 +291,10 @@ class ServingFrontEnd:
         ledger reconciliation `admitted == completed + timed_out + drained
         + failed + Σ shed_admitted` stays checkable from the JSONL."""
         self._count("shed_admitted", labels={"reason": reason})
+        bb = sys.modules.get("deepspeed_tpu.blackbox")
+        if bb is not None:
+            bb.record("shed_admitted", "warning",
+                      {"reason": reason, "retry_after_s": retry_after_s})
         req.retry_after_s = float(retry_after_s)
         self._resolve(req, "shed", reason)
 

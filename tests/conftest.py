@@ -85,6 +85,58 @@ def _witness_chaos(request):
         _locks.reset_witness()
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Stamp each phase's report on the item so teardown-time fixtures
+    (incident_forensics) can tell a PASSING drill from a failing one —
+    forensics asserts must never shadow the drill's own failure."""
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "rep_" + rep.when, rep)
+
+
+@pytest.fixture
+def incident_forensics(request, tmp_path):
+    """Post-drill incident forensics (the ds_blackbox acceptance rider):
+    after a PASSING ``@pytest.mark.incident_drill(device=D)`` evict drill
+    whose telemetry landed in ``tmp_path/"tel"``, the flight recorder
+    must have dumped >= 1 incident bundle, and ``bin/ds_incident report``
+    must merge it into a timeline naming the blamed device D as first
+    cause. Runs as teardown so the drill body stays unchanged; skipped
+    when the drill itself failed (one failure, not two)."""
+    import subprocess
+    import sys as _sys
+
+    yield
+    # teardown always releases the recorder's SIGUSR1 sentinel thread,
+    # pass or fail — the thread-lifecycle sentinel would flag a leak
+    from deepspeed_tpu import blackbox as _bb
+
+    _bb.deconfigure()
+    rep = getattr(request.node, "rep_call", None)
+    if rep is None or not rep.passed:
+        return
+    marker = request.node.get_closest_marker("incident_drill")
+    device = marker.kwargs.get("device") if marker else None
+    tel = os.path.join(str(tmp_path), "tel")
+    incidents = os.path.join(tel, "incidents")
+    assert os.path.isdir(incidents), (
+        "drill passed but the flight recorder wrote no incident bundle "
+        f"under {tel} — the error-severity verdict should have triggered "
+        "a dump")
+    bundles = [d for d in os.listdir(incidents)
+               if not d.endswith(".tmp")]
+    assert bundles, f"incidents/ exists but holds no bundle: {incidents}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(repo, "bin", "ds_incident"),
+         "report", tel], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "first cause:" in proc.stdout, proc.stdout
+    if device is not None:
+        assert f"device {device}" in proc.stdout, proc.stdout
+
+
 @pytest.fixture
 def mesh8():
     from deepspeed_tpu.parallel.topology import build_mesh

@@ -1,0 +1,801 @@
+"""ds_blackbox: flight recorder + incident bundles + ds_incident forensics.
+
+What is covered here:
+
+* the unified event envelope (schema_version / event_id / ts / severity)
+  and the restart-record stamping the SDC/gray verdicts ride;
+* strict no-op: without the ``blackbox`` block the module is never
+  imported and the lowered step HLO is byte-identical — and because the
+  recorder is entirely host-side, an ARMED block lowers the same bytes;
+* the recorder: bounded ring, step tail, severity-gated trigger→bundle
+  dumps, rate limiting, pruning, clean-run zero bundles;
+* bundle contents: manifest identity, torn-tail trimming, the hard size
+  budget, tmp-dir atomicity;
+* the ``ds_incident`` merge degradation matrix: torn JSONL tails,
+  missing ranks, two bundles claiming one rank, overlapping sessions,
+  mixed schema versions — warn loudly, never fabricate;
+* first-cause priority (verdict > error > restart > skew gauge >
+  refuse-to-guess) and the rendered report;
+* the `incident:` line shared by ds_top and the ds_metrics footer.
+
+THE cross-rank drill (chaos slow_device → gray verdict → evict 8→6 →
+merged bundle naming device 3 as first cause) rides the existing
+``test_gray.py`` / ``test_sdc.py`` evict drills through the
+``incident_forensics`` conftest fixture.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models.simple import SimpleModel
+from deepspeed_tpu.runtime.config import BlackboxConfig
+
+HIDDEN = 16
+TBS = 8
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BB_MOD = "deepspeed_tpu.blackbox"
+
+pytestmark = pytest.mark.blackbox
+
+
+def plain_engine(extra=None):
+    comm.cdb = None
+    cfg = {"train_batch_size": TBS,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 0}
+    if extra:
+        cfg.update(extra)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN, nlayers=2), config=cfg)
+    return engine
+
+
+def batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(TBS, HIDDEN).astype(np.float32),
+            rng.randn(TBS, 1).astype(np.float32))
+
+
+def make_recorder(tmp_path, **over):
+    from deepspeed_tpu import blackbox
+
+    kw = {"output_dir": str(tmp_path / "bb"), "min_trigger_interval_s": 0.0,
+          "signal_snap": False}
+    kw.update(over)
+    return blackbox.configure(BlackboxConfig(**kw))
+
+
+@pytest.fixture(autouse=True)
+def _teardown_recorder():
+    yield
+    bb = sys.modules.get(BB_MOD)
+    if bb is not None:
+        bb.deconfigure()
+    from deepspeed_tpu import telemetry
+
+    telemetry.deconfigure()
+    # The sentinel-rewind drill arms the rewind ladder; its tier-0 snapshots
+    # live in a module global that DSElasticAgent reads as "a RAM tier is
+    # available" — leaking them makes every later agent test resume into an
+    # empty save_dir.
+    rw = sys.modules.get("deepspeed_tpu.resilience.rewind")
+    if rw is not None:
+        rw.clear_ram_snapshots()
+
+
+# --------------------------------------------------------------- envelope
+class TestEnvelope:
+    def test_make_event_fields(self):
+        from deepspeed_tpu.telemetry.events import (SCHEMA_VERSION,
+                                                    make_event)
+
+        ev = make_event("gray_verdict", "error", {"device": 3}, step=7,
+                        rank=2, ts=100.5, mono=40.0)
+        assert ev["schema_version"] == SCHEMA_VERSION
+        assert ev["kind"] == "gray_verdict"
+        assert ev["severity"] == "error"
+        assert ev["step"] == 7 and ev["rank"] == 2
+        assert ev["ts"] == 100.5 and ev["mono"] == 40.0
+        assert ev["payload"] == {"device": 3}
+        assert len(ev["event_id"]) == 12
+
+    def test_event_ids_unique(self):
+        from deepspeed_tpu.telemetry.events import new_event_id
+
+        ids = {new_event_id() for _ in range(256)}
+        assert len(ids) == 256
+
+    def test_severity_rank_ordering_and_unknown(self):
+        from deepspeed_tpu.telemetry.events import severity_rank
+
+        ranks = [severity_rank(s) for s in
+                 ("debug", "info", "warning", "error", "critical")]
+        assert ranks == sorted(ranks)
+        assert severity_rank("nonsense") == -1
+
+    def test_stamp_envelope_preserves_existing(self):
+        from deepspeed_tpu.telemetry.events import (SCHEMA_VERSION,
+                                                    stamp_envelope)
+
+        rec = {"event": "restart", "step": 4}
+        out = stamp_envelope(rec, kind="restart", severity="error")
+        assert out is rec                       # in place
+        assert rec["schema_version"] == SCHEMA_VERSION
+        assert rec["kind"] == "restart" and rec["severity"] == "error"
+        eid = rec["event_id"]
+        stamp_envelope(rec, kind="other", severity="info")
+        assert rec["event_id"] == eid           # setdefault, not overwrite
+        assert rec["kind"] == "restart"
+
+    def test_schema_version_cross_check_with_incident_literal(self):
+        """incident.py duplicates SCHEMA_VERSION as a literal so it stays
+        importable on a jax-less responder box — the two must agree."""
+        from deepspeed_tpu.blackbox import incident
+        from deepspeed_tpu.telemetry import events
+
+        assert incident.SCHEMA_VERSION == events.SCHEMA_VERSION
+        assert set(incident._SEVERITY_RANK) == set(events.SEVERITIES)
+
+    def test_verdict_records_ride_the_envelope(self):
+        """Satellite: restart_log records (here: the verdict to_record
+        payloads) are stamped with schema_version + event_id so a
+        mixed-version fleet merges loudly instead of silently."""
+        from deepspeed_tpu.resilience.gray import GrayVerdict
+        from deepspeed_tpu.resilience.sdc import SdcVerdict
+        from deepspeed_tpu.telemetry.events import SCHEMA_VERSION
+
+        gv = GrayVerdict(step=5, device=3, kind="slow-compute",
+                         evidence={}).to_record()
+        sv = SdcVerdict(step=6, device=5, evidence={}).to_record()
+        for rec in (gv, sv):
+            assert rec["schema_version"] == SCHEMA_VERSION
+            assert rec["event_id"]
+            assert rec["severity"] == "error"
+        # stamp_envelope setdefaults: gray's domain "kind" (slow-compute)
+        # is preserved, sdc picks up the envelope kind
+        assert gv["kind"] == "slow-compute"
+        assert sv["kind"] == "sdc_verdict"
+
+
+# ------------------------------------------------------------ strict no-op
+class TestStrictNoOp:
+    def _without_module(self):
+        return {m: sys.modules.pop(m) for m in list(sys.modules)
+                if m == BB_MOD or m.startswith(BB_MOD + ".")}
+
+    def test_block_absent_never_imports_module(self):
+        saved = self._without_module()
+        try:
+            engine = plain_engine()
+            engine.train_batch(batch())
+            assert engine._blackbox is None
+            assert BB_MOD not in sys.modules
+        finally:
+            sys.modules.update(saved)
+
+    def test_enabled_false_never_imports_module(self):
+        saved = self._without_module()
+        try:
+            engine = plain_engine(extra={"blackbox": {"enabled": False}})
+            engine.train_batch(batch())
+            assert engine._blackbox is None
+            assert BB_MOD not in sys.modules
+        finally:
+            sys.modules.update(saved)
+
+    def test_producer_idiom_is_noop_without_module(self):
+        """The producer idiom (sys.modules.get) costs a dict lookup and
+        nothing else when the package was never imported."""
+        saved = self._without_module()
+        try:
+            bb = sys.modules.get(BB_MOD)
+            assert bb is None
+        finally:
+            sys.modules.update(saved)
+
+    def test_step_hlo_byte_identical_even_armed(self, tmp_path):
+        """Absent == enabled:false down to the lowered HLO bytes — and
+        because the recorder is entirely host-side (ring appends and
+        bundle dumps never touch the compiled program), an ARMED block
+        lowers the same bytes too."""
+        def lowered(extra):
+            engine = plain_engine(extra=extra)
+            b = engine._shard_batch(batch())
+            abstract = lambda t: jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=x.sharding), t)
+            with engine.mesh:
+                return engine._get_compiled_train_batch(1).lower(
+                    abstract(engine.state), abstract(b)).as_text()
+
+        absent = lowered(None)
+        off = lowered({"blackbox": {"enabled": False}})
+        armed = lowered({"blackbox": {
+            "output_dir": str(tmp_path / "bb"), "signal_snap": False}})
+        assert absent == off
+        assert armed == absent
+
+
+# ---------------------------------------------------------------- recorder
+class TestRecorder:
+    def test_ring_is_bounded_totals_are_not(self, tmp_path):
+        rec = make_recorder(tmp_path, ring_size=4)
+        for i in range(10):
+            rec.record("chaos_injection", "warning", {"i": i}, step=i)
+        ring = rec.ring_snapshot()
+        assert len(ring) == 4
+        assert [e["payload"]["i"] for e in ring] == [6, 7, 8, 9]
+        assert rec.events_total == 10
+        assert rec.errors_total == 0
+        assert rec.overhead_us() > 0.0
+
+    def test_step_tail_bounded(self, tmp_path):
+        rec = make_recorder(tmp_path, metric_tail=3)
+        for i in range(7):
+            rec.on_step(i, wall_s=0.01)
+        tail = rec.step_tail_snapshot()
+        assert [t["step"] for t in tail] == [4, 5, 6]
+        assert rec.steps_seen() == 7
+        assert rec.last_step == 6
+
+    def test_clean_run_writes_zero_bundles(self, tmp_path):
+        rec = make_recorder(tmp_path)
+        rec.record("fleet_resize", "warning", {"kind": "grow"})
+        rec.record("rewind_recovery", "info", {"tier": "ram"})
+        assert rec.bundles_written == 0
+        assert not os.path.exists(str(tmp_path / "bb" / "incidents"))
+
+    def test_error_event_triggers_bundle(self, tmp_path):
+        rec = make_recorder(tmp_path)
+        rec.record("sdc_verdict", "error", {"device": 5}, step=6)
+        assert rec.bundles_written == 1
+        assert rec.last_trigger == "sdc_verdict"
+        assert os.path.isdir(rec.last_bundle_dir)
+        assert os.path.basename(rec.last_bundle_dir).endswith("_sdc_verdict")
+
+    def test_trigger_severity_knob(self, tmp_path):
+        rec = make_recorder(tmp_path, trigger_severity="critical")
+        rec.record("watchdog_timeout", "error", {})
+        assert rec.bundles_written == 0
+        rec.record("watchdog_timeout", "critical", {})
+        assert rec.bundles_written == 1
+
+    def test_rate_limit_one_bundle_per_interval(self, tmp_path):
+        rec = make_recorder(tmp_path, min_trigger_interval_s=3600.0)
+        rec.record("watchdog_timeout", "error", {"kind": "stall"})
+        rec.record("sdc_verdict", "error", {"device": 1})
+        assert rec.bundles_written == 1      # second is inside the window
+        assert rec.last_trigger == "watchdog_timeout"
+
+    def test_snap_forces_bundle_without_trigger(self, tmp_path):
+        from deepspeed_tpu import blackbox
+
+        rec = make_recorder(tmp_path)
+        rec.record("shed", "warning", {"reason": "queue_full"})
+        path = blackbox.snap("manual")
+        assert path is not None and os.path.isdir(path)
+        assert rec.bundles_written == 1
+        assert rec.last_trigger == "manual"
+
+    def test_bundle_pruning_keeps_newest(self, tmp_path):
+        from deepspeed_tpu.blackbox import bundle as bmod
+
+        rec = make_recorder(tmp_path, max_bundles=2)
+        inc = str(tmp_path / "bb" / "incidents")
+        # three distinct bundle dirs (the collision suffix distinguishes
+        # same-second dumps) + one torn .tmp leftover
+        for i in range(3):
+            rec.record("watchdog_timeout", "error", {"i": i})
+        os.makedirs(os.path.join(inc, "19700101T000000_dead.tmp"))
+        bmod.prune_bundles(inc, 2)
+        left = sorted(os.listdir(inc))
+        assert len(left) == 2
+        assert not any(n.endswith(".tmp") for n in left)
+
+    def test_record_unarmed_module_level_is_none(self):
+        from deepspeed_tpu import blackbox
+
+        blackbox.deconfigure()
+        assert blackbox.record("x", "error", {}) is None
+        assert blackbox.snap() is None
+        assert blackbox.get_recorder() is None
+
+    def test_configure_replaces_and_closes_previous(self, tmp_path):
+        from deepspeed_tpu import blackbox
+
+        first = make_recorder(tmp_path)
+        second = blackbox.configure(BlackboxConfig(
+            output_dir=str(tmp_path / "bb2"), signal_snap=False))
+        assert blackbox.get_recorder() is second
+        assert first._closed
+
+
+# ------------------------------------------------------------------ bundle
+class TestBundle:
+    def test_bundle_contents_and_manifest(self, tmp_path):
+        tel = tmp_path / "bb"
+        tel.mkdir()
+        (tel / "metrics.jsonl").write_text(
+            json.dumps({"name": "goodput/mfu", "value": 0.4, "kind": "gauge"})
+            + "\n" + '{"torn...')
+        (tel / "restart_log.jsonl").write_text(
+            json.dumps({"event": "restart", "step": 3, "ts": 123.0}) + "\n")
+        rec = make_recorder(tmp_path)
+        rec.config_fingerprint = "fp123"
+        rec.world_size = 1
+        rec.on_step(3)
+        rec.record("gray_verdict", "error", {"device": 3}, step=3)
+        b = rec.last_bundle_dir
+        names = sorted(os.listdir(b))
+        assert "events.jsonl" in names and "manifest.json" in names
+        assert "stacks.txt" in names and "env.json" in names
+        with open(os.path.join(b, "manifest.json")) as f:
+            m = json.load(f)
+        assert m["schema_version"] == 1
+        assert m["trigger"] == "gray_verdict"
+        assert m["rank"] == 0 and m["world_size"] == 1
+        assert m["config_fingerprint"] == "fp123"
+        assert set(m["clock_anchor"]) == {"epoch_s", "monotonic_s"}
+        # the tail copy is raw bytes (torn-line dropping is ds_incident's
+        # job at merge time) — the whole record must be there
+        with open(os.path.join(b, "metrics_tail.jsonl")) as f:
+            tail = []
+            for l in f:
+                try:
+                    tail.append(json.loads(l))
+                except ValueError:
+                    pass
+        assert any(r.get("name") == "goodput/mfu" for r in tail)
+        # restart_log slice captured
+        with open(os.path.join(b, "restart_log.jsonl")) as f:
+            rl = [json.loads(l) for l in f if l.strip()]
+        assert rl and rl[0]["event"] == "restart"
+        # stacks contain real faulthandler tracebacks, not a degraded note
+        stacks = open(os.path.join(b, "stacks.txt")).read()
+        assert "Current thread" in stacks and "File " in stacks
+        assert "faulthandler failed" not in stacks
+        # no half-written tmp dir left behind
+        assert not any(n.endswith(".tmp")
+                       for n in os.listdir(os.path.dirname(b)))
+
+    def test_hard_size_budget(self, tmp_path):
+        tel = tmp_path / "bb"
+        tel.mkdir()
+        big = json.dumps({"name": "goodput/step_wall_s", "value": 1.0,
+                          "pad": "x" * 512})
+        (tel / "metrics.jsonl").write_text((big + "\n") * 4096)  # ~2 MiB
+        rec = make_recorder(tmp_path, max_bundle_mb=0.05)
+        rec.record("watchdog_timeout", "error", {})
+        b = rec.last_bundle_dir
+        total = sum(os.path.getsize(os.path.join(b, n))
+                    for n in os.listdir(b))
+        assert total <= int(0.05 * 1024 * 1024) + 4096  # manifest slack
+        with open(os.path.join(b, "manifest.json")) as f:
+            m = json.load(f)
+        assert any("truncat" in n or "budget" in n for n in m["notes"]) or \
+            os.path.getsize(os.path.join(b, "metrics_tail.jsonl")) \
+            < 4096 * len(big)
+
+
+# --------------------------------------------- ds_incident merge + forensics
+def mk_bundle(root, name, rank, events, world_size=None, fingerprint="fp",
+              ts=1000.0, restart=(), trace=(), metrics=(), schema_version=1,
+              torn_tail=False):
+    d = os.path.join(str(root), "incidents", name)
+    os.makedirs(d)
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump({"schema_version": schema_version, "trigger": "test",
+                   "rank": rank, "world_size": world_size, "ts": ts,
+                   "clock_anchor": {"epoch_s": ts, "monotonic_s": 0.0},
+                   "config_fingerprint": fingerprint}, f)
+    with open(os.path.join(d, "events.jsonl"), "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+        if torn_tail:
+            f.write('{"kind": "cut-mid-wr')
+    for fname, recs in (("restart_log.jsonl", restart),
+                        ("trace_tail.jsonl", trace),
+                        ("metrics_tail.jsonl", metrics)):
+        if recs:
+            with open(os.path.join(d, fname), "w") as f:
+                for r in recs:
+                    f.write(json.dumps(r) + "\n")
+    return d
+
+
+def ev(kind, severity, ts, rank, step=None, payload=None, eid=None,
+       schema_version=1):
+    import uuid
+
+    return {"schema_version": schema_version,
+            "event_id": eid or uuid.uuid4().hex[:12], "ts": ts,
+            "mono": ts, "step": step, "rank": rank, "kind": kind,
+            "severity": severity, "payload": payload or {}}
+
+
+class TestIncidentMerge:
+    def test_two_rank_merge_ordered_timeline(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("watchdog_timeout", "error", 1002.0, 0, step=9),
+                   ev("shed", "warning", 1001.0, 0)], world_size=2)
+        mk_bundle(tmp_path, "b_r1", 1,
+                  [ev("gray_verdict", "error", 1000.5, 1, step=8,
+                      payload={"device": 3, "kind": "slow-compute"})],
+                  world_size=2)
+        rep = build_report([str(tmp_path)])
+        assert rep["ranks"] == [0, 1]
+        kinds = [e["kind"] for e in rep["timeline"]]
+        assert kinds == ["gray_verdict", "shed", "watchdog_timeout"]
+        fc = rep["first_cause"]
+        assert fc["rank"] == 1 and fc["device"] == 3
+        assert "verdict" in fc["why"]
+        # no missing-rank warning: both ranks of world 2 are present
+        assert not any("missing bundle" in w for w in rep["warnings"])
+
+    def test_torn_events_tail_warns_and_degrades(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("watchdog_timeout", "error", 1000.0, 0)],
+                  torn_tail=True)
+        rep = build_report([str(tmp_path)])
+        assert len(rep["timeline"]) == 1       # whole event survived
+        assert any("torn" in w for w in rep["warnings"])
+
+    def test_missing_rank_warns(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("watchdog_timeout", "error", 1000.0, 0)], world_size=3)
+        mk_bundle(tmp_path, "b_r2", 2,
+                  [ev("shed", "warning", 1001.0, 2)], world_size=3)
+        rep = build_report([str(tmp_path)])
+        w = [w for w in rep["warnings"] if "missing bundle" in w]
+        assert w and "[1]" in w[0]
+
+    def test_two_bundles_one_rank_dedups_and_warns(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        shared = ev("watchdog_timeout", "error", 1000.0, 0, eid="aaaaaaaaaaaa")
+        mk_bundle(tmp_path, "a_r0", 0, [shared], ts=1000.0)
+        mk_bundle(tmp_path, "b_r0_again", 0,
+                  [shared, ev("shed", "warning", 1001.0, 0)], ts=1001.0)
+        rep = build_report([str(tmp_path)])
+        assert any("claimed by 2 bundles" in w for w in rep["warnings"])
+        # the shared event_id appears once
+        assert [e["kind"] for e in rep["timeline"]].count(
+            "watchdog_timeout") == 1
+
+    def test_one_rank_fingerprint_disagreement_warns(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("watchdog_timeout", "error", 1000.0, 0)],
+                  fingerprint="fpA")
+        mk_bundle(tmp_path, "b_r0", 0,
+                  [ev("shed", "warning", 2000.0, 0)], fingerprint="fpB")
+        rep = build_report([str(tmp_path)])
+        assert any("different runs" in w for w in rep["warnings"])
+
+    def test_overlapping_sessions_warn(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("shed", "warning", 1000.0, 0),
+                   ev("shed", "warning", 1010.0, 0)])
+        mk_bundle(tmp_path, "b_r0", 0,
+                  [ev("shed", "warning", 1005.0, 0),
+                   ev("shed", "warning", 1015.0, 0)])
+        rep = build_report([str(tmp_path)])
+        assert any("overlap in time" in w for w in rep["warnings"])
+
+    def test_mixed_schema_versions_warn(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("watchdog_timeout", "error", 1000.0, 0,
+                      schema_version=99)], schema_version=99)
+        rep = build_report([str(tmp_path)])
+        assert any("mixed-version fleet" in w for w in rep["warnings"])
+        assert any("foreign schema_version" in w for w in rep["warnings"])
+        assert len(rep["timeline"]) == 1       # merged anyway, loudly
+
+    def test_half_written_tmp_bundle_skipped(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("watchdog_timeout", "error", 1000.0, 0)])
+        os.makedirs(str(tmp_path / "incidents" / "b_r1.tmp"))
+        rep = build_report([str(tmp_path)])
+        assert len(rep["bundles"]) == 1
+        assert any(".tmp" in w for w in rep["warnings"])
+
+    def test_first_cause_priority_ladder(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        # 1) a verdict beats an EARLIER plain error
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("watchdog_timeout", "error", 1000.0, 0),
+                   ev("sdc_verdict", "error", 1005.0, 0,
+                      payload={"device": 5, "kind": "corruption"})])
+        rep = build_report([str(tmp_path)])
+        assert rep["first_cause"]["device"] == 5
+
+    def test_first_cause_error_then_restart_then_skew(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        # 2) no verdict: earliest error event
+        mk_bundle(tmp_path / "e", "a_r0", 0,
+                  [ev("shed", "warning", 999.0, 0),
+                   ev("watchdog_timeout", "error", 1000.0, 0)])
+        rep = build_report([str(tmp_path / "e")])
+        assert rep["first_cause"]["kind"] == "watchdog_timeout"
+        # 3) no errors at all: earliest restart record
+        mk_bundle(tmp_path / "r", "a_r0", 0,
+                  [ev("shed", "warning", 999.0, 0)],
+                  restart=[{"event": "restart", "ts": 998.0, "step": 3}])
+        rep = build_report([str(tmp_path / "r")])
+        assert "restart record" in rep["first_cause"]["why"]
+        # 4) nothing but a skew gauge
+        mk_bundle(tmp_path / "s", "a_r0", 0,
+                  [ev("shed", "warning", 999.0, 0)],
+                  metrics=[{"name": "comm/latency_skew", "value": 4.2}])
+        rep = build_report([str(tmp_path / "s")])
+        assert "skew" in rep["first_cause"]["why"]
+        # 5) no evidence at all: refuse to guess
+        mk_bundle(tmp_path / "n", "a_r0", 0,
+                  [ev("shed", "warning", 999.0, 0)])
+        rep = build_report([str(tmp_path / "n")])
+        assert rep["first_cause"] is None
+        assert any("refusing to guess" in w for w in rep["warnings"])
+
+    def test_recovery_and_cost_from_bundle_restarts(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import (build_report,
+                                                     render_report)
+
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("gray_verdict", "error", 1000.0, 0,
+                      payload={"device": 3, "kind": "slow-compute"})],
+                  restart=[{"event": "restart", "ts": 1001.0, "step": 12,
+                            "backoff_s": 1.5,
+                            "recovery": {"tier": "ram", "steps_lost": 2,
+                                         "restore_s": 0.5,
+                                         "resize": {"kind": "shrink",
+                                                    "from": 8, "to": 6}}}])
+        rep = build_report([str(tmp_path)])
+        assert rep["cost"]["recovery"]["tier"] == "ram"
+        assert rep["cost"]["fleet_seconds"] == 2.0   # backoff + restore
+        text = render_report(rep)
+        assert "recovery: tier=ram" in text
+        assert "resize 8->6" in text
+        assert "first cause: rank 0 device 3" in text
+
+    def test_render_report_and_elision(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import (build_report,
+                                                     render_report)
+
+        events = [ev("shed", "warning", 1000.0 + i, 0) for i in range(30)]
+        events.append(ev("watchdog_timeout", "error", 1031.0, 0))
+        mk_bundle(tmp_path, "a_r0", 0, events)
+        rep = build_report([str(tmp_path)])
+        text = render_report(rep, max_events=10)
+        assert "more ..." in text
+        assert "WATCHDOG_TIMEOUT".lower() in text.lower()
+        assert "trigger: test" in text
+
+    def test_empty_dir_no_fabrication(self, tmp_path):
+        from deepspeed_tpu.blackbox.incident import build_report
+
+        rep = build_report([str(tmp_path)])
+        assert rep["bundles"] == []
+        assert any("no incident bundles" in w for w in rep["warnings"])
+
+
+class TestIncidentCLI:
+    def test_report_exit_codes_and_list(self, tmp_path):
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("sdc_verdict", "error", 1000.0, 0,
+                      payload={"device": 5, "kind": "corruption"})])
+        tool = os.path.join(REPO, "bin", "ds_incident")
+        ok = subprocess.run([sys.executable, tool, "report", str(tmp_path)],
+                            capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stderr
+        assert "first cause: rank 0 device 5" in ok.stdout
+        j = subprocess.run([sys.executable, tool, "report", str(tmp_path),
+                            "--json"], capture_output=True, text=True)
+        assert j.returncode == 0
+        rep = json.loads(j.stdout)
+        assert rep["first_cause"]["device"] == 5
+        empty = subprocess.run(
+            [sys.executable, tool, "report", str(tmp_path / "nothing")],
+            capture_output=True, text=True)
+        assert empty.returncode == 1
+        usage = subprocess.run([sys.executable, tool, "report"],
+                               capture_output=True, text=True)
+        assert usage.returncode == 2
+        ls = subprocess.run([sys.executable, tool, "list", str(tmp_path)],
+                            capture_output=True, text=True)
+        assert ls.returncode == 0
+        assert "trigger=test" in ls.stdout
+
+    def test_ds_report_incident_delegates(self, tmp_path):
+        mk_bundle(tmp_path, "a_r0", 0,
+                  [ev("gray_verdict", "error", 1000.0, 0,
+                      payload={"device": 3, "kind": "slow-compute"})])
+        proc = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.env_report", "incident",
+             str(tmp_path)], capture_output=True, text=True,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        assert "first cause: rank 0 device 3" in proc.stdout
+
+
+# ----------------------------------------------------------- observability
+class TestObservability:
+    def test_render_incident_line(self):
+        from deepspeed_tpu.goodput.tail import render_incident_line
+
+        assert render_incident_line({}, {}) is None
+        line = render_incident_line(
+            {"blackbox/ring_fill": 17.0},
+            {'blackbox/events{severity=warning}': 3,
+             'blackbox/events{severity=error}': 2,
+             'blackbox/bundles{trigger=gray_verdict}': 1})
+        assert line.startswith("incident:")
+        assert "5 event(s)" in line and "2 error" in line
+        assert "ring 17" in line
+        assert "BUNDLES 1" in line and "gray_verdict" in line
+
+    def test_render_incident_line_clean(self):
+        from deepspeed_tpu.goodput.tail import render_incident_line
+
+        line = render_incident_line(
+            {"blackbox/ring_fill": 2.0},
+            {'blackbox/events{severity=info}': 2})
+        assert "no bundles" in line
+
+    def test_ds_metrics_footer(self, tmp_path):
+        tel = str(tmp_path / "tel")
+        os.makedirs(tel)
+        recs = [
+            {"name": "blackbox/events", "kind": "counter", "value": 2,
+             "labels": {"severity": "error"}, "step": 5, "ts": 1.0},
+            {"name": "blackbox/ring_fill", "kind": "gauge", "value": 2.0,
+             "step": 5, "ts": 1.0},
+            {"name": "blackbox/bundles", "kind": "counter", "value": 1,
+             "labels": {"trigger": "sdc_verdict"}, "step": 5, "ts": 1.0},
+        ]
+        with open(os.path.join(tel, "metrics.jsonl"), "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_metrics"), tel],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "incident:" in proc.stdout
+        assert "BUNDLES 1 (sdc_verdict)" in proc.stdout
+
+
+# ----------------------------------------------------------- config/schema
+class TestConfigSchema:
+    def test_defaults(self):
+        cfg = BlackboxConfig()
+        assert cfg.enabled is True
+        assert cfg.ring_size == 512
+        assert cfg.trigger_severity == "error"
+        assert cfg.signal_snap is True
+
+    def test_unknown_key_did_you_mean(self):
+        with pytest.raises(ValueError, match="ring_size"):
+            BlackboxConfig(ring_sze=64)
+
+    def test_block_absent_vs_present_flag(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        base = {"train_batch_size": 8}
+        cfg = DeepSpeedConfig(dict(base))
+        assert cfg.blackbox_present is False
+        cfg2 = DeepSpeedConfig({**base, "blackbox": {}})
+        assert cfg2.blackbox_present is True
+        assert cfg2.blackbox.enabled is True
+
+    def test_doctor_blackbox_without_telemetry_errors(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, _ = walk_config({"train_batch_size": 8, "blackbox": {}})
+        hits = [f for f in findings
+                if "blackbox" in f.citation and f.severity == "error"]
+        assert hits and "telemetry" in hits[0].message
+
+    def test_doctor_blackbox_own_output_dir_downgrades(self, tmp_path):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, _ = walk_config({
+            "train_batch_size": 8,
+            "blackbox": {"output_dir": str(tmp_path)}})
+        hits = [f for f in findings if "blackbox" in f.citation]
+        assert hits and all(f.severity == "warning" for f in hits)
+
+    def test_doctor_blackbox_with_telemetry_clean(self, tmp_path):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, _ = walk_config({
+            "train_batch_size": 8, "blackbox": {},
+            "telemetry": {"enabled": True, "output_dir": str(tmp_path)}})
+        assert not [f for f in findings if "blackbox" in f.citation]
+
+    def test_doctor_typo_did_you_mean_blackbox(self):
+        from deepspeed_tpu.analysis.schema import walk_config
+
+        findings, _ = walk_config({"train_batch_size": 8, "blackbxo": {}})
+        msgs = " ".join(f.message for f in findings)
+        assert "blackbox" in msgs
+
+
+# ------------------------------------------------------- engine integration
+class TestEngineIntegration:
+    def test_engine_arms_records_and_prices(self, tmp_path):
+        from deepspeed_tpu import blackbox, telemetry
+
+        tel = str(tmp_path / "tel")
+        engine = plain_engine(extra={
+            "blackbox": {"signal_snap": False},
+            "telemetry": {"enabled": True, "output_dir": tel,
+                          "prometheus": False, "trace": True,
+                          "flush_interval": 1}})
+        rec = engine._blackbox
+        assert rec is not None
+        assert rec.config_fingerprint           # perf-ledger-shaped hash
+        assert rec.world_size == 1              # processes, not devices
+        for i in range(3):
+            engine.train_batch(batch(i))
+        assert rec.steps_seen() == 3
+        assert rec.overhead_us() > 0.0
+        assert rec.output_dir() == tel          # telemetry session dir
+        blackbox.record("gray_verdict", "error",
+                        {"device": 3, "kind": "slow-compute"}, step=3)
+        assert rec.bundles_written == 1
+        telemetry.flush()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_incident"),
+             "report", tel], capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "first cause: rank 0 device 3" in proc.stdout
+        # no spurious missing-rank hole on a single-process sim
+        assert "missing bundle" not in proc.stdout
+
+    def test_sentinel_rewind_emits_event(self, tmp_path):
+        """The engine's bad-step sentinel is a producer: a NaN step lands
+        a sentinel_rewind error event in the ring (and hence a bundle)."""
+        from deepspeed_tpu import blackbox
+
+        make_recorder(tmp_path)
+        engine = plain_engine(extra={
+            "resilience": {"sentinel": {"enabled": True, "patience": 2}},
+            "rewind": {"ram_interval": 1, "keep": 2}})
+        for i in range(3):
+            engine.train_batch(batch(i))
+        bad = batch(9)
+        bad[0][0, 0] = np.nan
+        engine.train_batch(bad)
+        engine.train_batch(bad)                 # patience=2 → rewind
+        rec = blackbox.get_recorder()
+        kinds = [e["kind"] for e in rec.ring_snapshot()]
+        assert "sentinel_rewind" in kinds
+        sr = next(e for e in rec.ring_snapshot()
+                  if e["kind"] == "sentinel_rewind")
+        assert sr["severity"] == "error"
+        assert sr["payload"].get("tier") == "ram"
+        assert rec.bundles_written >= 1

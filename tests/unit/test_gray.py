@@ -365,8 +365,9 @@ class TestClassifyProbe:
 # ------------------------------------------------------- THE evict drill
 @pytest.mark.chaos
 class TestEvictDrill:
+    @pytest.mark.incident_drill(device=3)
     def test_THE_drill_slow_device_blamed_probed_evicted_8_to_6(
-            self, tmp_path):
+            self, tmp_path, incident_forensics):
         """The acceptance drill, end to end: device 3 of 8 turns 5x slow
         at step 11 — the comm windows stamp straggler excess, suspicion
         crosses the blame threshold, two microprobes name device 3
@@ -388,6 +389,10 @@ class TestEvictDrill:
                 rewind={"ram_interval": 2, "keep": 4},
                 extra={**SERIAL_ZERO3,
                        "gray": dict(GRAY_FAST),
+                       # the verdict is an error-severity blackbox event:
+                       # the flight recorder must dump an incident bundle
+                       # the incident_forensics teardown merges + blames
+                       "blackbox": {},
                        "telemetry": {"enabled": True, "output_dir": tel,
                                      "prometheus": False, "trace": True,
                                      "flush_interval": 1}})

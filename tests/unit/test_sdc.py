@@ -508,7 +508,9 @@ class TestRewindOnlyDrill:
 # ------------------------------------------------------- THE evict drill
 @pytest.mark.chaos
 class TestEvictDrill:
-    def test_THE_drill_bitflip_blamed_evicted_8_to_6_priced(self, tmp_path):
+    @pytest.mark.incident_drill(device=5)
+    def test_THE_drill_bitflip_blamed_evicted_8_to_6_priced(
+            self, tmp_path, incident_forensics):
         """The acceptance drill, end to end: 8-device run, chaos flips a
         bit on device 5 at audit step 6 — detected by the replay audit,
         blamed to device 5, quarantined via a chaos-shrink-shaped
@@ -550,6 +552,10 @@ class TestEvictDrill:
             return survivor_engine(
                 rewind={"ram_interval": 2, "keep": 2},
                 extra={**sdc_cfg,
+                       # the verdict is an error-severity blackbox event:
+                       # the flight recorder must dump an incident bundle
+                       # the incident_forensics teardown merges + blames
+                       "blackbox": {},
                        "telemetry": {"enabled": True, "output_dir": tel,
                                      "prometheus": False, "trace": True,
                                      "flush_interval": 1}})
